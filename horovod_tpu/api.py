@@ -1,0 +1,308 @@
+"""Public collective API: allreduce/allgather/broadcast/alltoall + handles.
+
+Reference parity: ``horovod/torch/mpi_ops.py`` (SURVEY.md §2.2) — the same
+function surface (sync + ``_async`` forms, ``grouped_*`` forms,
+``synchronize``/``poll``, ``join``, ``barrier``), with the same defaults
+(average=True via op=Average, auto-assigned tensor names, prescale/postscale
+factors, compression).  In-place ``*_`` variants are provided as aliases:
+JAX arrays are immutable, so "in place" returns the new array; the reference
+semantics (result visible in the passed tensor) cannot exist under a
+functional substrate and callers use the return value.
+
+Two usage tiers (see ops/collectives.py for the tensor-semantics model):
+
+* **eager**: these functions — full async-handle parity, negotiated/fused
+  by the background engine.
+* **in-jit**: ``allreduce_p`` etc. (re-exported) for use inside compiled
+  shard_map programs — the performance path used by ``DistributedOptimizer``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from . import runtime
+from .compression import Compression
+from .exceptions import HorovodInternalError
+from .ops import collectives
+from .ops.engine import Handle, TensorTableEntry
+from .runtime import ReduceOp, _require_init
+
+
+def _engine():
+    return _require_init().engine
+
+
+def _ps(process_set):
+    if process_set is None:
+        return runtime._get_global_process_set()
+    if not process_set.initialized():
+        raise ValueError("process set is not initialized")
+    return process_set
+
+
+def _resolve_op(average: Optional[bool], op: Optional[str]) -> str:
+    # Reference: horovod/torch/mpi_ops.py handle_average_backwards_compatibility
+    if average is not None and op is not None:
+        raise ValueError("The average and op arguments cannot both be set; "
+                         "use op alone.")
+    if op is None:
+        return ReduceOp.AVERAGE if (average is None or average) \
+            else ReduceOp.SUM
+    return op
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    process_set=None) -> Handle:
+    """Asynchronous allreduce; returns a handle for ``synchronize``."""
+    eng = _engine()
+    ps = _ps(process_set)
+    rop = _resolve_op(average, op)
+    entry = TensorTableEntry(
+        name=name or eng.auto_name("allreduce"),
+        op_type="allreduce", arrays=[tensor], process_set=ps, reduce_op=rop,
+        prescale=None if prescale_factor == 1.0 else prescale_factor,
+        postscale=None if postscale_factor == 1.0 else postscale_factor)
+    return eng.submit(entry)
+
+
+def allreduce(tensor, average=None, name=None, compression=Compression.none,
+              op=None, prescale_factor=1.0, postscale_factor=1.0,
+              process_set=None):
+    """Blocking allreduce (reference default: average=True)."""
+    wire, ctx = compression.compress(tensor)
+    handle = allreduce_async(wire, average, name, op, prescale_factor,
+                             postscale_factor, process_set)
+    return compression.decompress(handle.synchronize(), ctx)
+
+
+def grouped_allreduce_async(tensors: Sequence, average=None, name=None,
+                            op=None, prescale_factor=1.0,
+                            postscale_factor=1.0, process_set=None) -> Handle:
+    """Grouped allreduce: the tensors fuse atomically (reference:
+    group_table.cc all-or-nothing semantics)."""
+    eng = _engine()
+    ps = _ps(process_set)
+    rop = _resolve_op(average, op)
+    entry = TensorTableEntry(
+        name=name or eng.auto_name("grouped_allreduce"),
+        op_type="allreduce", arrays=list(tensors), process_set=ps,
+        reduce_op=rop,
+        prescale=None if prescale_factor == 1.0 else prescale_factor,
+        postscale=None if postscale_factor == 1.0 else postscale_factor,
+        group_id=eng.next_group_id())
+    return eng.submit(entry)
+
+
+def grouped_allreduce(tensors: Sequence, average=None, name=None,
+                      compression=Compression.none, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=None) -> List:
+    wires, ctxs = [], []
+    for t in tensors:
+        w, c = compression.compress(t)
+        wires.append(w)
+        ctxs.append(c)
+    handle = grouped_allreduce_async(wires, average, name, op,
+                                     prescale_factor, postscale_factor,
+                                     process_set)
+    return [compression.decompress(r, c)
+            for r, c in zip(handle.synchronize(), ctxs)]
+
+
+# In-place aliases (JAX arrays are immutable; see module docstring).
+allreduce_ = allreduce
+allreduce_async_ = allreduce_async
+grouped_allreduce_ = grouped_allreduce
+grouped_allreduce_async_ = grouped_allreduce_async
+
+
+# ---------------------------------------------------------------------------
+# allgather
+# ---------------------------------------------------------------------------
+
+def allgather_async(tensor, name=None, process_set=None) -> Handle:
+    eng = _engine()
+    entry = TensorTableEntry(
+        name=name or eng.auto_name("allgather"), op_type="allgather",
+        arrays=[tensor], process_set=_ps(process_set))
+    return eng.submit(entry)
+
+
+def allgather(tensor, name=None, process_set=None):
+    """Concatenate every worker's tensor along dim 0 (reference contract)."""
+    return allgather_async(tensor, name, process_set).synchronize()
+
+
+def grouped_allgather_async(tensors: Sequence, name=None,
+                            process_set=None) -> Handle:
+    eng = _engine()
+    entry = TensorTableEntry(
+        name=name or eng.auto_name("grouped_allgather"), op_type="allgather",
+        arrays=list(tensors), process_set=_ps(process_set),
+        group_id=eng.next_group_id())
+    return eng.submit(entry)
+
+
+def grouped_allgather(tensors: Sequence, name=None, process_set=None) -> List:
+    return grouped_allgather_async(tensors, name, process_set).synchronize()
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+# ---------------------------------------------------------------------------
+
+def broadcast_async(tensor, root_rank: int, name=None,
+                    process_set=None) -> Handle:
+    eng = _engine()
+    entry = TensorTableEntry(
+        name=name or eng.auto_name("broadcast"), op_type="broadcast",
+        arrays=[tensor], process_set=_ps(process_set), root_rank=root_rank)
+    return eng.submit(entry)
+
+
+def broadcast(tensor, root_rank: int, name=None, process_set=None):
+    """Broadcast worker ``root_rank``'s value to all workers."""
+    return broadcast_async(tensor, root_rank, name, process_set).synchronize()
+
+
+broadcast_ = broadcast
+broadcast_async_ = broadcast_async
+
+
+def broadcast_object(obj, root_rank: int = 0, name=None, process_set=None):
+    """Serialize and broadcast an arbitrary Python object from root.
+
+    Reference: ``horovod/torch/mpi_ops.py`` broadcast_object (pickle → byte
+    tensor → bcast size → bcast payload).  Single-controller SPMD holds one
+    copy of ``obj`` per process; cross-process broadcast distributes from
+    the root *process*.
+    """
+    import pickle
+    _require_init()
+    if runtime.cross_size() == 1:
+        return obj  # one process holds the only copy already
+    from .utils import multihost_broadcast_bytes
+    payload = pickle.dumps(obj) if runtime.cross_rank() == (
+        root_rank // runtime.local_size()) else None
+    data = multihost_broadcast_bytes(
+        payload, root_process=root_rank // runtime.local_size())
+    return pickle.loads(data)
+
+
+# ---------------------------------------------------------------------------
+# alltoall
+# ---------------------------------------------------------------------------
+
+def alltoall_async(tensor, splits=None, name=None, process_set=None) -> Handle:
+    eng = _engine()
+    ps = _ps(process_set)
+    if splits is not None and len(splits) != ps.size():
+        # cheap validation at submission (the reference validates splits in
+        # the binding before enqueue)
+        raise ValueError(
+            f"splits must have one entry per worker ({ps.size()}), got "
+            f"{len(splits)}")
+    entry = TensorTableEntry(
+        name=name or eng.auto_name("alltoall"), op_type="alltoall",
+        arrays=[tensor], process_set=ps, splits=splits)
+    return eng.submit(entry)
+
+
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    """Distribute slices of ``tensor`` to every worker (MPI_Alltoallv)."""
+    return alltoall_async(tensor, splits, name, process_set).synchronize()
+
+
+# ---------------------------------------------------------------------------
+# reducescatter
+# ---------------------------------------------------------------------------
+
+def reducescatter_async(tensor, op=None, name=None,
+                        process_set=None) -> Handle:
+    eng = _engine()
+    rop = _resolve_op(None, op)
+    if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"reducescatter supports Sum and Average, got {rop}")
+    entry = TensorTableEntry(
+        name=name or eng.auto_name("reducescatter"), op_type="reducescatter",
+        arrays=[tensor], process_set=_ps(process_set), reduce_op=rop)
+    return eng.submit(entry)
+
+
+def reducescatter(tensor, op=None, name=None, process_set=None):
+    return reducescatter_async(tensor, op, name, process_set).synchronize()
+
+
+def grouped_reducescatter(tensors: Sequence, op=None, name=None,
+                          process_set=None) -> List:
+    return [reducescatter(t, op, f"{name}.{i}" if name else None, process_set)
+            for i, t in enumerate(tensors)]
+
+
+# ---------------------------------------------------------------------------
+# handle management / sync primitives
+# ---------------------------------------------------------------------------
+
+def synchronize(handle: Handle):
+    """Block until an async handle's result is ready (reference:
+    hvd.synchronize)."""
+    return handle.synchronize()
+
+
+def poll(handle: Handle) -> bool:
+    """Non-blocking completion test (reference: hvd.poll)."""
+    return handle.poll()
+
+
+def wait(handle: Handle, timeout: Optional[float] = None) -> bool:
+    return handle.wait(timeout)
+
+
+def barrier(process_set=None):
+    """Block until every participant reaches the barrier.
+
+    Reference: hvd.barrier (BarrierOp).  Within a process collectives are
+    ordered by the engine; across processes the coordination-service
+    barrier is used.
+    """
+    _require_init()
+    if runtime.cross_size() > 1:
+        from .utils import multihost_barrier
+        multihost_barrier("hvd_barrier")
+
+
+_joined = False
+
+
+def join(device: int = -1) -> int:
+    """Signal that this worker has no more tensors to reduce this epoch.
+
+    Reference: hvd.join (JoinOp) — lets ranks with uneven batch counts
+    finish: remaining allreduces see zero contributions from joined ranks.
+    Under a single controller all chips run one program, so uneven
+    *per-chip* input cannot arise; ``join`` degenerates to a cross-process
+    barrier and returns the last joining worker's rank, preserving the
+    reference's return contract.
+    """
+    _require_init()
+    barrier()
+    return runtime.size() - 1
+
+
+# in-jit traceable forms, re-exported for shard_map users
+allreduce_p = collectives.allreduce_p
+allgather_p = collectives.allgather_p
+broadcast_p = collectives.broadcast_p
+alltoall_p = collectives.alltoall_p
+reducescatter_p = collectives.reducescatter_p
+stack_on_workers = collectives.stack_on_workers
+worker_values = collectives.worker_values

@@ -1,0 +1,140 @@
+"""Online autotuning of runtime parameters.
+
+Reference parity: ``horovod/common/parameter_manager.cc`` (SURVEY.md §2.1) —
+the reference runs Bayesian optimization (Gaussian-process surrogate) over
+fusion-threshold and cycle-time, scoring candidates by observed throughput,
+with warmup → sampling → tuned phases, logging to ``HOROVOD_AUTOTUNE_LOG``.
+
+TPU redesign: the parameters that matter here are the fusion threshold
+(bucket size of the flatten-concat-psum) and the cycle time.  The search is
+a Gaussian-process expected-improvement loop over log2(threshold), same
+phases and logging as the reference, implemented with numpy (the reference
+vendored Eigen+LBFGS for the same job).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("horovod_tpu")
+
+_MIB = 1024 * 1024
+# candidate grid: log2 bucket bytes from 1 MiB to 512 MiB
+_GRID = [float(e) for e in range(20, 30)]
+
+
+class _GP:
+    """Tiny Gaussian process (RBF kernel) for 1-D expected improvement."""
+
+    def __init__(self, length_scale: float = 1.5, noise: float = 1e-2):
+        self.ls = length_scale
+        self.noise = noise
+        self.xs: List[float] = []
+        self.ys: List[float] = []
+
+    def add(self, x: float, y: float):
+        self.xs.append(x)
+        self.ys.append(y)
+
+    def _k(self, a, b):
+        a = np.asarray(a)[:, None]
+        b = np.asarray(b)[None, :]
+        return np.exp(-0.5 * ((a - b) / self.ls) ** 2)
+
+    def posterior(self, xq) -> Tuple[np.ndarray, np.ndarray]:
+        X = np.asarray(self.xs)
+        y = np.asarray(self.ys)
+        mu0 = y.mean() if len(y) else 0.0
+        K = self._k(X, X) + self.noise * np.eye(len(X))
+        Ks = self._k(xq, X)
+        sol = np.linalg.solve(K, y - mu0)
+        mu = Ks @ sol + mu0
+        v = 1.0 + self.noise - np.sum(Ks * np.linalg.solve(K, Ks.T).T, axis=1)
+        return mu, np.sqrt(np.maximum(v, 1e-12))
+
+    def suggest(self) -> float:
+        if not self.xs:
+            return _GRID[len(_GRID) // 2]
+        mu, sd = self.posterior(_GRID)
+        best = max(self.ys)
+        z = (mu - best) / sd
+        ei = sd * (z * _ndtr(z) + _npdf(z))
+        return _GRID[int(np.argmax(ei))]
+
+
+def _ndtr(z):
+    return 0.5 * (1 + np.vectorize(math.erf)(z / math.sqrt(2)))
+
+
+def _npdf(z):
+    return np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+
+
+class ParameterManager:
+    """Warmup → sample → tuned lifecycle, scoring by bytes/sec throughput."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.warmup_remaining = cfg.autotune_warmup_samples
+        self.steps_per_sample = cfg.autotune_steps_per_sample
+        self._gp = _GP()
+        self._current_exp = math.log2(cfg.fusion_threshold_bytes)
+        self._sample_bytes = 0
+        self._sample_time = 0.0
+        self._sample_steps = 0
+        self._tuned = False
+        self._best: Optional[Tuple[float, float]] = None
+        self._log_file = open(cfg.autotune_log, "w") if cfg.autotune_log \
+            else None
+        if self._log_file:
+            self._log_file.write(
+                "timestamp,fusion_threshold_bytes,score_bytes_per_sec,phase\n")
+
+    def current_fusion_threshold(self) -> int:
+        return int(2 ** self._current_exp)
+
+    @property
+    def tuned(self) -> bool:
+        return self._tuned
+
+    def record_cycle(self, nbytes: int, elapsed_s: float):
+        if self._tuned:
+            return
+        self._sample_bytes += nbytes
+        self._sample_time += elapsed_s
+        self._sample_steps += 1
+        if self._sample_steps < self.steps_per_sample:
+            return
+        score = self._sample_bytes / max(self._sample_time, 1e-9)
+        phase = "warmup" if self.warmup_remaining > 0 else "sample"
+        if self.warmup_remaining > 0:
+            self.warmup_remaining -= 1
+        else:
+            self._gp.add(self._current_exp, score)
+            if self._best is None or score > self._best[1]:
+                self._best = (self._current_exp, score)
+            if len(self._gp.xs) >= len(_GRID):
+                # converge: lock in the best observed point
+                self._current_exp = self._best[0]
+                self._tuned = True
+                phase = "tuned"
+                logger.info(
+                    "autotune converged: fusion_threshold=%d bytes "
+                    "(%.1f MiB), score=%.3g B/s",
+                    self.current_fusion_threshold(),
+                    self.current_fusion_threshold() / _MIB, self._best[1])
+            else:
+                self._current_exp = self._gp.suggest()
+        if self._log_file:
+            self._log_file.write(
+                f"{time.time():.3f},{self.current_fusion_threshold()},"
+                f"{score:.6g},{phase}\n")
+            self._log_file.flush()
+        self._sample_bytes = 0
+        self._sample_time = 0.0
+        self._sample_steps = 0

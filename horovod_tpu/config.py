@@ -1,0 +1,149 @@
+"""Typed runtime configuration with Horovod-compatible environment variables.
+
+The reference configures its runtime exclusively through ``HOROVOD_*``
+environment variables parsed in ``horovod/common/utils/env_parser.cc`` and
+``horovod/common/operations.cc`` (see SURVEY.md §5.6).  We honor the same
+names so scripts written against the reference keep working, and add a typed
+``Config`` object as the single source of truth inside the process.
+
+Only variables that are meaningful on TPU are interpreted; GPU-specific knobs
+(``HOROVOD_NUM_NCCL_STREAMS`` etc.) are accepted and recorded but unused.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {raw!r}")
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {raw!r}")
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_str(name: str, default: Optional[str]) -> Optional[str]:
+    raw = os.environ.get(name)
+    return default if raw in (None, "") else raw
+
+
+@dataclasses.dataclass
+class Config:
+    """Runtime configuration snapshot (taken once at ``hvd.init()``)."""
+
+    # --- fusion (reference: fusion_buffer_manager.cc, default 64 MiB) ---
+    fusion_threshold_bytes: int = 64 * 1024 * 1024
+    # --- coordination cycle (reference: HOROVOD_CYCLE_TIME, ms) ---
+    cycle_time_ms: float = 1.0
+    # --- response cache (reference: response_cache.cc) ---
+    cache_capacity: int = 1024
+    # --- timeline (reference: timeline.cc) ---
+    timeline_path: Optional[str] = None
+    timeline_mark_cycles: bool = False
+    # --- stall inspector (reference: stall_inspector.cc; seconds) ---
+    stall_check_time: float = 60.0
+    stall_shutdown_time: float = 0.0  # 0 = never abort
+    stall_check_disable: bool = False
+    # --- autotune (reference: parameter_manager.cc) ---
+    autotune: bool = False
+    autotune_log: Optional[str] = None
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    # --- logging ---
+    log_level: str = "warning"
+    log_timestamp: bool = False
+    # --- elastic ---
+    elastic: bool = False
+    # --- launcher-provided topology (reference: §3.4 env contract) ---
+    rank: Optional[int] = None
+    size: Optional[int] = None
+    local_rank: Optional[int] = None
+    local_size: Optional[int] = None
+    cross_rank: Optional[int] = None
+    cross_size: Optional[int] = None
+    hostname: Optional[str] = None
+    # rendezvous / coordination service (jax.distributed coordinator)
+    rendezvous_addr: Optional[str] = None
+    rendezvous_port: Optional[int] = None
+    controller: Optional[str] = None
+    # --- TPU-specific additions ---
+    # mesh axis name used for the data-parallel worker axis
+    worker_axis: str = "workers"
+    # use the native C++ core (_hvd_core) when available
+    use_native_core: bool = True
+    # operations forced on/off
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+
+    @staticmethod
+    def from_env() -> "Config":
+        c = Config()
+        c.fusion_threshold_bytes = _env_int(
+            "HOROVOD_FUSION_THRESHOLD", c.fusion_threshold_bytes)
+        c.cycle_time_ms = _env_float("HOROVOD_CYCLE_TIME", c.cycle_time_ms)
+        c.cache_capacity = _env_int("HOROVOD_CACHE_CAPACITY", c.cache_capacity)
+        c.timeline_path = _env_str("HOROVOD_TIMELINE", c.timeline_path)
+        c.timeline_mark_cycles = _env_bool(
+            "HOROVOD_TIMELINE_MARK_CYCLES", c.timeline_mark_cycles)
+        c.stall_check_time = _env_float(
+            "HOROVOD_STALL_CHECK_TIME_SECONDS", c.stall_check_time)
+        c.stall_shutdown_time = _env_float(
+            "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", c.stall_shutdown_time)
+        c.stall_check_disable = _env_bool(
+            "HOROVOD_STALL_CHECK_DISABLE", c.stall_check_disable)
+        c.autotune = _env_bool("HOROVOD_AUTOTUNE", c.autotune)
+        c.autotune_log = _env_str("HOROVOD_AUTOTUNE_LOG", c.autotune_log)
+        c.autotune_warmup_samples = _env_int(
+            "HOROVOD_AUTOTUNE_WARMUP_SAMPLES", c.autotune_warmup_samples)
+        c.autotune_steps_per_sample = _env_int(
+            "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", c.autotune_steps_per_sample)
+        c.log_level = _env_str("HOROVOD_LOG_LEVEL", c.log_level) or "warning"
+        c.log_timestamp = _env_bool("HOROVOD_LOG_TIMESTAMP", c.log_timestamp)
+        c.elastic = _env_bool("HOROVOD_ELASTIC", c.elastic)
+        c.rank = _env_int("HOROVOD_RANK", -1)
+        c.rank = None if c.rank < 0 else c.rank
+        c.size = _env_int("HOROVOD_SIZE", -1)
+        c.size = None if c.size < 0 else c.size
+        c.local_rank = _env_int("HOROVOD_LOCAL_RANK", -1)
+        c.local_rank = None if c.local_rank < 0 else c.local_rank
+        c.local_size = _env_int("HOROVOD_LOCAL_SIZE", -1)
+        c.local_size = None if c.local_size < 0 else c.local_size
+        c.cross_rank = _env_int("HOROVOD_CROSS_RANK", -1)
+        c.cross_rank = None if c.cross_rank < 0 else c.cross_rank
+        c.cross_size = _env_int("HOROVOD_CROSS_SIZE", -1)
+        c.cross_size = None if c.cross_size < 0 else c.cross_size
+        c.hostname = _env_str("HOROVOD_HOSTNAME", c.hostname)
+        c.rendezvous_addr = _env_str(
+            "HOROVOD_GLOO_RENDEZVOUS_ADDR", c.rendezvous_addr)
+        port = _env_int("HOROVOD_GLOO_RENDEZVOUS_PORT", -1)
+        c.rendezvous_port = None if port < 0 else port
+        c.controller = _env_str("HOROVOD_CONTROLLER", c.controller)
+        c.use_native_core = _env_bool(
+            "HOROVOD_TPU_NATIVE_CORE", c.use_native_core)
+        c.hierarchical_allreduce = _env_bool(
+            "HOROVOD_HIERARCHICAL_ALLREDUCE", c.hierarchical_allreduce)
+        c.hierarchical_allgather = _env_bool(
+            "HOROVOD_HIERARCHICAL_ALLGATHER", c.hierarchical_allgather)
+        return c

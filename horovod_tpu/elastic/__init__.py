@@ -1,0 +1,16 @@
+"""Elastic training: fault-tolerant runs over dynamic worker membership.
+
+Reference parity: ``horovod/common/elastic.py`` + ``horovod/torch/elastic/``
++ ``horovod/runner/elastic/`` (SURVEY.md §2.2/§3.5/§5.3).  The capability:
+wrap the training loop with ``@hvd.elastic.run``; commit state snapshots
+periodically; on a collective failure (``HorovodInternalError``, e.g. TPU
+slice preemption) restore the last commit and re-initialize; on a
+membership change (``HostsUpdatedInterrupt`` from the discovery driver)
+re-sync state from the new coordinator and continue.
+"""
+
+from .state import (  # noqa: F401
+    State, ObjectState, ArrayState, TpuState,
+)
+from .runner import run  # noqa: F401
+from .sampler import ElasticSampler  # noqa: F401

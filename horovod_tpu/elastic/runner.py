@@ -1,0 +1,92 @@
+"""The elastic run-loop decorator.
+
+Reference parity: ``horovod/common/elastic.py`` ``run_fn`` (SURVEY.md §3.5):
+
+    @hvd.elastic.run
+    def train(state, ...): ...
+
+The wrapper catches ``HorovodInternalError`` (collective failure — on TPU:
+slice preemption / ICI timeout) → ``state.restore()`` + re-init, and
+``HostsUpdatedInterrupt`` (discovery delta) → ``state.sync()``, then
+re-enters the function.  ``reset_limit`` bounds consecutive resets.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
+
+logger = logging.getLogger("horovod_tpu")
+
+
+def run(func=None, *, reset_limit: int = None):
+    if func is None:
+        return functools.partial(run, reset_limit=reset_limit)
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        from .. import runtime
+        notification_manager = _get_notification_manager()
+        if notification_manager is not None:
+            notification_manager.register_listener(state)
+        reset_count = 0
+        try:
+            while True:
+                if reset_count > 0:
+                    state.on_reset()
+                try:
+                    return func(state, *args, **kwargs)
+                except HorovodInternalError:
+                    logger.warning(
+                        "collective failure; restoring last committed state "
+                        "and re-initializing")
+                    _reinitialize()
+                    state.restore()
+                    _sync_after_reset(state, skip_sync=False)
+                except HostsUpdatedInterrupt as e:
+                    logger.info("hosts updated; syncing state")
+                    _reinitialize()
+                    _sync_after_reset(state, skip_sync=e.skip_sync)
+                reset_count += 1
+                if reset_limit is not None and reset_count > reset_limit:
+                    raise RuntimeError(
+                        f"exceeded elastic reset limit ({reset_limit})")
+        finally:
+            if notification_manager is not None:
+                notification_manager.remove_listener(state)
+
+    return wrapper
+
+
+def _reinitialize():
+    """Tear down and re-init the runtime so the mesh reflects the new
+    membership (reference: shutdown + init with HOROVOD_ELASTIC reset)."""
+    from .. import runtime
+    runtime.shutdown()
+    runtime.init()
+
+
+def _sync_after_reset(state, skip_sync: bool):
+    if not skip_sync:
+        state.sync()
+
+
+_notification_manager = None
+
+
+def _get_notification_manager():
+    return _notification_manager
+
+
+def init_notification_manager(manager):
+    """Install the worker-side notification listener (reference:
+    horovod/runner/elastic/worker.py WorkerNotificationManager)."""
+    global _notification_manager
+    _notification_manager = manager
+
+
+def shutdown_notification_manager():
+    global _notification_manager
+    _notification_manager = None

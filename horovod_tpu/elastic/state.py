@@ -1,0 +1,183 @@
+"""Elastic state objects: commit / restore / sync.
+
+Reference parity: ``horovod/common/elastic.py`` (``State``, ``ObjectState``)
+and ``horovod/torch/elastic/state.py`` (``TorchState``) — SURVEY.md §5.4.
+``commit()`` is an *in-memory* snapshot (cheap, per-batch); ``restore()``
+rolls back to it after a failure; ``sync()`` broadcasts state from the new
+coordinator after membership changes.  Durable checkpoints remain the
+caller's job (orbax on TPU), same posture as the reference.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+
+class State:
+    """Base elastic state (reference: common/elastic.py State)."""
+
+    def __init__(self, **kwargs):
+        self._host_messages: List = []
+        self._reset_callbacks: List[Callable] = []
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def register_reset_callbacks(self, callbacks: List[Callable]):
+        """Callbacks invoked after a reset (e.g. rebuild data loaders)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        self._host_messages.clear()
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def on_hosts_updated(self, timestamp, update_res):
+        self._host_messages.append((timestamp, update_res))
+
+    def process_incoming_updates(self):
+        """Raise HostsUpdatedInterrupt if the driver flagged a change."""
+        from ..exceptions import HostsUpdatedInterrupt
+        if self._host_messages:
+            msgs = self._host_messages
+            self._host_messages = []
+            # skip sync only if every update was a pure addition
+            skip = all(res == 1 for _, res in msgs)
+            raise HostsUpdatedInterrupt(skip_sync=skip)
+
+    # subclass interface ----------------------------------------------------
+    def commit(self):
+        """Snapshot state in memory AND check for membership updates."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        self.process_incoming_updates()
+
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class ObjectState(State):
+    """Elastic state for picklable Python attributes.
+
+    Reference: ``horovod/common/elastic.py`` ObjectState — ``sync()``
+    broadcasts the pickled attribute dict from the coordinator.
+    """
+
+    def __init__(self, bcast_object=None, get_rank=None, **kwargs):
+        from .. import api, runtime
+        self._bcast_object = bcast_object or api.broadcast_object
+        self._get_rank = get_rank or runtime.rank
+        self._saved_state: Dict[str, Any] = {}
+        super().__init__(**kwargs)
+        self._attrs = list(kwargs.keys())
+        self.save()
+
+    def save(self):
+        self._saved_state = {
+            k: copy.deepcopy(getattr(self, k)) for k in self._attrs}
+
+    def restore(self):
+        for k, v in self._saved_state.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self):
+        if self._saved_state:
+            synced = self._bcast_object(self._saved_state, root_rank=0)
+            for k, v in synced.items():
+                setattr(self, k, v)
+            self.save()
+
+
+class ArrayState(State):
+    """Elastic state for JAX pytrees (params / optimizer state / step).
+
+    The TPU-native analog of the reference's ``TorchState`` (model +
+    optimizer + sampler): holds named pytrees of arrays; ``commit``
+    device-copies them (cheap snapshot in HBM), ``restore`` re-installs,
+    ``sync`` broadcasts from worker 0 after a membership change.
+    """
+
+    def __init__(self, **trees):
+        self._trees: Dict[str, Any] = {}
+        self._saved: Dict[str, Any] = {}
+        self._scalar_state = {}
+        super().__init__()
+        for name, tree in trees.items():
+            if hasattr(tree, "dtype") or isinstance(
+                    tree, (dict, list, tuple)) or _is_pytree(tree):
+                self._trees[name] = tree
+            else:
+                self._scalar_state[name] = tree
+        self.save()
+
+    def __getattr__(self, name):
+        trees = object.__getattribute__(self, "_trees")
+        if name in trees:
+            return trees[name]
+        scalars = object.__getattribute__(self, "_scalar_state")
+        if name in scalars:
+            return scalars[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_") or name in ("model",):
+            object.__setattr__(self, name, value)
+            return
+        if "_trees" in self.__dict__ and name in self._trees:
+            self._trees[name] = value
+        elif "_scalar_state" in self.__dict__ and \
+                name in self._scalar_state:
+            self._scalar_state[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def save(self):
+        # jnp copies are lazy/async; this snapshots values not references
+        self._saved = {
+            "trees": {k: jax.tree_util.tree_map(_copy_leaf, v)
+                      for k, v in self._trees.items()},
+            "scalars": copy.deepcopy(self._scalar_state),
+        }
+
+    def restore(self):
+        for k, v in self._saved.get("trees", {}).items():
+            self._trees[k] = jax.tree_util.tree_map(_copy_leaf, v)
+        self._scalar_state = copy.deepcopy(self._saved.get("scalars", {}))
+
+    def sync(self):
+        from .. import api
+        for k, tree in self._trees.items():
+            self._trees[k] = jax.tree_util.tree_map(
+                lambda p: api.broadcast(p, 0) if hasattr(p, "dtype") else p,
+                tree)
+        self._scalar_state = api.broadcast_object(self._scalar_state, 0)
+        self.save()
+
+
+# Alias matching "TorchState for TPU" naming users will look for.
+TpuState = ArrayState
+
+
+def _is_pytree(x) -> bool:
+    return len(jax.tree_util.tree_leaves(x)) > 0
+
+
+def _copy_leaf(x):
+    if hasattr(x, "dtype"):
+        import jax.numpy as jnp
+        return jnp.array(x)
+    return copy.deepcopy(x)

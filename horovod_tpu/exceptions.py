@@ -1,0 +1,48 @@
+"""Exception types mirroring the reference's error surface.
+
+Reference parity: ``HorovodInternalError`` is raised when a collective fails
+(reference: horovod/common/exceptions.py — surfaced from the C++ status in
+``horovod/common/operations.cc``); ``HostsUpdatedInterrupt`` is raised when the
+elastic driver discovers a membership change (reference:
+horovod/runner/elastic/worker.py).  On TPU the analogous events are an
+ICI/DCN collective timeout / slice preemption (``HorovodInternalError``) and a
+slice-discovery delta (``HostsUpdatedInterrupt``).
+"""
+
+
+class HorovodTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class HorovodInternalError(HorovodTpuError):
+    """A collective operation failed (peer died, slice preempted, timeout).
+
+    Elastic training catches this, restores state from the last commit and
+    re-initializes the communication layer (see ``horovod_tpu.elastic.run``).
+    """
+
+
+class HostsUpdatedInterrupt(HorovodTpuError):
+    """The elastic driver discovered a host/slice membership change.
+
+    Carries ``skip_sync``: when True the worker set only grew, so current
+    state is still consistent and ``state.sync()`` may be skipped.
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__("hosts updated")
+        self.skip_sync = skip_sync
+
+
+class NotInitializedError(HorovodTpuError):
+    """An API requiring ``hvd.init()`` was called before initialization."""
+
+    def __init__(self, name: str = "this function"):
+        super().__init__(
+            f"horovod_tpu has not been initialized; call hvd.init() before "
+            f"using {name}."
+        )
+
+
+class StallError(HorovodTpuError):
+    """Raised when the stall inspector's shutdown deadline is exceeded."""

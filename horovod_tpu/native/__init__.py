@@ -1,0 +1,5 @@
+"""Native C++ core (_hvd_core): controller, fusion planner, response cache,
+timeline writer — reference parity for the C++ components in SURVEY.md §2.1.
+Built as a CPython extension; ``loader.load()`` returns None when unbuilt
+and pure-Python implementations take over.
+"""

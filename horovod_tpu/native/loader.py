@@ -1,0 +1,31 @@
+"""Loader for the native core extension with graceful fallback."""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger("horovod_tpu")
+
+_core = None
+_attempted = False
+
+
+def load():
+    """Import ``_hvd_core`` if built; returns the module or None."""
+    global _core, _attempted
+    if _attempted:
+        return _core
+    _attempted = True
+    try:
+        from . import _hvd_core  # type: ignore
+        _core = _hvd_core
+        logger.info("native core loaded: %s", _hvd_core.__file__)
+    except ImportError:
+        _core = None
+    return _core
+
+
+def reset():
+    global _core, _attempted
+    _core = None
+    _attempted = False

@@ -1,0 +1,3 @@
+"""Collective operations: eager engine, compiled kernels, fusion planner."""
+
+from . import collectives, engine, fusion  # noqa: F401

@@ -1,0 +1,188 @@
+"""Fusion planner and response cache (Python implementation).
+
+Reference parity: rebuilds the *planning* side of
+``horovod/common/fusion_buffer_manager.cc`` (tensor fusion up to
+``HOROVOD_FUSION_THRESHOLD`` bytes), ``horovod/common/controller.cc``'s
+``FuseResponses`` (same dtype/device/op → one fused response) and
+``horovod/common/response_cache.cc`` (steady-state negotiation skip) — see
+SURVEY.md §2.1.  The *execution* side (pack → one collective → unpack) is a
+single XLA program built in ``collectives.py``; this module only decides the
+deterministic bucketing.
+
+A native C++ implementation of the same planner lives in
+``horovod_tpu/native`` (``_hvd_core``); when built it replaces the pure-
+Python path (same tests cover both).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_DTYPE_BYTES = {
+    "float32": 4, "float64": 8, "float16": 2, "bfloat16": 2,
+    "int8": 1, "uint8": 1, "int16": 2, "uint16": 2,
+    "int32": 4, "uint32": 4, "int64": 8, "uint64": 8, "bool": 1,
+}
+
+
+def dtype_nbytes(dtype: str) -> int:
+    return _DTYPE_BYTES.get(str(dtype), 4)
+
+
+@dataclasses.dataclass(frozen=True)
+class EntrySig:
+    """Signature of one pending collective (the negotiation Request).
+
+    Reference: ``horovod/common/message.cc`` Request — (rank, name, dtype,
+    shape, op type).  ``group_id`` carries the reference's GroupTable
+    semantics: entries sharing a group fuse atomically.
+    """
+    name: str
+    op_type: str          # allreduce | allgather | broadcast | alltoall | ...
+    reduce_op: str        # sum | average | ...
+    dtype: str
+    shape: Tuple[int, ...]
+    process_set_id: int
+    stacked: bool
+    group_id: int = -1    # -1 = ungrouped
+    # scale factors participate in fusion compatibility: entries with
+    # different prescale/postscale must not share one fused collective
+    prescale: Optional[float] = None
+    postscale: Optional[float] = None
+
+    @property
+    def nbytes(self) -> int:
+        numel = 1
+        for d in self.shape:
+            numel *= d
+        return numel * dtype_nbytes(self.dtype)
+
+    def bucket_key(self) -> Tuple:
+        """Entries sharing this key may fuse into one collective."""
+        return (self.op_type, self.reduce_op, self.dtype,
+                self.process_set_id, self.stacked,
+                1.0 if self.prescale is None else self.prescale,
+                1.0 if self.postscale is None else self.postscale)
+
+
+def plan_fusion(entries: Sequence[EntrySig],
+                threshold_bytes: int) -> List[List[int]]:
+    """Deterministically bucket entries for fused dispatch.
+
+    Returns a list of buckets, each a list of indices into ``entries``.
+    Ordering rule: entries are processed in sorted (bucket_key, name) order —
+    the same total order on every process, which is the property the
+    reference's coordinator-negotiation protocol exists to guarantee
+    (controller.cc ComputeResponseList): all ranks must execute the same
+    collectives in the same order each cycle.
+
+    Grouped entries (same ``group_id``) always land in one bucket regardless
+    of the threshold (reference: group_table.cc all-or-nothing fusion).
+    Only allreduce fuses; other op types dispatch one bucket per entry.
+    """
+    order = sorted(range(len(entries)),
+                   key=lambda i: (entries[i].bucket_key(), entries[i].name,
+                                  i))
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_key: Optional[Tuple] = None
+    cur_bytes = 0
+    cur_group = -1
+
+    def flush():
+        nonlocal cur, cur_bytes
+        if cur:
+            buckets.append(cur)
+        cur, cur_bytes = [], 0
+
+    for i in order:
+        e = entries[i]
+        fusable = e.op_type == "allreduce"
+        key = e.bucket_key()
+        if not fusable:
+            flush()
+            buckets.append([i])
+            cur_key = None
+            continue
+        same_group = e.group_id != -1 and e.group_id == cur_group and cur
+        if (key != cur_key
+                or (cur_bytes + e.nbytes > threshold_bytes and not same_group
+                    and cur)):
+            flush()
+            cur_key = key
+        cur.append(i)
+        cur_bytes += e.nbytes
+        cur_group = e.group_id
+    flush()
+    return buckets
+
+
+class ResponseCache:
+    """LRU cache of fusion plans keyed by the cycle's entry signatures.
+
+    Reference: ``horovod/common/response_cache.cc`` — in steady state the
+    same tensors arrive every cycle, so ranks skip full negotiation and
+    exchange only a cache-hit bit vector.  Here the cached value is the
+    fusion plan; a hit skips the planner (and, multi-process, the
+    name-exchange round in the engine).
+    """
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = capacity
+        self._cache: "OrderedDict[Tuple, List[List[int]]]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key(entries: Sequence[EntrySig]) -> Tuple:
+        return tuple(dataclasses.astuple(e) for e in entries)
+
+    def get(self, entries: Sequence[EntrySig]) -> Optional[List[List[int]]]:
+        if self.capacity <= 0:
+            return None
+        k = self.key(entries)
+        plan = self._cache.get(k)
+        if plan is not None:
+            self._cache.move_to_end(k)
+            self.hits += 1
+            return plan
+        self.misses += 1
+        return None
+
+    def put(self, entries: Sequence[EntrySig], plan: List[List[int]]):
+        if self.capacity <= 0:
+            return
+        k = self.key(entries)
+        self._cache[k] = plan
+        self._cache.move_to_end(k)
+        while len(self._cache) > self.capacity:
+            self._cache.popitem(last=False)
+
+    def clear(self):
+        self._cache.clear()
+
+    def stats(self) -> Dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses,
+                "entries": len(self._cache)}
+
+
+def get_planner(cfg):
+    """Return (plan_fn, cache): native ``_hvd_core`` when built, else Python.
+
+    The native planner implements the identical algorithm in C++
+    (horovod_tpu/native/core.cpp) — parity-checked in
+    tests/test_native_core.py.
+    """
+    if cfg is not None and cfg.use_native_core:
+        try:
+            from ..native import loader
+            core = loader.load()
+            if core is not None:
+                return core.plan_fusion_sigs, ResponseCache(
+                    cfg.cache_capacity)
+        except Exception:  # noqa: BLE001 - fall back to Python planner
+            pass
+    cap = cfg.cache_capacity if cfg is not None else 1024
+    return plan_fusion, ResponseCache(cap)

@@ -1,0 +1,8 @@
+"""Distributed optimizer wrappers (reference: horovod/torch/optimizer.py,
+horovod/tensorflow/__init__.py DistributedOptimizer/DistributedGradientTape).
+"""
+
+from .distributed import (  # noqa: F401
+    DistributedOptimizer, DistributedGradientTransform, fused_reduce_tree,
+    broadcast_parameters, broadcast_optimizer_state,
+)

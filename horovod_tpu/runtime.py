@@ -1,0 +1,516 @@
+"""Core runtime: initialization, topology, process sets, global state.
+
+Reference parity: this module rebuilds the capability surface of
+``horovod/common/operations.cc`` (init/shutdown/rank/size C exports),
+``horovod/common/global_state.h`` (HorovodGlobalState) and
+``horovod/common/process_set.cc`` (ProcessSet / ProcessSetTable) — see
+SURVEY.md §2.1/§3.1 — redesigned for the TPU SPMD model:
+
+* The reference runs **one process per accelerator**; rank == process.  On
+  TPU one Python process drives many chips through XLA, so we map Horovod's
+  "worker" onto a **chip**: ``size()`` is the number of chips participating
+  in collectives (``jax.device_count()``), ``local_size()`` the chips owned
+  by this process.  ``rank()`` is the global index of this process's lead
+  chip, which preserves the two idioms user scripts rely on:
+  ``hvd.rank() == 0`` gates checkpointing exactly on the coordinator
+  process, and rank-dependent data sharding maps to per-chip shards.
+* The reference's MPI/Gloo rendezvous becomes ``jax.distributed.initialize``
+  against the coordination service (over DCN); the background negotiation
+  thread lives in ``horovod_tpu.ops.engine``.
+* Process sets (subsets of workers with their own communicators) become
+  sub-``Mesh``es over device subsets; XLA emits collectives only over the
+  sub-mesh's ICI/DCN links.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .config import Config
+from .exceptions import NotInitializedError
+
+logger = logging.getLogger("horovod_tpu")
+
+# Reduction op enums, mirroring the reference's hvd.Sum/Average/Adasum/Min/Max
+# (horovod/common/common.h ReduceOp + horovod/torch/mpi_ops.py).
+class ReduceOp:
+    AVERAGE = "average"
+    SUM = "sum"
+    ADASUM = "adasum"
+    MIN = "min"
+    MAX = "max"
+    PRODUCT = "product"
+    BAND = "band"
+    BOR = "bor"
+    BXOR = "bxor"
+
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+
+class ProcessSet:
+    """A subset of workers (chips) with its own communicator (sub-mesh).
+
+    Reference parity: ``horovod/common/process_set.cc`` — each ProcessSet had
+    its own controller + tensor queue over an MPI sub-communicator.  Here a
+    process set owns a 1-D ``jax.sharding.Mesh`` over the selected chips;
+    eager collectives over the set are compiled against that mesh, and the
+    engine keeps a separate pending-queue per set.
+
+    ``ranks`` are *global worker (chip) indices* into ``hvd.size()``.
+    """
+
+    def __init__(self, ranks: Optional[Sequence[int]] = None):
+        self.ranks: Optional[List[int]] = (
+            sorted(int(r) for r in ranks) if ranks is not None else None)
+        self.process_set_id: Optional[int] = None
+        self._mesh: Optional[jax.sharding.Mesh] = None
+        self._axis: str = "workers"
+
+    # -- queries -------------------------------------------------------------
+    def initialized(self) -> bool:
+        return self.process_set_id is not None
+
+    def size(self) -> int:
+        self._check()
+        return len(self.ranks)
+
+    def included(self) -> bool:
+        self._check()
+        return _state().lead_worker_rank in self.ranks
+
+    def rank(self) -> int:
+        """Rank of this process's lead chip within the set (-1 if excluded)."""
+        self._check()
+        lead = _state().lead_worker_rank
+        return self.ranks.index(lead) if lead in self.ranks else -1
+
+    @property
+    def mesh(self) -> jax.sharding.Mesh:
+        self._check()
+        return self._mesh
+
+    @property
+    def axis(self) -> str:
+        return self._axis
+
+    def _check(self):
+        if not self.initialized():
+            raise NotInitializedError("ProcessSet")
+
+    def _materialize(self, set_id: int, all_devices, axis: str):
+        self.process_set_id = set_id
+        self._axis = axis
+        if self.ranks is None:
+            self.ranks = list(range(len(all_devices)))
+        if any(r < 0 or r >= len(all_devices) for r in self.ranks):
+            raise ValueError(
+                f"process set ranks {self.ranks} out of range for "
+                f"{len(all_devices)} workers")
+        devs = np.array([all_devices[r] for r in self.ranks])
+        self._mesh = jax.sharding.Mesh(devs, (axis,))
+
+    def __repr__(self):
+        return (f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})")
+
+
+class ProcessSetTable:
+    """Registry of process sets (reference: ProcessSetTable, process_set.cc)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table: Dict[int, ProcessSet] = {}
+        self._next_id = 0
+
+    def register(self, ps: ProcessSet, all_devices, axis: str) -> int:
+        with self._lock:
+            # Duplicate rank-lists map to the existing set, as in the
+            # reference's AddProcessSet.
+            for existing in self._table.values():
+                if existing.ranks == (ps.ranks if ps.ranks is not None
+                                      else list(range(len(all_devices)))):
+                    raise ValueError(
+                        f"A process set with ranks {existing.ranks} already "
+                        f"exists (id={existing.process_set_id})")
+            set_id = self._next_id
+            self._next_id += 1
+            ps._materialize(set_id, all_devices, axis)
+            self._table[set_id] = ps
+            return set_id
+
+    def remove(self, set_id: int):
+        with self._lock:
+            if set_id == 0:
+                raise ValueError("cannot remove the global process set")
+            if set_id not in self._table:
+                raise ValueError(f"no process set with id {set_id}")
+            ps = self._table.pop(set_id)
+            ps.process_set_id = None
+
+    def get(self, set_id: int) -> ProcessSet:
+        with self._lock:
+            return self._table[set_id]
+
+    def ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._table)
+
+    def clear(self):
+        with self._lock:
+            for ps in self._table.values():
+                ps.process_set_id = None
+            self._table.clear()
+            self._next_id = 0
+
+
+class _RuntimeState:
+    """Singleton global state (reference: HorovodGlobalState, global_state.h)."""
+
+    def __init__(self):
+        self.initialized = False
+        self.config: Optional[Config] = None
+        self.devices: List = []
+        self.global_mesh: Optional[jax.sharding.Mesh] = None
+        self.process_set_table = ProcessSetTable()
+        self.global_process_set: Optional[ProcessSet] = None
+        self.lead_worker_rank: int = 0
+        self.engine = None          # ops.engine.CollectiveEngine
+        self.timeline = None        # timeline.Timeline
+        self.stall_inspector = None  # stall.StallInspector
+        self.autotuner = None       # autotune.ParameterManager
+        self.shutdown_hooks: List = []
+        self.owns_jax_distributed = False
+        self._init_lock = threading.Lock()
+
+
+_STATE = _RuntimeState()
+
+
+def _state() -> _RuntimeState:
+    return _STATE
+
+
+def _require_init() -> _RuntimeState:
+    if not _STATE.initialized:
+        raise NotInitializedError()
+    return _STATE
+
+
+def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
+    """Initialize the runtime (reference: horovod_init → InitializeHorovodOnce).
+
+    Resolves topology from the TPU slice / JAX runtime instead of
+    MPI_COMM_WORLD:
+
+    * Under the ``hvdrun`` launcher (or any launcher exporting the reference's
+      §3.4 env contract: HOROVOD_RANK/SIZE + rendezvous address), calls
+      ``jax.distributed.initialize`` so every process joins the coordination
+      service and sees the global device set.
+    * Stand-alone, uses whatever devices JAX exposes (single host).
+
+    ``comm`` is accepted for API compatibility (the reference takes an MPI
+    communicator); only ``None`` (world) is supported.
+    ``process_sets`` are additional process sets to create at init, as in the
+    reference's ``hvd.init(process_sets=...)``.
+    """
+    with _STATE._init_lock:
+        if _STATE.initialized:
+            return
+        if comm is not None:
+            raise ValueError(
+                "horovod_tpu.init(comm=...) with a custom communicator is not "
+                "supported on TPU; use process_sets for sub-groups.")
+        cfg = Config.from_env()
+        _STATE.config = cfg
+        _setup_logging(cfg)
+
+        # Multi-process rendezvous via the JAX coordination service (the
+        # TPU-native replacement for MPI/Gloo rendezvous, SURVEY.md §5.8).
+        if cfg.size is not None and cfg.size > 1 and cfg.rendezvous_addr:
+            coordinator = f"{cfg.rendezvous_addr}:{cfg.rendezvous_port or 9999}"
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=cfg.cross_size or cfg.size,
+                process_id=cfg.cross_rank
+                if cfg.cross_rank is not None else cfg.rank,
+            )
+            _STATE.owns_jax_distributed = True
+
+        _STATE.devices = list(jax.devices())
+        n = len(_STATE.devices)
+        _STATE.global_mesh = jax.sharding.Mesh(
+            np.array(_STATE.devices), (cfg.worker_axis,))
+        _STATE.lead_worker_rank = (
+            jax.process_index() * jax.local_device_count())
+
+        _STATE.process_set_table.clear()
+        global_ps = ProcessSet(None)
+        _STATE.process_set_table.register(
+            global_ps, _STATE.devices, cfg.worker_axis)
+        _STATE.global_process_set = global_ps
+        if process_sets:
+            for ps in process_sets:
+                _STATE.process_set_table.register(
+                    ps, _STATE.devices, cfg.worker_axis)
+
+        # Observability subsystems.
+        from .timeline import Timeline
+        from .stall import StallInspector
+        _STATE.timeline = Timeline(
+            cfg.timeline_path, mark_cycles=cfg.timeline_mark_cycles)
+        _STATE.stall_inspector = StallInspector(
+            check_time=cfg.stall_check_time,
+            shutdown_time=cfg.stall_shutdown_time,
+            disabled=cfg.stall_check_disable)
+
+        if cfg.autotune:
+            from .autotune import ParameterManager
+            _STATE.autotuner = ParameterManager(cfg)
+
+        # The background collective engine (reference: BackgroundThreadLoop).
+        from .ops.engine import CollectiveEngine
+        _STATE.engine = CollectiveEngine(
+            cfg, _STATE.global_mesh, _STATE.timeline,
+            _STATE.stall_inspector, _STATE.autotuner)
+        _STATE.engine.start()
+
+        _STATE.initialized = True
+        atexit.register(shutdown)
+        logger.info(
+            "horovod_tpu initialized: %d workers (%d local), process %d/%d",
+            n, jax.local_device_count(), jax.process_index(),
+            jax.process_count())
+
+
+def shutdown():
+    """Tear down the runtime (reference: horovod_shutdown)."""
+    with _STATE._init_lock:
+        if not _STATE.initialized:
+            return
+        try:
+            if _STATE.engine is not None:
+                _STATE.engine.stop()
+            if _STATE.timeline is not None:
+                _STATE.timeline.close()
+            for hook in _STATE.shutdown_hooks:
+                try:
+                    hook()
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    logger.exception("shutdown hook failed")
+        finally:
+            if _STATE.owns_jax_distributed:
+                # release the coordination-service connection so an elastic
+                # re-init can re-join the (possibly re-formed) cluster
+                try:
+                    jax.distributed.shutdown()
+                except Exception:  # noqa: BLE001 - peer may already be gone
+                    logger.warning("jax.distributed.shutdown failed",
+                                   exc_info=True)
+                _STATE.owns_jax_distributed = False
+            _STATE.initialized = False
+            _STATE.engine = None
+            _STATE.global_mesh = None
+            _STATE.global_process_set = None
+            _STATE.process_set_table.clear()
+
+
+def is_initialized() -> bool:
+    """Reference: horovod_is_initialized / hvd.is_initialized()."""
+    return _STATE.initialized
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False):
+    """Reference: hvd.start_timeline (horovod/common/basics.py)."""
+    st = _require_init()
+    st.timeline.reopen(file_path, mark_cycles=mark_cycles)
+
+
+def stop_timeline():
+    st = _require_init()
+    st.timeline.close()
+
+
+# --- topology accessors (reference: horovod/common/basics.py) ---------------
+
+def size() -> int:
+    """Total number of workers (chips) participating in collectives."""
+    _require_init()
+    return len(_STATE.devices)
+
+
+def rank() -> int:
+    """Global rank of this process's lead worker (chip).
+
+    ``rank() == 0`` is true exactly on the coordinator process, preserving
+    the reference's checkpoint-gating idiom.
+    """
+    _require_init()
+    return _STATE.lead_worker_rank
+
+
+def local_size() -> int:
+    """Number of workers (chips) driven by this process."""
+    _require_init()
+    return jax.local_device_count()
+
+
+def local_rank() -> int:
+    """Rank of the lead worker within this host (0 in SPMD: the process owns
+    all its local chips)."""
+    _require_init()
+    return 0
+
+
+def cross_size() -> int:
+    """Number of processes (hosts) — reference: ranks with my local_rank."""
+    _require_init()
+    return jax.process_count()
+
+
+def cross_rank() -> int:
+    """Index of this process among processes (hosts)."""
+    _require_init()
+    return jax.process_index()
+
+
+def process_count() -> int:
+    """TPU-native explicit name for ``jax.process_count()``."""
+    _require_init()
+    return jax.process_count()
+
+
+def process_index() -> int:
+    """TPU-native explicit name for ``jax.process_index()``."""
+    _require_init()
+    return jax.process_index()
+
+
+def is_homogeneous() -> bool:
+    """Reference: horovod_is_homogeneous — equal local sizes on all hosts.
+
+    TPU slices are homogeneous by construction.
+    """
+    _require_init()
+    return True
+
+
+def mesh() -> jax.sharding.Mesh:
+    """The global 1-D worker mesh (TPU-native addition)."""
+    _require_init()
+    return _STATE.global_mesh
+
+
+def worker_axis() -> str:
+    _require_init()
+    return _STATE.config.worker_axis
+
+
+# --- feature queries (reference: util.py check_extension / basics.py) -------
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    """All collectives compile to XLA on this framework."""
+    return True
+
+
+def tpu_built() -> bool:
+    return True
+
+
+# --- process set API (reference: horovod/common/process_sets.py) ------------
+
+global_process_set: Optional[ProcessSet] = None  # set lazily via __getattr__
+
+
+def _get_global_process_set() -> ProcessSet:
+    _require_init()
+    return _STATE.global_process_set
+
+
+def add_process_set(ps_or_ranks) -> ProcessSet:
+    """Create a new process set at runtime (reference: hvd.add_process_set)."""
+    st = _require_init()
+    ps = (ps_or_ranks if isinstance(ps_or_ranks, ProcessSet)
+          else ProcessSet(ps_or_ranks))
+    st.process_set_table.register(ps, st.devices, st.config.worker_axis)
+    return ps
+
+
+def remove_process_set(ps: ProcessSet) -> bool:
+    st = _require_init()
+    if not ps.initialized():
+        return False
+    st.process_set_table.remove(ps.process_set_id)
+    return True
+
+
+def get_process_set_ids_and_ranks() -> Dict[int, List[int]]:
+    st = _require_init()
+    return {i: list(st.process_set_table.get(i).ranks)
+            for i in st.process_set_table.ids()}
+
+
+def _setup_logging(cfg: Config):
+    level = {
+        "trace": logging.DEBUG, "debug": logging.DEBUG,
+        "info": logging.INFO, "warning": logging.WARNING,
+        "error": logging.ERROR, "fatal": logging.CRITICAL,
+        "off": logging.CRITICAL,
+    }.get(cfg.log_level.lower(), logging.WARNING)
+    fmt = ("%(asctime)s %(name)s %(levelname)s: %(message)s"
+           if cfg.log_timestamp else "%(name)s %(levelname)s: %(message)s")
+    handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter(fmt))
+    logger.handlers[:] = [handler]
+    logger.setLevel(level)

@@ -1,0 +1,75 @@
+"""Stall inspector: detects collectives stuck in the queue.
+
+Reference parity: ``horovod/common/stall_inspector.cc`` (SURVEY.md §5.2) —
+the reference warns when some ranks submitted a tensor while others haven't
+for ``HOROVOD_STALL_CHECK_TIME`` seconds, and aborts after
+``HOROVOD_STALL_SHUTDOWN_TIME``.
+
+On an SPMD substrate the analogous *semantic race* is a tensor that was
+submitted but never dispatched (e.g. a process diverged and stopped feeding
+the same program, or a multi-host peer stopped participating so the XLA
+collective never completes).  We track enqueue→complete latency per tensor
+name and surface the same warning/abort behavior.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict
+
+from .exceptions import StallError
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class StallInspector:
+    def __init__(self, check_time: float = 60.0, shutdown_time: float = 0.0,
+                 disabled: bool = False):
+        self.check_time = check_time
+        self.shutdown_time = shutdown_time
+        self.disabled = disabled or check_time <= 0
+        self._pending: Dict[str, float] = {}
+        self._warned: Dict[str, float] = {}
+        self.warnings_issued = 0
+
+    def record_enqueue(self, name: str, t: float):
+        if self.disabled:
+            return
+        self._pending.setdefault(name, t)
+
+    def record_complete(self, name: str):
+        if self.disabled:
+            return
+        self._pending.pop(name, None)
+        self._warned.pop(name, None)
+
+    def check(self, now: float = None):
+        """Scan pending tensors; warn on stalls, raise past the shutdown bar.
+
+        Called once per engine cycle (reference: CheckForStalledTensors from
+        ComputeResponseList).
+        """
+        if self.disabled:
+            return
+        now = time.monotonic() if now is None else now
+        stalled = []
+        for name, t0 in self._pending.items():
+            age = now - t0
+            if age > self.check_time and name not in self._warned:
+                stalled.append((name, age))
+                self._warned[name] = now
+            if self.shutdown_time > 0 and age > self.shutdown_time:
+                raise StallError(
+                    f"tensor {name} stalled for {age:.0f}s, past "
+                    f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
+                    f"{self.shutdown_time:.0f}; aborting")
+        if stalled:
+            self.warnings_issued += 1
+            names = ", ".join(f"{n} ({a:.0f}s)" for n, a in stalled)
+            logger.warning(
+                "One or more tensors were submitted to be reduced/gathered "
+                "but were not dispatched for over %.0f seconds: [%s]. This "
+                "usually means a participating process has stopped feeding "
+                "the same program (the SPMD analog of missing ranks).",
+                self.check_time, names)

@@ -1,0 +1,149 @@
+"""Chrome-trace timeline of per-tensor collective lifecycles.
+
+Reference parity: ``horovod/common/timeline.cc`` (SURVEY.md §5.1) — every
+tensor's journey is recorded as Chrome trace events (open the file in
+``chrome://tracing`` or Perfetto): NEGOTIATE_<OP> → QUEUED →
+MEMCPY_IN_FUSION_BUFFER → XLA_<OP> → DONE.  A dedicated writer thread drains
+an event queue so the hot path only does an enqueue, matching the
+reference's ``TimelineWriter`` design.  ``HOROVOD_TIMELINE`` enables it;
+``HOROVOD_TIMELINE_MARK_CYCLES=1`` adds one instant event per background
+cycle.
+
+On TPU, XLA/libtpu already traces the collectives themselves via
+``jax.profiler``; this timeline covers the framework layer above XLA
+(negotiation, queueing, fusion planning) which the device trace cannot see.
+Both use trace-event JSON, so they can be merged in Perfetto.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import List, Optional
+
+
+class Timeline:
+    def __init__(self, path: Optional[str], mark_cycles: bool = False):
+        self._path = None
+        self._mark_cycles = mark_cycles
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._file = None
+        self._first = True
+        self._t0 = time.monotonic()
+        self._tensor_tids = {}
+        self._next_tid = 1
+        self._lock = threading.Lock()
+        if path:
+            self.reopen(path, mark_cycles)
+
+    @property
+    def enabled(self) -> bool:
+        return self._path is not None
+
+    def reopen(self, path: str, mark_cycles: bool = False):
+        self.close()
+        self._path = path
+        self._mark_cycles = mark_cycles
+        self._file = open(path, "w")
+        self._file.write("[\n")
+        self._first = True
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._writer_loop, name="hvd-timeline", daemon=True)
+        self._thread.start()
+
+    def close(self):
+        if self._file is None:
+            return
+        self._queue.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._file.write("\n]\n")
+        self._file.close()
+        self._file = None
+        self._path = None
+
+    # -- event API (called from the engine) ---------------------------------
+    def _ts_us(self) -> float:
+        return (time.monotonic() - self._t0) * 1e6
+
+    def _tid(self, name: str) -> int:
+        with self._lock:
+            tid = self._tensor_tids.get(name)
+            if tid is None:
+                tid = self._next_tid
+                self._next_tid += 1
+                self._tensor_tids[name] = tid
+                self._emit({"name": "thread_name", "ph": "M", "pid": 0,
+                            "tid": tid, "args": {"name": name}})
+            return tid
+
+    def negotiate_start(self, name: str, op_type: str):
+        if not self.enabled:
+            return
+        tid = self._tid(name)
+        self._emit({"name": f"NEGOTIATE_{op_type.upper()}", "ph": "B",
+                    "pid": 0, "tid": tid, "ts": self._ts_us()})
+        self._emit({"name": f"NEGOTIATE_{op_type.upper()}", "ph": "E",
+                    "pid": 0, "tid": tid, "ts": self._ts_us()})
+        self._emit({"name": "QUEUED", "ph": "B", "pid": 0, "tid": tid,
+                    "ts": self._ts_us()})
+
+    def activity_start(self, names: List[str], activity: str):
+        if not self.enabled:
+            return
+        for name in names:
+            tid = self._tid(name)
+            self._emit({"name": "QUEUED", "ph": "E", "pid": 0, "tid": tid,
+                        "ts": self._ts_us()})
+            self._emit({"name": activity, "ph": "B", "pid": 0, "tid": tid,
+                        "ts": self._ts_us()})
+
+    def activity_transition(self, names: List[str], activity: str):
+        if not self.enabled:
+            return
+        for name in names:
+            tid = self._tid(name)
+            ts = self._ts_us()
+            self._emit({"name": "", "ph": "E", "pid": 0, "tid": tid,
+                        "ts": ts})
+            self._emit({"name": activity, "ph": "B", "pid": 0, "tid": tid,
+                        "ts": ts})
+
+    def activity_end(self, names: List[str]):
+        if not self.enabled:
+            return
+        for name in names:
+            self._emit({"name": "", "ph": "E", "pid": 0,
+                        "tid": self._tid(name), "ts": self._ts_us()})
+
+    def end(self, name: str):
+        pass  # lifecycle closed by activity_end; kept for API parity
+
+    def cycle_mark(self, cycle: int):
+        if not self.enabled or not self._mark_cycles:
+            return
+        self._emit({"name": "CYCLE_START", "ph": "i", "pid": 0, "tid": 0,
+                    "ts": self._ts_us(), "s": "g",
+                    "args": {"cycle": cycle}})
+
+    def _emit(self, event: dict):
+        if self._file is not None:
+            self._queue.put(event)
+
+    def _writer_loop(self):
+        while True:
+            ev = self._queue.get()
+            if ev is None:
+                return
+            prefix = "" if self._first else ",\n"
+            self._first = False
+            try:
+                self._file.write(prefix + json.dumps(ev))
+            except ValueError:
+                return  # file closed

@@ -1,0 +1,74 @@
+"""Cross-process coordination utilities over the JAX coordination service.
+
+Reference parity: the control-plane transports in ``horovod/common/mpi/``
+(MPI_Gatherv/Bcast) and ``horovod/common/gloo/http_store.cc`` (HTTP KV
+store).  On TPU the coordination service that ``jax.distributed.initialize``
+connects to provides a distributed key-value store and barriers over DCN —
+the native replacement for both.
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+from typing import Optional
+
+import jax
+
+_counter = itertools.count()
+
+
+def _client():
+    from jax._src import distributed
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "JAX distributed runtime is not initialized; multi-process "
+            "coordination requires launching via hvdrun (or calling "
+            "jax.distributed.initialize).")
+    return client
+
+
+def multihost_barrier(tag: str, timeout_s: int = 300):
+    """Barrier across processes via the coordination service."""
+    if jax.process_count() == 1:
+        return
+    n = next(_counter)
+    _client().wait_at_barrier(f"{tag}_{n}", timeout_in_ms=timeout_s * 1000)
+
+
+def multihost_broadcast_bytes(payload: Optional[bytes],
+                              root_process: int = 0,
+                              timeout_s: int = 300) -> bytes:
+    """Broadcast a byte string from ``root_process`` to every process."""
+    if jax.process_count() == 1:
+        if payload is None:
+            raise ValueError("payload required on the root process")
+        return payload
+    client = _client()
+    n = next(_counter)
+    key = f"hvd_bcast_{n}"
+    if jax.process_index() == root_process:
+        if payload is None:
+            raise ValueError("payload required on the root process")
+        client.key_value_set(key, base64.b64encode(payload).decode())
+    raw = client.blocking_key_value_get(key, timeout_s * 1000)
+    return base64.b64decode(raw)
+
+
+def multihost_allgather_str(value: str, tag: str = "ag",
+                            timeout_s: int = 300) -> list:
+    """Gather one string from every process; returns list indexed by rank.
+
+    The transport for the engine's cross-process negotiation round
+    (reference: MPIController::ComputeResponseList's Gatherv+Bcast).
+    """
+    if jax.process_count() == 1:
+        return [value]
+    client = _client()
+    n = next(_counter)
+    prefix = f"hvd_ag_{tag}_{n}"
+    client.key_value_set(f"{prefix}/{jax.process_index()}", value)
+    client.wait_at_barrier(f"{prefix}_b", timeout_in_ms=timeout_s * 1000)
+    return [client.blocking_key_value_get(f"{prefix}/{p}", timeout_s * 1000)
+            for p in range(jax.process_count())]

@@ -1,0 +1,38 @@
+"""Test configuration: 8 virtual CPU devices simulating a TPU slice.
+
+SURVEY.md §4: the reference tests collectives by launching ≥2 real processes
+over Gloo/MPI shared memory.  JAX lets us do strictly better — a virtual
+8-device mesh in one process (``--xla_force_host_platform_device_count``)
+exercises the same XLA collective code paths that run over ICI on hardware.
+
+NOTE: the axon sitecustomize force-registers the TPU PJRT plugin and sets
+``jax_platforms=axon,cpu`` programmatically, so setting JAX_PLATFORMS in the
+environment is not sufficient — we must override the config after import.
+"""
+
+import os
+
+os.environ.setdefault("HOROVOD_CYCLE_TIME", "0.1")  # fast test cycles (ms)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def hvd():
+    import horovod_tpu as hvd
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+@pytest.fixture(scope="session")
+def n_workers(hvd):
+    return hvd.size()

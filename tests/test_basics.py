@@ -1,0 +1,80 @@
+"""Init/topology/process-set tests (reference: test/parallel/test_torch.py
+topology assertions + test_process_sets.py)."""
+
+import numpy as np
+import pytest
+
+
+def test_init_idempotent(hvd):
+    assert hvd.is_initialized()
+    hvd.init()  # second init is a no-op
+    assert hvd.is_initialized()
+
+
+def test_topology(hvd):
+    assert hvd.size() == 8
+    assert hvd.local_size() == 8
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.is_homogeneous()
+
+
+def test_feature_flags(hvd):
+    assert not hvd.mpi_built()
+    assert not hvd.nccl_built()
+    assert not hvd.gloo_built()
+    assert hvd.xla_built()
+    assert hvd.tpu_built()
+    assert not hvd.mpi_threads_supported()
+
+
+def test_mesh(hvd):
+    m = hvd.mesh()
+    assert m.devices.size == 8
+    assert m.axis_names == (hvd.worker_axis(),)
+
+
+def test_global_process_set(hvd):
+    ps = hvd.global_process_set
+    assert ps.process_set_id == 0
+    assert ps.size() == 8
+    assert ps.ranks == list(range(8))
+    assert ps.included()
+    assert ps.rank() == 0
+
+
+def test_add_remove_process_set(hvd):
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    try:
+        assert ps.initialized()
+        assert ps.size() == 4
+        assert ps.rank() == 0  # lead worker 0 is in the set
+        ids = hvd.get_process_set_ids_and_ranks()
+        assert ids[ps.process_set_id] == [0, 2, 4, 6]
+        # duplicate registration is rejected (reference behavior)
+        with pytest.raises(ValueError):
+            hvd.add_process_set([0, 2, 4, 6])
+    finally:
+        assert hvd.remove_process_set(ps)
+    assert not ps.initialized()
+    assert not hvd.remove_process_set(ps)
+
+
+def test_cannot_remove_global_set(hvd):
+    with pytest.raises(ValueError):
+        hvd.runtime._state().process_set_table.remove(0)
+
+
+def test_not_initialized_error():
+    import horovod_tpu as hvd
+    from horovod_tpu.runtime import ProcessSet
+    ps = ProcessSet([0, 1])
+    with pytest.raises(hvd.NotInitializedError):
+        ps.size()
+
+
+def test_worker_values_shape(hvd):
+    x = hvd.worker_values(lambda r: np.full((3,), float(r)))
+    assert x.shape == (8, 3)

@@ -1,0 +1,268 @@
+"""Collective op correctness (reference: test/parallel/test_torch.py —
+every op x dtype, rank-dependent inputs verify real communication)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+def test_allreduce_replicated_average(hvd):
+    x = jnp.ones((4, 5))
+    out = hvd.allreduce(x)  # default average
+    np.testing.assert_allclose(out, np.ones((4, 5)))
+
+
+def test_allreduce_replicated_sum(hvd):
+    x = jnp.ones((3,))
+    out = hvd.allreduce(x, op=hvd.Sum)
+    np.testing.assert_allclose(out, np.full((3,), 8.0))
+
+
+def test_allreduce_stacked_sum(hvd):
+    # rank-dependent input: worker r contributes r — the reference's
+    # "verify real communication" pattern
+    x = hvd.worker_values(lambda r: np.full((2, 3), float(r)))
+    out = hvd.allreduce(x, op=hvd.Sum)
+    np.testing.assert_allclose(out, np.full((2, 3), sum(range(8))))
+
+
+def test_allreduce_stacked_average(hvd):
+    x = hvd.worker_values(lambda r: np.full((4,), float(r)))
+    out = hvd.allreduce(x)
+    np.testing.assert_allclose(out, np.full((4,), np.mean(range(8))))
+
+
+def test_allreduce_min_max(hvd):
+    x = hvd.worker_values(lambda r: np.array([float(r), -float(r)]))
+    np.testing.assert_allclose(
+        hvd.allreduce(x, op=hvd.Min), np.array([0.0, -7.0]))
+    np.testing.assert_allclose(
+        hvd.allreduce(x, op=hvd.Max), np.array([7.0, 0.0]))
+
+
+def test_allreduce_product(hvd):
+    x = hvd.worker_values(lambda r: np.full((2,), 2.0))
+    np.testing.assert_allclose(
+        hvd.allreduce(x, op=hvd.Product), np.full((2,), 2.0 ** 8))
+
+
+def test_allreduce_int_dtype(hvd):
+    x = hvd.worker_values(lambda r: np.array([r, r + 1], dtype=np.int32))
+    out = hvd.allreduce(x, op=hvd.Sum)
+    assert out.dtype == jnp.int32
+    np.testing.assert_array_equal(out, np.array([28, 36]))
+
+
+def test_allreduce_prescale_postscale(hvd):
+    x = hvd.worker_values(lambda r: np.full((3,), 2.0))
+    out = hvd.allreduce(x, op=hvd.Sum, prescale_factor=0.5,
+                        postscale_factor=4.0)
+    # (2*0.5) summed over 8 = 8, * 4 = 32
+    np.testing.assert_allclose(out, np.full((3,), 32.0))
+
+
+def test_allreduce_average_and_op_conflict(hvd):
+    with pytest.raises(ValueError):
+        hvd.allreduce(jnp.ones(2), average=True, op=hvd.Sum)
+
+
+def test_allreduce_compression_fp16(hvd):
+    x = hvd.worker_values(lambda r: np.full((4,), float(r)))
+    out = hvd.allreduce(x, op=hvd.Sum, compression=hvd.Compression.fp16)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(out, np.full((4,), 28.0))
+
+
+def test_allreduce_compression_bf16(hvd):
+    x = jnp.ones((4,))
+    out = hvd.allreduce(x, op=hvd.Sum, compression=hvd.Compression.bf16)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(out, np.full((4,), 8.0))
+
+
+def test_allreduce_async_poll_synchronize(hvd):
+    x = jnp.ones((2,))
+    handle = hvd.allreduce_async(x, op=hvd.Sum)
+    out = hvd.synchronize(handle)
+    assert hvd.poll(handle)
+    np.testing.assert_allclose(out, np.full((2,), 8.0))
+
+
+def test_grouped_allreduce(hvd):
+    xs = [hvd.worker_values(lambda r: np.full((i + 1,), float(r)))
+          for i in range(3)]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum)
+    assert len(outs) == 3
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o, np.full((i + 1,), 28.0))
+
+
+def test_grouped_allreduce_mixed_dtypes(hvd):
+    xs = [hvd.worker_values(lambda r: np.full((2,), float(r), np.float32)),
+          hvd.worker_values(lambda r: np.full((2,), r, np.int32))]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum)
+    np.testing.assert_allclose(outs[0], np.full((2,), 28.0))
+    np.testing.assert_array_equal(outs[1], np.full((2,), 28))
+
+
+def test_allreduce_process_set(hvd):
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    try:
+        x = horovod_tpu.ops.collectives.stack_on_workers(
+            [np.full((2,), float(r)) for r in range(4)], ps)
+        out = hvd.allreduce(x, op=hvd.Sum, process_set=ps)
+        np.testing.assert_allclose(out, np.full((2,), 6.0))
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_allreduce_adasum_identical(hvd):
+    # adasum of identical vectors = the vector itself
+    x = jnp.array([3.0, 4.0])
+    out = hvd.allreduce(x, op=hvd.Adasum)
+    np.testing.assert_allclose(out, np.array([3.0, 4.0]), atol=1e-5)
+
+
+def test_allreduce_adasum_orthogonal(hvd):
+    # orthogonal contributions: adasum == sum (projections are zero)
+    def contrib(r):
+        v = np.zeros((8,), np.float32)
+        v[r] = 1.0
+        return v
+    x = hvd.worker_values(contrib)
+    out = hvd.allreduce(x, op=hvd.Adasum)
+    np.testing.assert_allclose(out, np.ones((8,)), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# allgather
+# ---------------------------------------------------------------------------
+
+def test_allgather_stacked(hvd):
+    x = hvd.worker_values(lambda r: np.full((2, 3), float(r)))
+    out = hvd.allgather(x)
+    assert out.shape == (16, 3)
+    expected = np.concatenate([np.full((2, 3), float(r)) for r in range(8)])
+    np.testing.assert_allclose(out, expected)
+
+
+def test_allgather_replicated(hvd):
+    x = jnp.arange(6.0).reshape(2, 3)
+    out = hvd.allgather(x)
+    assert out.shape == (16, 3)
+    np.testing.assert_allclose(out, np.concatenate([np.asarray(x)] * 8))
+
+
+def test_grouped_allgather(hvd):
+    xs = [hvd.worker_values(lambda r: np.full((1, 2), float(r + i)))
+          for i in range(2)]
+    outs = hvd.grouped_allgather(xs)
+    assert outs[0].shape == (8, 2)
+    np.testing.assert_allclose(outs[0][:, 0], np.arange(8.0))
+    np.testing.assert_allclose(outs[1][:, 0], np.arange(8.0) + 1)
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+# ---------------------------------------------------------------------------
+
+def test_broadcast_stacked(hvd):
+    x = hvd.worker_values(lambda r: np.full((3,), float(r)))
+    for root in (0, 3, 7):
+        out = hvd.broadcast(x, root_rank=root)
+        np.testing.assert_allclose(out, np.full((3,), float(root)))
+
+
+def test_broadcast_replicated_identity(hvd):
+    x = jnp.arange(5.0)
+    out = hvd.broadcast(x, root_rank=2)
+    np.testing.assert_allclose(out, np.arange(5.0))
+
+
+def test_broadcast_int(hvd):
+    x = hvd.worker_values(lambda r: np.array([r * 10], dtype=np.int64))
+    out = hvd.broadcast(x, root_rank=5)
+    np.testing.assert_array_equal(np.asarray(out), np.array([50]))
+
+
+def test_broadcast_object(hvd):
+    obj = {"a": 1, "b": [1, 2, 3]}
+    assert hvd.broadcast_object(obj, root_rank=0) == obj
+
+
+# ---------------------------------------------------------------------------
+# alltoall
+# ---------------------------------------------------------------------------
+
+def test_alltoall_uniform(hvd):
+    # worker i sends value i*8+j to worker j
+    x = hvd.worker_values(
+        lambda i: np.array([i * 8 + j for j in range(8)], dtype=np.float32))
+    out = hvd.alltoall(x)
+    assert out.shape == (8, 8)
+    # worker j receives [i*8+j for i in range(8)]
+    got = np.asarray(out)
+    for j in range(8):
+        np.testing.assert_allclose(
+            got[j], np.array([i * 8 + j for i in range(8)]))
+
+
+def test_alltoall_uniform_splits_arg(hvd):
+    x = hvd.worker_values(
+        lambda i: np.arange(16.0) + 100 * i)
+    out = hvd.alltoall(x, splits=[2] * 8)
+    got = np.asarray(out)
+    for j in range(8):
+        expected = np.concatenate(
+            [np.arange(2 * j, 2 * j + 2) + 100 * i for i in range(8)])
+        np.testing.assert_allclose(got[j], expected)
+
+
+def test_alltoall_indivisible_raises(hvd):
+    x = hvd.worker_values(lambda i: np.arange(7.0))
+    with pytest.raises(horovod_tpu.HorovodInternalError):
+        hvd.alltoall(x)
+
+
+# ---------------------------------------------------------------------------
+# reducescatter
+# ---------------------------------------------------------------------------
+
+def test_reducescatter_stacked(hvd):
+    x = hvd.worker_values(lambda r: np.full((16,), float(r)))
+    out = hvd.reducescatter(x, op=hvd.Sum)
+    assert out.shape == (8, 2)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 2), 28.0))
+
+
+def test_reducescatter_average(hvd):
+    x = hvd.worker_values(lambda r: np.full((8,), float(r)))
+    out = hvd.reducescatter(x)  # average
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.5))
+
+
+def test_reducescatter_replicated(hvd):
+    x = jnp.arange(8.0)
+    out = hvd.reducescatter(x, op=hvd.Sum)
+    np.testing.assert_allclose(
+        np.asarray(out), (np.arange(8.0) * 8).reshape(8, 1))
+
+
+# ---------------------------------------------------------------------------
+# sync primitives
+# ---------------------------------------------------------------------------
+
+def test_join_and_barrier(hvd):
+    hvd.barrier()
+    assert hvd.join() == hvd.size() - 1
+
+
+def test_engine_stats(hvd):
+    stats = horovod_tpu.runtime._state().engine.stats()
+    assert stats["cycles"] > 0

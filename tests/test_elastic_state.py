@@ -1,0 +1,144 @@
+"""Elastic state commit/restore/sync tests (reference:
+test/integration/test_elastic_torch.py state semantics, single-process
+subset; driver tests live with the runner)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd_mod
+from horovod_tpu.elastic import ArrayState, ElasticSampler, ObjectState
+from horovod_tpu.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+
+
+def test_object_state_commit_restore(hvd):
+    state = ObjectState(epoch=0, batch=5)
+    state.epoch = 3
+    state.batch = 7
+    state.commit()
+    state.epoch = 99
+    state.restore()
+    assert state.epoch == 3
+    assert state.batch == 7
+
+
+def test_object_state_sync(hvd):
+    state = ObjectState(epoch=4)
+    state.sync()
+    assert state.epoch == 4
+
+
+def test_array_state_commit_restore(hvd):
+    params = {"w": jnp.arange(4.0)}
+    state = ArrayState(params=params, step=0)
+    state.commit()
+    state.params = {"w": jnp.zeros(4)}
+    state.step = 10
+    state.restore()
+    np.testing.assert_allclose(state.params["w"], np.arange(4.0))
+    assert state.step == 0
+
+
+def test_array_state_sync(hvd):
+    state = ArrayState(params={"w": jnp.ones(3)})
+    state.sync()
+    np.testing.assert_allclose(state.params["w"], np.ones(3))
+
+
+def test_elastic_run_restores_on_internal_error(hvd):
+    calls = []
+
+    @hvd_mod.elastic.run
+    def train(state):
+        calls.append(state.step)
+        if len(calls) == 1:
+            state.step = 55
+            raise HorovodInternalError("simulated slice preemption")
+        return state.step
+
+    state = ObjectState(step=1)
+    result = train(state)
+    # restored to committed value after the failure
+    assert result == 1
+    assert calls == [1, 1]
+
+
+def test_elastic_run_syncs_on_hosts_updated(hvd):
+    calls = []
+
+    @hvd_mod.elastic.run
+    def train(state):
+        calls.append(1)
+        if len(calls) == 1:
+            raise HostsUpdatedInterrupt(skip_sync=False)
+        return "done"
+
+    state = ObjectState(step=2)
+    assert train(state) == "done"
+    assert len(calls) == 2
+
+
+def test_elastic_reset_limit(hvd):
+    @hvd_mod.elastic.run(reset_limit=1)
+    def train(state):
+        raise HorovodInternalError("always fails")
+
+    with pytest.raises(RuntimeError, match="reset limit"):
+        train(ObjectState(step=0))
+
+
+def test_state_host_update_raises_interrupt(hvd):
+    state = ObjectState(step=0)
+    state.on_hosts_updated(0.0, 0)  # removal → full sync required
+    with pytest.raises(HostsUpdatedInterrupt) as exc_info:
+        state.commit()
+    assert not exc_info.value.skip_sync
+
+
+def test_state_addition_only_skips_sync(hvd):
+    state = ObjectState(step=0)
+    state.on_hosts_updated(0.0, 1)  # pure addition
+    with pytest.raises(HostsUpdatedInterrupt) as exc_info:
+        state.commit()
+    assert exc_info.value.skip_sync
+
+
+# ---------------------------------------------------------------------------
+# elastic sampler
+# ---------------------------------------------------------------------------
+
+def test_sampler_partitions_evenly():
+    s = ElasticSampler(dataset_size=100, shuffle=False, rank=0,
+                       num_replicas=4)
+    assert len(s) == 25
+    all_indices = set()
+    for r in range(4):
+        sr = ElasticSampler(100, shuffle=False, rank=r, num_replicas=4)
+        all_indices.update(sr)
+    assert all_indices == set(range(100))
+
+
+def test_sampler_reshards_remaining_after_resize():
+    s = ElasticSampler(dataset_size=20, shuffle=False, rank=0,
+                       num_replicas=4)
+    s.record_indices(list(range(8)))  # first 8 samples done
+    # resize: 4 → 2 workers
+    s._explicit_replicas = 2
+    s.reset()
+    remaining = set(s.remaining_indices)
+    assert remaining == set(range(8, 20))
+    assert len(s) == 6  # 12 remaining / 2 workers
+
+
+def test_sampler_state_dict_roundtrip():
+    s = ElasticSampler(dataset_size=10, shuffle=True, seed=3, rank=0,
+                       num_replicas=2)
+    s.set_epoch(2)
+    s.record_indices([1, 2, 3])
+    sd = s.state_dict()
+    s2 = ElasticSampler(dataset_size=10, shuffle=True, seed=3, rank=0,
+                        num_replicas=2)
+    s2.load_state_dict(sd)
+    assert s2.epoch == 2
+    assert s2.processed_indices == {1, 2, 3}
+    assert set(s2.remaining_indices) == set(range(10)) - {1, 2, 3}

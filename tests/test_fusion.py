@@ -1,0 +1,102 @@
+"""Fusion planner + response cache unit tests (reference:
+controller.cc FuseResponses + response_cache.cc behavior)."""
+
+from horovod_tpu.ops.fusion import EntrySig, ResponseCache, plan_fusion
+
+
+def sig(name, shape=(10,), dtype="float32", op="allreduce",
+        reduce_op="sum", ps=0, stacked=True, group=-1):
+    return EntrySig(name=name, op_type=op, reduce_op=reduce_op, dtype=dtype,
+                    shape=shape, process_set_id=ps, stacked=stacked,
+                    group_id=group)
+
+
+def test_single_bucket():
+    entries = [sig("a"), sig("b"), sig("c")]
+    plan = plan_fusion(entries, threshold_bytes=1 << 20)
+    assert plan == [[0, 1, 2]]
+
+
+def test_threshold_splits_buckets():
+    # each tensor is 40 bytes; threshold 100 → at most 2 per bucket
+    entries = [sig(n) for n in "abcde"]
+    plan = plan_fusion(entries, threshold_bytes=100)
+    assert [len(b) for b in plan] == [2, 2, 1]
+    # deterministic name order within/across buckets
+    flat = [entries[i].name for b in plan for i in b]
+    assert flat == sorted(flat)
+
+
+def test_dtype_separates_buckets():
+    entries = [sig("a", dtype="float32"), sig("b", dtype="bfloat16"),
+               sig("c", dtype="float32")]
+    plan = plan_fusion(entries, threshold_bytes=1 << 20)
+    buckets = {tuple(entries[i].dtype for i in b) for b in plan}
+    for b in buckets:
+        assert len(set(b)) == 1  # no mixed-dtype bucket
+
+
+def test_reduce_op_separates_buckets():
+    entries = [sig("a", reduce_op="sum"), sig("b", reduce_op="min")]
+    plan = plan_fusion(entries, threshold_bytes=1 << 20)
+    assert len(plan) == 2
+
+
+def test_process_set_separates_buckets():
+    entries = [sig("a", ps=0), sig("b", ps=1)]
+    plan = plan_fusion(entries, threshold_bytes=1 << 20)
+    assert len(plan) == 2
+
+
+def test_non_allreduce_never_fuses():
+    entries = [sig("a", op="allgather"), sig("b", op="allgather")]
+    plan = plan_fusion(entries, threshold_bytes=1 << 20)
+    assert plan == [[0], [1]]
+
+
+def test_group_overrides_threshold():
+    # grouped entries fuse atomically even past the threshold
+    entries = [sig("a", group=7), sig("b", group=7), sig("c", group=7)]
+    plan = plan_fusion(entries, threshold_bytes=50)  # < one tensor
+    assert plan == [[0, 1, 2]]
+
+
+def test_deterministic_across_submission_orders():
+    e1 = [sig("x"), sig("a"), sig("m")]
+    e2 = [sig("a"), sig("m"), sig("x")]
+    p1 = plan_fusion(e1, 1 << 20)
+    p2 = plan_fusion(e2, 1 << 20)
+    names1 = [[e1[i].name for i in b] for b in p1]
+    names2 = [[e2[i].name for i in b] for b in p2]
+    assert names1 == names2 == [["a", "m", "x"]]
+
+
+def test_response_cache_hit_miss_lru():
+    cache = ResponseCache(capacity=2)
+    a = [sig("a")]
+    b = [sig("b")]
+    c = [sig("c")]
+    assert cache.get(a) is None
+    cache.put(a, [[0]])
+    assert cache.get(a) == [[0]]
+    cache.put(b, [[0]])
+    cache.put(c, [[0]])  # evicts a (capacity 2, LRU)
+    assert cache.get(a) is None
+    assert cache.get(b) == [[0]]
+    assert cache.get(c) == [[0]]
+    stats = cache.stats()
+    assert stats["hits"] == 3 and stats["entries"] == 2
+
+
+def test_response_cache_keyed_by_shape_and_dtype():
+    cache = ResponseCache(capacity=8)
+    cache.put([sig("a", shape=(4,))], [[0]])
+    assert cache.get([sig("a", shape=(5,))]) is None
+    assert cache.get([sig("a", shape=(4,), dtype="bfloat16")]) is None
+    assert cache.get([sig("a", shape=(4,))]) == [[0]]
+
+
+def test_zero_capacity_disables_cache():
+    cache = ResponseCache(capacity=0)
+    cache.put([sig("a")], [[0]])
+    assert cache.get([sig("a")]) is None

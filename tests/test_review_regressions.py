@@ -1,0 +1,62 @@
+"""Regression tests for code-review findings."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu
+from horovod_tpu.elastic import ElasticSampler
+
+
+def test_mixed_prescale_not_fused_incorrectly(hvd):
+    """Two same-shape allreduces with different prescale factors submitted in
+    one cycle must each get their own scale."""
+    x = hvd.worker_values(lambda r: np.full((3,), 1.0))
+    y = hvd.worker_values(lambda r: np.full((3,), 1.0))
+    h1 = hvd.allreduce_async(x, op=hvd.Sum, name="noscale")
+    h2 = hvd.allreduce_async(y, op=hvd.Sum, name="scaled",
+                             prescale_factor=10.0)
+    np.testing.assert_allclose(h1.synchronize(), np.full((3,), 8.0))
+    np.testing.assert_allclose(h2.synchronize(), np.full((3,), 80.0))
+
+
+def test_reducescatter_rejects_min(hvd):
+    x = hvd.worker_values(lambda r: np.full((8,), float(r + 1)))
+    with pytest.raises(ValueError, match="Sum and Average"):
+        hvd.reducescatter(x, op=hvd.Min)
+
+
+def test_alltoall_uneven_splits(hvd):
+    # worker i sends 1 row to workers 0..6 and 2 rows to worker 7
+    splits = [1] * 7 + [2]
+
+    def contrib(i):
+        return np.arange(9.0) + 100 * i
+
+    x = hvd.worker_values(contrib)
+    out = hvd.alltoall(x, splits=splits)
+    assert isinstance(out, list) and len(out) == 8
+    # worker j<7 receives 8 rows: value j from each sender
+    for j in range(7):
+        np.testing.assert_allclose(
+            np.asarray(out[j]), np.array([100 * i + j for i in range(8)]))
+    # worker 7 receives 16 rows: values 7,8 from each sender
+    expected = np.concatenate([[100 * i + 7, 100 * i + 8] for i in range(8)])
+    np.testing.assert_allclose(np.asarray(out[7]), expected)
+
+
+def test_alltoall_bad_splits_raises_at_submission(hvd):
+    with pytest.raises(ValueError, match="one entry per worker"):
+        hvd.alltoall(hvd.worker_values(lambda r: np.arange(8.0)),
+                     splits=[1, 2, 3])
+
+
+def test_sampler_record_batch_uses_remaining_order():
+    s = ElasticSampler(dataset_size=8, shuffle=False, rank=0, num_replicas=2)
+    s.record_batch(0, 1)  # marks padded[0:2] = {0, 1}
+    assert s.processed_indices == {0, 1}
+    s.reset()  # remaining = [2..7]
+    s.record_batch(0, 1)  # must mark {2, 3}, not re-mark {0, 1}
+    assert s.processed_indices == {0, 1, 2, 3}
+    s.reset()
+    assert set(s.remaining_indices) == {4, 5, 6, 7}
