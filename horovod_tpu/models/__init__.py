@@ -1,0 +1,9 @@
+"""Model zoo: the benchmark-config model families (SURVEY.md §2.3).
+
+MNIST MLP/CNN (config 1), ResNet-50 (config 2), BERT (config 3),
+Llama-3-style decoder (config 4, flagship) and a Mixtral-style MoE variant
+(expert parallelism).  All are written TPU-first: bf16 compute / fp32
+params, stacked-layer ``lax.scan`` bodies, explicit mesh-axis hooks.
+"""
+
+from . import llama  # noqa: F401  (mlp/resnet/bert/moe import on demand)

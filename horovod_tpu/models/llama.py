@@ -1,0 +1,321 @@
+"""Llama-3-style decoder: the flagship model (BASELINE config 4).
+
+TPU-first design choices:
+  * bf16 activations / fp32 master params — MXU-native matmuls, fp32 RMSNorm
+    and softmax accumulation.
+  * Layers stacked on a leading dim and driven by ``lax.scan`` — one
+    compiled block body regardless of depth (fast compile, XLA-friendly).
+  * Parallelism as mesh-axis hooks (``ParallelSpec``): megatron-style
+    column/row tensor parallel (one psum per attention + one per MLP),
+    ring-attention or Ulysses sequence parallel for long context, optional
+    GPipe pipeline over the layer stack, data parallel gradient psum.
+  * GQA (grouped-query attention) with RoPE, SwiGLU MLP — the Llama-3
+    architecture family.
+
+The reference has no model zoo of its own (its examples wrap torchvision/
+transformers models); this module provides the equivalent capability
+surface natively, and is the model the benchmarks drive.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..parallel.ring_attention import ring_attention
+from ..parallel.ulysses import ulysses_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    dtype: Any = jnp.bfloat16     # activation / compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    # Mixture-of-Experts (0 experts = dense SwiGLU MLP)
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def llama3_8b() -> LlamaConfig:
+    """Llama-3-8B geometry (the BASELINE config-4 target)."""
+    return LlamaConfig()
+
+
+def tiny(vocab: int = 256, seq: int = 128) -> LlamaConfig:
+    """Test-scale config: same code paths, toy sizes."""
+    return LlamaConfig(vocab_size=vocab, d_model=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, d_ff=128, max_seq_len=seq,
+                       dtype=jnp.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelSpec:
+    """Which mesh axes the forward pass should use (None = off)."""
+    dp_axis: Optional[str] = None
+    tp_axis: Optional[str] = None
+    sp_axis: Optional[str] = None
+    pp_axis: Optional[str] = None
+    ep_axis: Optional[str] = None  # usually aliased to dp (see mesh.py)
+    attn: str = "ring"            # "ring" | "ulysses" | "local"
+
+
+def init_params(cfg: LlamaConfig, key, tp: int = 1) -> Dict:
+    """Initialize parameters; with ``tp > 1`` returns the FULL stacked
+    params — shard them over the mesh with :func:`param_specs`."""
+    k = jax.random.split(key, 8)
+    D, H, Hkv, Dh, F, L, V = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+                              cfg.head_dim, cfg.d_ff, cfg.n_layers,
+                              cfg.vocab_size)
+    if H % tp or Hkv % tp or F % tp:
+        raise ValueError(
+            f"heads({H})/kv_heads({Hkv})/d_ff({F}) must divide tp={tp}")
+
+    def norm(key, shape, fan_in):
+        return (jax.random.normal(key, shape, cfg.param_dtype)
+                * (fan_in ** -0.5))
+
+    layers = {
+        "attn_norm": jnp.ones((L, D), cfg.param_dtype),
+        "wq": norm(k[1], (L, D, H * Dh), D),
+        "wk": norm(k[2], (L, D, Hkv * Dh), D),
+        "wv": norm(k[3], (L, D, Hkv * Dh), D),
+        "wo": norm(k[4], (L, H * Dh, D), H * Dh),
+        "mlp_norm": jnp.ones((L, D), cfg.param_dtype),
+    }
+    if cfg.n_experts > 0:
+        from .moe import init_moe_layer_params
+        layers.update(init_moe_layer_params(
+            k[5], L, D, F, cfg.n_experts, cfg.param_dtype))
+    else:
+        layers.update({
+            "w_gate": norm(k[5], (L, D, F), D),
+            "w_up": norm(k[6], (L, D, F), D),
+            "w_down": norm(k[7], (L, F, D), F),
+        })
+    return {
+        "embed": norm(k[0], (V, D), D),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), cfg.param_dtype),
+    }
+
+
+def param_specs(par: ParallelSpec, cfg: Optional[LlamaConfig] = None):
+    """PartitionSpecs for the param pytree (megatron layout).
+
+    Column-parallel (wq/wk/wv/w_gate/w_up) shard the output dim over tp;
+    row-parallel (wo/w_down) shard the input dim; norms and embeddings are
+    replicated; the stacked layer dim shards over pp when pipelining; MoE
+    expert weights shard their expert dim over ep.
+    """
+    from jax.sharding import PartitionSpec as P
+    tp = par.tp_axis
+    pp = par.pp_axis
+    layers = {
+        "attn_norm": P(pp, None),
+        "wq": P(pp, None, tp),
+        "wk": P(pp, None, tp),
+        "wv": P(pp, None, tp),
+        "wo": P(pp, tp, None),
+        "mlp_norm": P(pp, None),
+    }
+    if cfg is not None and cfg.n_experts > 0:
+        ep = par.ep_axis
+        layers.update({
+            "router": P(pp, None, None),
+            "we_gate": P(pp, ep, None, tp),
+            "we_up": P(pp, ep, None, tp),
+            "we_down": P(pp, ep, tp, None),
+        })
+    else:
+        layers.update({
+            "w_gate": P(pp, None, tp),
+            "w_up": P(pp, None, tp),
+            "w_down": P(pp, tp, None),
+        })
+    return {
+        "embed": P(),
+        "layers": layers,
+        "final_norm": P(),
+    }
+
+
+def _rmsnorm(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * w.astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    """Rotary embedding; x: [B, T, H, D], positions: [B, T] (global)."""
+    Dh = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, Dh // 2, dtype=jnp.float32) / (Dh // 2))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,Dh/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    return out.astype(x.dtype)
+
+
+def _attention(x, lp, cfg: LlamaConfig, par: ParallelSpec, positions):
+    """One attention sublayer on tp-local heads and sp-local sequence."""
+    B, Tl, D = x.shape
+    Dh = cfg.head_dim
+    # local head counts under tp (weights arrive pre-sharded)
+    Hl = lp["wq"].shape[-1] // Dh
+    Hkvl = lp["wk"].shape[-1] // Dh
+    q = (x @ lp["wq"].astype(x.dtype)).reshape(B, Tl, Hl, Dh)
+    k = (x @ lp["wk"].astype(x.dtype)).reshape(B, Tl, Hkvl, Dh)
+    v = (x @ lp["wv"].astype(x.dtype)).reshape(B, Tl, Hkvl, Dh)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    # GQA: repeat kv heads up to q heads
+    if Hkvl != Hl:
+        rep = Hl // Hkvl
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if par.attn == "ulysses":
+        o = ulysses_attention(q, k, v, par.sp_axis, causal=True)
+    else:
+        o = ring_attention(q, k, v, par.sp_axis, causal=True)
+    o = o.reshape(B, Tl, Hl * Dh) @ lp["wo"].astype(x.dtype)
+    if par.tp_axis is not None:
+        o = lax.psum(o, par.tp_axis)  # row-parallel output reduction
+    return o
+
+
+def _mlp(x, lp, par: ParallelSpec):
+    gate = jax.nn.silu(x @ lp["w_gate"].astype(x.dtype))
+    up = x @ lp["w_up"].astype(x.dtype)
+    out = (gate * up) @ lp["w_down"].astype(x.dtype)
+    if par.tp_axis is not None:
+        out = lax.psum(out, par.tp_axis)
+    return out
+
+
+def block(x, lp, cfg: LlamaConfig, par: ParallelSpec, positions):
+    """One transformer block (shape-preserving — the pipeline stage unit).
+    Returns (x, aux_loss) — aux is 0 for dense MLPs."""
+    x = x + _attention(_rmsnorm(x, lp["attn_norm"], cfg.norm_eps),
+                       lp, cfg, par, positions)
+    pre = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    if cfg.n_experts > 0:
+        from .moe import moe_layer
+        y, aux = moe_layer(pre, lp, cfg, par)
+    else:
+        y, aux = _mlp(pre, lp, par), jnp.float32(0.0)
+    return x + y, aux
+
+
+def _layer_stack(h, layers, cfg: LlamaConfig, par: ParallelSpec, positions):
+    body = block
+    if cfg.remat:
+        body = jax.checkpoint(
+            body, static_argnums=(2, 3),
+            policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_body(carry, lp):
+        h, aux = carry
+        h, aux_l = body(h, lp, cfg, par, positions)
+        return (h, aux + aux_l), None
+
+    # aux accumulator derives from h (×0) so it inherits h's varying mesh
+    # axes — a fresh constant would be invariant and fail check_vma's
+    # carry-type check once the MoE aux (data-dependent) joins it
+    aux0 = (h.astype(jnp.float32) * 0).sum()
+    (h, aux), _ = lax.scan(scan_body, (h, aux0), layers)
+    return h, aux
+
+
+def forward(params, tokens, cfg: LlamaConfig, par: ParallelSpec,
+            n_microbatches: int = 0):
+    """Token ids → logits.  Call inside shard_map over the parallel mesh.
+
+    ``tokens``: ``[B_local, T_local]`` — batch sharded over dp, sequence
+    over sp.  With ``par.pp_axis``, ``n_microbatches`` must divide B_local
+    and the layer stack runs through the GPipe scheduler.
+    """
+    Tl = tokens.shape[1]
+    sp_idx = (lax.axis_index(par.sp_axis)
+              if par.sp_axis is not None else 0)
+    positions = (jnp.arange(Tl)[None, :] + sp_idx * Tl
+                 ).astype(jnp.int32) * jnp.ones_like(tokens)
+    h = params["embed"].astype(cfg.dtype)[tokens]
+    aux = jnp.float32(0.0)
+
+    if par.pp_axis is not None:
+        from ..parallel.pipeline import pipeline_apply
+        if cfg.n_experts > 0:
+            raise NotImplementedError(
+                "pipeline + MoE is not supported yet (the pipeline wire "
+                "format is shape-preserving and cannot carry aux losses)")
+        if n_microbatches <= 0:
+            raise ValueError("pipeline parallelism needs n_microbatches > 0")
+        B = h.shape[0]
+        if B % n_microbatches:
+            raise ValueError(
+                f"batch {B} not divisible by n_microbatches={n_microbatches}")
+        mb = B // n_microbatches
+        h_mb = h.reshape(n_microbatches, mb, *h.shape[1:])
+        # positions are identical for every batch row (pure function of the
+        # sp shard), so stages recompute them instead of wiring them through
+        pos_mb = (jnp.arange(Tl)[None, :] + sp_idx * Tl
+                  ).astype(jnp.int32) * jnp.ones((mb, 1), jnp.int32)
+
+        def stage_fn(stage_layers, x):
+            y, _aux = _layer_stack(x, stage_layers, cfg, par, pos_mb)
+            return y
+
+        out = pipeline_apply(stage_fn, params["layers"], h_mb,
+                             axis_name=par.pp_axis)
+        h = out.reshape(B, Tl, cfg.d_model)
+    else:
+        h, aux = _layer_stack(h, params["layers"], cfg, par, positions)
+
+    h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    # tied embedding head (Llama-3 unties; tying halves test-model memory
+    # and changes no parallel structure — the head matmul stays [D, V])
+    logits = h @ params["embed"].T.astype(h.dtype)
+    return logits, aux
+
+
+def loss_fn(params, tokens, targets, cfg: LlamaConfig, par: ParallelSpec,
+            n_microbatches: int = 0):
+    """Mean next-token cross-entropy over local tokens plus the MoE
+    load-balance auxiliary loss (caller pmeans over dp/sp axes)."""
+    logits, aux = forward(params, tokens, cfg, par, n_microbatches)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss = -ll.mean()
+    if cfg.n_experts > 0:
+        loss = loss + cfg.aux_loss_coef * aux / cfg.n_layers
+    return loss
+
+
+def count_params(cfg: LlamaConfig) -> int:
+    D, H, Hkv, Dh, F, L, V = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+                              cfg.head_dim, cfg.d_ff, cfg.n_layers,
+                              cfg.vocab_size)
+    per_layer = (2 * D + D * H * Dh + 2 * D * Hkv * Dh + H * Dh * D
+                 + 3 * D * F)
+    return V * D + L * per_layer + D

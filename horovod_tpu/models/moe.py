@@ -1,0 +1,123 @@
+"""Mixture-of-Experts layer with expert parallelism (ep mesh axis).
+
+Beyond-reference capability (SURVEY.md §2.9: the reference exposes the
+``alltoall`` primitive MoE routing needs but has no MoE layer).  This is
+the TPU-native GShard/Switch formulation: top-k routing with a static
+capacity (XLA needs static shapes, so overflow tokens drop), dispatch and
+combine as one-hot einsums (MXU-friendly), and expert placement over the
+``ep`` mesh axis — by default aliased onto ``dp``, the standard layout —
+with two tiled ``all_to_all`` exchanges per layer carrying tokens to their
+experts and back over ICI.
+
+Gradient calculus note (see training.py): expert weights are *sharded*
+over ep=dp, and the backward all_to_all already sums each expert's
+gradient contributions from every data shard, so expert-weight grads need
+scaling by 1/(dp·sp) instead of the replicated-param pmean.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def init_moe_layer_params(key, n_layers, d_model, d_ff, n_experts,
+                          param_dtype=jnp.float32):
+    """Stacked per-layer MoE params: router + per-expert SwiGLU weights."""
+    k = jax.random.split(key, 4)
+
+    def norm(key, shape, fan_in):
+        return jax.random.normal(key, shape, param_dtype) * (fan_in ** -0.5)
+
+    L, D, F, E = n_layers, d_model, d_ff, n_experts
+    return {
+        "router": norm(k[0], (L, D, E), D),
+        "we_gate": norm(k[1], (L, E, D, F), D),
+        "we_up": norm(k[2], (L, E, D, F), D),
+        "we_down": norm(k[3], (L, E, F, D), F),
+    }
+
+
+def _top_k_dispatch(gates, k, capacity):
+    """Build dispatch/combine tensors from gate probabilities.
+
+    gates: [N, E] softmax probabilities.  Returns
+    (dispatch [N, E, C] one-hot, combine [N, E, C] weighted, aux_loss).
+    GShard-style: k sequential top-1 selections, each with its own
+    position-in-expert cumsum offset by the previous choices' counts.
+    """
+    N, E = gates.shape
+    remaining = gates
+    counts = jnp.zeros((E,), jnp.int32)
+    dispatch = jnp.zeros((N, E, capacity), gates.dtype)
+    combine = jnp.zeros((N, E, capacity), gates.dtype)
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)                     # [N]
+        onehot = jax.nn.one_hot(idx, E, dtype=gates.dtype)       # [N, E]
+        pos = (jnp.cumsum(onehot, axis=0) - onehot
+               + counts[None, :]) * onehot                        # [N, E]
+        keep = (pos < capacity) * onehot
+        pos_oh = jax.nn.one_hot(
+            pos.sum(-1).astype(jnp.int32), capacity,
+            dtype=gates.dtype) * keep.sum(-1, keepdims=True)      # [N, C]
+        d = keep[:, :, None] * pos_oh[:, None, :]                 # [N, E, C]
+        dispatch = dispatch + d
+        combine = combine + d * (gates * onehot).sum(
+            -1, keepdims=True)[:, :, None]
+        counts = counts + onehot.sum(0).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)
+    # normalize combine weights over the selected experts
+    denom = combine.sum(axis=(1, 2), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    # load-balance auxiliary loss (Switch Transformer eq. 4)
+    frac_tokens = dispatch.sum(axis=(0, 2)) / jnp.maximum(
+        dispatch.sum(), 1.0)
+    frac_probs = gates.mean(axis=0)
+    aux = (frac_tokens * frac_probs).sum() * E
+    return dispatch, combine, aux
+
+
+def moe_layer(x, lp, cfg, par):
+    """One MoE sublayer.  x: [B, Tl, D]; lp: this layer's MoE params with
+    expert dim already ep-local ([E_local, D, F] …)."""
+    B, Tl, D = x.shape
+    N = B * Tl
+    E = cfg.n_experts
+    k = cfg.expert_top_k
+    ep_ax = par.ep_axis
+    ep = lax.axis_size(ep_ax) if ep_ax is not None else 1
+    El = lp["we_gate"].shape[0]           # experts held by this shard
+    if El * ep != E:
+        raise ValueError(f"experts {E} != ep({ep}) * local({El})")
+    capacity = int(np.ceil(k * N / E * cfg.capacity_factor))
+
+    tokens = x.reshape(N, D)
+    logits = tokens @ lp["router"].astype(x.dtype)                # [N, E]
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    dispatch, combine, aux = _top_k_dispatch(gates, k, capacity)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+
+    # dispatch tokens into per-expert slots: [E, C, D]
+    slots = jnp.einsum("nec,nd->ecd", dispatch, tokens)
+    if ep_ax is not None and ep > 1:
+        # experts → their owning shard; each expert gets ep*C slots
+        slots = lax.all_to_all(slots, ep_ax, split_axis=0, concat_axis=1,
+                               tiled=True)                        # [El, ep*C, D]
+    # expert FFN, batched over local experts (one big MXU einsum each)
+    gate = jax.nn.silu(jnp.einsum(
+        "ecd,edf->ecf", slots, lp["we_gate"].astype(x.dtype)))
+    up = jnp.einsum("ecd,edf->ecf", slots, lp["we_up"].astype(x.dtype))
+    out = jnp.einsum("ecf,efd->ecd", gate * up,
+                     lp["we_down"].astype(x.dtype))
+    if ep_ax is not None and ep > 1:
+        out = lax.all_to_all(out, ep_ax, split_axis=1, concat_axis=0,
+                             tiled=True)                          # [E, C, D]
+    # combine expert outputs back to token order
+    y = jnp.einsum("ecd,nec->nd", out, combine)
+    if par.tp_axis is not None:
+        # expert FFNs are also tp-column/row sharded → row reduction
+        y = lax.psum(y, par.tp_axis)
+    return y.reshape(B, Tl, D), aux.astype(jnp.float32)

@@ -1,0 +1,13 @@
+"""Multi-axis parallelism over TPU device meshes.
+
+Beyond-reference capability (SURVEY.md §2.9): the reference is DP-only; this
+package adds the parallelism families a modern TPU framework needs — tensor
+(tp), sequence/context (sp: ring attention + Ulysses), pipeline (pp), and
+expert (ep) — all expressed as mesh axes with XLA collectives over ICI.
+"""
+
+from .mesh import MeshConfig, ParallelMesh, make_mesh  # noqa: F401
+from .vma import as_varying  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
+from .pipeline import pipeline_apply  # noqa: F401

@@ -1,0 +1,116 @@
+"""Multi-axis device mesh management.
+
+The reference's only grouping concepts are MPI_COMM_WORLD plus process sets
+(``horovod/common/process_set.cc``).  On TPU, parallelism is expressed as a
+multi-dimensional ``jax.sharding.Mesh`` whose axes carry meaning:
+
+  * ``dp`` — data parallel (gradient psum; the reference's core capability)
+  * ``pp`` — pipeline parallel (stage-to-stage ppermute)
+  * ``sp`` — sequence/context parallel (ring attention / Ulysses)
+  * ``tp`` — tensor parallel (megatron-style column/row sharding)
+  * ``ep`` — expert parallel (MoE all_to_all routing)
+
+Axis order matters on hardware: the innermost axes get the
+fastest-wraparound ICI links, so tp (latency-bound, every layer) sits last
+and dp (bandwidth-bound, once per step, overlappable) first — the layout
+the scaling playbook prescribes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+AXIS_ORDER = ("dp", "pp", "sp", "tp")  # ep is aliased onto dp by default
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: Optional[int] = None  # None → experts sharded over the dp axis
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.pp * self.sp * self.tp
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {"dp": self.dp, "pp": self.pp, "sp": self.sp, "tp": self.tp}
+
+
+class ParallelMesh:
+    """A named multi-axis mesh plus convenience queries.
+
+    ``ep`` (expert parallel) is by default an *alias* of the dp axis — the
+    standard MoE layout where experts shard over data-parallel ranks and
+    tokens move via all_to_all on that axis — so no devices are wasted on a
+    separate axis unless requested.
+    """
+
+    def __init__(self, config: MeshConfig, devices: Optional[Sequence] = None):
+        self.config = config
+        devices = list(devices if devices is not None else jax.devices())
+        n = config.n_devices
+        if len(devices) < n:
+            raise ValueError(
+                f"mesh needs {n} devices ({config}), only "
+                f"{len(devices)} available")
+        shape = tuple(config.axis_sizes()[a] for a in AXIS_ORDER)
+        arr = np.array(devices[:n]).reshape(shape)
+        self.mesh = jax.sharding.Mesh(arr, AXIS_ORDER)
+        self.ep_axis = "ep" if config.ep else "dp"
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return self.mesh.axis_names
+
+    def axis_size(self, name: str) -> int:
+        if name == "ep":
+            return self.config.ep or self.config.dp
+        return self.config.axis_sizes()[name]
+
+    def __enter__(self):
+        self._ctx = self.mesh
+        return self.mesh.__enter__()
+
+    def __exit__(self, *a):
+        return self.mesh.__exit__(*a)
+
+
+def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None,
+              pp: int = 1, sp: int = 1, tp: int = 1,
+              devices: Optional[Sequence] = None) -> ParallelMesh:
+    """Build a ParallelMesh; ``dp`` defaults to whatever devices remain."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devices)
+    if dp is None:
+        denom = pp * sp * tp
+        if n % denom:
+            raise ValueError(f"{n} devices not divisible by pp*sp*tp={denom}")
+        dp = n // denom
+    return ParallelMesh(MeshConfig(dp=dp, pp=pp, sp=sp, tp=tp),
+                        devices=devices)
+
+
+def factor_mesh(n: int, want_pp: bool = True) -> MeshConfig:
+    """Factor ``n`` devices into a sensible (dp, pp, sp, tp) for dry runs.
+
+    Greedy: grow tp, then sp, then pp, then dp — each axis gets a factor of
+    2 while available, mirroring how real slices are carved.
+    """
+    sizes = {"dp": 1, "pp": 1, "sp": 1, "tp": 1}
+    order = ["tp", "sp", "pp", "dp"] if want_pp else ["tp", "sp", "dp"]
+    rem = n
+    for axis in order:
+        if rem % 2 == 0 and rem > 1:
+            sizes[axis] *= 2
+            rem //= 2
+    # remaining factor goes to dp
+    sizes["dp"] *= rem
+    return MeshConfig(**sizes)

@@ -1,0 +1,81 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over the pp axis.
+
+Beyond-reference capability (SURVEY.md §2.9: the reference has no PP).
+TPU-native design: each pp-mesh shard holds one stage's parameters
+(sharded ``P('pp')`` on the stacked stage dim); microbatches stream through
+the stages with stage-to-stage ``lax.ppermute`` hops over ICI inside one
+compiled program.  The schedule is the classic GPipe fill/steady/drain loop
+written as ``lax.scan`` — n_micro + n_stages - 1 ticks, bubble fraction
+(n_stages-1)/(n_micro+n_stages-1) — and the backward pipeline falls out of
+autodiff (the transpose of ppermute runs the ring backwards).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
+                   axis_name: str = "pp"):
+    """Run ``microbatches`` through a pipeline of identical-signature stages.
+
+    Args:
+      stage_fn: ``f(stage_params, x) -> y`` with ``y.shape == x.shape``
+        (the transformer-block case; stages must be shape-preserving so the
+        inter-stage wire format is fixed).
+      stage_params: this shard's stage parameters (use spec ``P('pp')`` on
+        the stacked leading dim outside, so each shard sees its own stage;
+        pass the already-unstacked local pytree here).
+      microbatches: ``[n_micro, mb, ...]`` input microbatches (replicated
+        across pp shards).
+      axis_name: the pipeline mesh axis.
+
+    Returns ``[n_micro, mb, ...]`` outputs, replicated across pp shards.
+    """
+    n_stages = lax.axis_size(axis_name)
+    if n_stages == 1:
+        return jax.vmap(lambda x: stage_fn(stage_params, x))(microbatches)
+
+    stage = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    total_ticks = n_micro + n_stages - 1
+    mb_shape = microbatches.shape[1:]
+    # send stage s → s+1 (no wraparound: last stage's send is discarded)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        incoming, outputs = carry
+        # stage 0 injects microbatch t (clamped during drain ticks);
+        # later stages consume what arrived from the previous stage
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        first_in = lax.dynamic_index_in_dim(
+            microbatches, mb_idx, axis=0, keepdims=False)
+        x = jnp.where(stage == 0, first_in, incoming)
+        y = stage_fn(stage_params, x)
+        # last stage retires microbatch t-(n_stages-1) (ignored while <0)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        live = t - (n_stages - 1) >= 0
+        retired = jnp.where(
+            jnp.logical_and(stage == n_stages - 1, live),
+            y, lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False))
+        outputs = lax.dynamic_update_index_in_dim(outputs, retired,
+                                                  out_idx, 0)
+        incoming = lax.ppermute(y, axis_name, perm)
+        return (incoming, outputs), None
+
+    from .vma import as_varying
+    # derive carries from the inputs (×0) so they inherit the inputs'
+    # varying axes, then add the pipeline axis (check_vma=True contract)
+    exemplar = jax.tree_util.tree_leaves(stage_params)[0]
+    incoming0 = as_varying(microbatches[0] * 0, axis_name, like=exemplar)
+    outputs0 = as_varying(microbatches * 0, axis_name, like=exemplar)
+    (_, outputs), _ = lax.scan(tick, (incoming0, outputs0),
+                               jnp.arange(total_ticks))
+    # outputs live on the last stage; replicate so every pp shard returns
+    # the same value (mask-and-psum broadcast over the pp ring)
+    mask = (stage == n_stages - 1).astype(outputs.dtype)
+    return lax.psum(outputs * mask, axis_name)

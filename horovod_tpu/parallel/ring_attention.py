@@ -1,0 +1,104 @@
+"""Ring attention: exact blockwise attention over a sequence-parallel axis.
+
+Beyond-reference capability (SURVEY.md §5.7 notes the reference has no
+long-context machinery; its only related primitive is alltoall).  This is
+the TPU-native form: the sequence is sharded over the ``sp`` mesh axis;
+each step of a ring schedule computes one query-block × key/value-block
+tile with an online-softmax accumulator while the K/V blocks rotate around
+the ICI ring via ``lax.ppermute`` — compute overlaps the neighbor exchange,
+total memory stays O(T/sp) per chip, and the result is *exact* attention
+(not an approximation).  Gradients flow through the loop by autodiff
+(the transpose of ppermute is the reverse rotation), with
+``jax.checkpoint`` on the per-step kernel to keep backward memory flat.
+
+Use inside ``shard_map`` with the sequence axis in scope; plain jnp
+fallback when the axis size is 1.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, m, l, o, q_blk, kv_blk, t_local, causal, scale):
+    """One tile: scores q·k with causal masking by global block position,
+    folded into the (m, l, o) online-softmax accumulator.  fp32 accumulate
+    regardless of input dtype (MXU-native bf16 inputs are fine)."""
+    # q: [B, Tq, H, D], k/v: [B, Tk, H, D]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq = jnp.arange(t_local)[:, None] + q_blk * t_local
+        tk = jnp.arange(t_local)[None, :] + kv_blk * t_local
+        s = jnp.where((tk <= tq)[None, None], s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))            # [B, H, Tq]
+    p = jnp.exp(s - m_new[..., None])                  # [B, H, Tq, Tk]
+    corr = jnp.exp(m - m_new)                          # [B, H, Tq]
+    l_new = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name: Optional[str] = None,
+                   causal: bool = True, sm_scale: Optional[float] = None):
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    Args:
+      q, k, v: ``[batch, t_local, heads, head_dim]`` — the local sequence
+        shard.  (GQA callers repeat k/v heads before calling.)
+      axis_name: the sp mesh axis; ``None`` (or size 1) → single-shard path.
+      causal: apply a causal mask using *global* token positions.
+      sm_scale: softmax scale; default ``1/sqrt(head_dim)``.
+
+    Returns ``[batch, t_local, heads, head_dim]`` in q's dtype.
+    """
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    n = lax.axis_size(axis_name) if axis_name is not None else 1
+    B, Tl, H, D = q.shape
+
+    if n == 1:
+        m = jnp.full((B, H, Tl), NEG_INF, jnp.float32)
+        l = jnp.zeros((B, H, Tl), jnp.float32)
+        o = jnp.zeros((B, Tl, H, D), jnp.float32)
+        m, l, o = _block_attend(q, k, v, m, l, o, 0, 0, Tl, causal, scale)
+        return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+    my_blk = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    attend = jax.checkpoint(
+        functools.partial(_block_attend, t_local=Tl, causal=causal,
+                          scale=scale))
+
+    def step(carry, s):
+        m, l, o, ck, cv = carry
+        kv_blk = (my_blk - s) % n  # whose block we hold after s rotations
+        m, l, o = attend(q, ck, cv, m, l, o, my_blk, kv_blk)
+        # rotate k/v around the ICI ring (skipped result on last step is
+        # dead code XLA drops)
+        ck = lax.ppermute(ck, axis_name, perm)
+        cv = lax.ppermute(cv, axis_name, perm)
+        return (m, l, o, ck, cv), None
+
+    from .vma import as_varying
+    # derive accumulators from q (×0) so they inherit q's varying axes
+    # (dp/tp/…), then add the ring axis — scan carries must match the body
+    # output's VMA exactly under check_vma=True
+    zero_bht = (q[:, :, :, 0].transpose(0, 2, 1) * 0).astype(jnp.float32)
+    m0 = zero_bht + NEG_INF
+    l0 = zero_bht
+    o0 = (q * 0).astype(jnp.float32)
+    m0, l0, o0 = as_varying((m0, l0, o0), axis_name, like=k)
+    (m, l, o, _, _), _ = lax.scan(
+        step, (m0, l0, o0, k, v), jnp.arange(n))
+    # causal guarantees every query attends at least to itself → l > 0
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)

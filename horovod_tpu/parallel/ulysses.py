@@ -1,0 +1,66 @@
+"""Ulysses-style sequence parallelism: all_to_all head scatter.
+
+Beyond-reference capability (SURVEY.md §5.7): the alternative long-context
+scheme — instead of rotating K/V blocks (ring attention), one all_to_all
+re-shards the activations from sequence-sharded to head-sharded, each chip
+computes *full-sequence* attention for its subset of heads, and a second
+all_to_all restores sequence sharding.  Two collectives per attention call
+vs. ring's n-step rotation: cheaper when heads ≥ sp and the sequence fits
+per-chip once gathered per-head; ring wins at extreme lengths.  The
+reference's ``hvd.alltoall`` is exactly the primitive this builds on.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def seq_to_heads(x, axis_name: str):
+    """[B, T/sp, H, D] seq-sharded → [B, T, H/sp, D] head-sharded.
+
+    One tiled all_to_all: head-chunk j goes to chip j; the received
+    sequence blocks concatenate in source order along the time dim.
+    """
+    n = lax.axis_size(axis_name)
+    H = x.shape[2]
+    if H % n:
+        raise ValueError(f"heads {H} not divisible by sp={n}")
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def heads_to_seq(x, axis_name: str):
+    """Inverse of seq_to_heads: [B, T, H/sp, D] → [B, T/sp, H, D]."""
+    n = lax.axis_size(axis_name)
+    T = x.shape[1]
+    if T % n:
+        raise ValueError(f"sequence {T} not divisible by sp={n}")
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, axis_name: Optional[str] = None,
+                      attn_fn: Optional[Callable] = None,
+                      causal: bool = True,
+                      sm_scale: Optional[float] = None):
+    """Attention over a seq-sharded input via head scatter.
+
+    q/k/v: ``[B, T/sp, H, D]`` sequence-sharded.  ``attn_fn(q, k, v)`` runs
+    full attention on head-sharded tensors; defaults to the single-shard
+    path of :func:`ring_attention` (exact softmax attention).
+    """
+    from .ring_attention import ring_attention
+    if attn_fn is None:
+        def attn_fn(q, k, v):
+            return ring_attention(q, k, v, axis_name=None, causal=causal,
+                                  sm_scale=sm_scale)
+    if axis_name is None or lax.axis_size(axis_name) == 1:
+        return attn_fn(q, k, v)
+    qh = seq_to_heads(q, axis_name)
+    kh = seq_to_heads(k, axis_name)
+    vh = seq_to_heads(v, axis_name)
+    oh = attn_fn(qh, kh, vh)
+    return heads_to_seq(oh, axis_name)

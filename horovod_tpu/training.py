@@ -1,0 +1,188 @@
+"""Train-step assembly: the full SPMD training program over a ParallelMesh.
+
+This is where the framework's layers meet: the model forward (models/),
+the parallel axes (parallel/), and the fused distributed gradient
+reduction (optim/) compose into ONE jit-compiled shard_map program per
+step — the TPU-native replacement for the reference's
+DistributedOptimizer-around-autograd architecture (SURVEY.md §3.3), with
+the gradient bucket fusion happening inside the compiled program where XLA
+overlaps it with the backward pass.
+
+Gradient reduction: the step runs under ``check_vma=True``, so JAX's
+transpose rules insert the correct cross-shard psums for every parameter
+automatically (replicated params get their partial gradients summed over
+tp/pp/sp/dp as needed; sharded params stay local).  What remains for us is
+the loss-averaging normalization — a uniform 1/(dp·sp) — and XLA's
+all-reduce combiner batches the inserted psums into fused transfers (the
+reference's fusion buffer as a compiler pass).  See reduce_grads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .models import llama as llama_mod
+from .models.llama import LlamaConfig, ParallelSpec
+from .parallel.mesh import ParallelMesh
+
+
+@dataclasses.dataclass
+class TrainStep:
+    """A compiled training step plus its sharding contract."""
+    step_fn: Callable            # (params, opt_state, tokens, targets) -> ...
+    init_fn: Callable            # (rng) -> (params, opt_state) [sharded]
+    par: ParallelSpec
+    mesh: Any
+    data_spec: Any               # PartitionSpec for token batches
+    param_sharding: Any          # pytree of NamedSharding
+
+
+def opt_state_partition_specs(opt_state_shape, param_shapes, pspec_tree):
+    """PartitionSpecs for an optax state: any subtree structurally identical
+    to the params (adam mu/nu, momentum buffers, …) inherits the param
+    specs; everything else (counters, scalars) is replicated."""
+    pdef = jax.tree_util.tree_structure(param_shapes)
+
+    def is_param_tree(x):
+        try:
+            return jax.tree_util.tree_structure(x) == pdef
+        except Exception:  # noqa: BLE001 - non-pytree nodes
+            return False
+
+    return jax.tree_util.tree_map(
+        lambda sub: pspec_tree if is_param_tree(sub) else P(),
+        opt_state_shape, is_leaf=is_param_tree)
+
+
+def _axis_or_none(pmesh: ParallelMesh, name: str) -> Optional[str]:
+    return name if pmesh.config.axis_sizes()[name] > 1 else None
+
+
+def make_llama_parallel_spec(pmesh: ParallelMesh, attn: str = "ring",
+                             use_ep: bool = False) -> ParallelSpec:
+    return ParallelSpec(
+        dp_axis=_axis_or_none(pmesh, "dp"),
+        tp_axis=_axis_or_none(pmesh, "tp"),
+        sp_axis=_axis_or_none(pmesh, "sp"),
+        pp_axis=_axis_or_none(pmesh, "pp"),
+        ep_axis=(_axis_or_none(pmesh, "dp") if use_ep else None),
+        attn=attn)
+
+
+def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
+                          optimizer: Optional[optax.GradientTransformation]
+                          = None,
+                          attn: str = "ring",
+                          n_microbatches: int = 0,
+                          fusion_threshold: Optional[int] = None
+                          ) -> TrainStep:
+    """Build the full data/tensor/sequence/pipeline/expert-parallel step."""
+    par = make_llama_parallel_spec(pmesh, attn, use_ep=cfg.n_experts > 0)
+    mesh = pmesh.mesh
+    opt = optimizer if optimizer is not None else optax.adamw(3e-4)
+    tp = pmesh.config.tp
+    pp = pmesh.config.pp
+    dp = pmesh.config.dp
+    sp = pmesh.config.sp
+    if cfg.n_experts > 0 and cfg.n_experts % dp:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} must divide over ep=dp={dp}")
+    if tp > 1 and (cfg.n_heads % tp or cfg.n_kv_heads % tp
+                   or cfg.d_ff % tp):
+        raise ValueError(
+            f"n_heads={cfg.n_heads}, n_kv_heads={cfg.n_kv_heads} and "
+            f"d_ff={cfg.d_ff} must all be divisible by tp={tp}")
+    if pp > 1 and cfg.n_layers % pp:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} must be divisible by pp={pp}")
+
+    specs = llama_mod.param_specs(par, cfg)
+    param_sharding = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    # data: batch over dp, sequence over sp
+    data_spec = P(par.dp_axis, par.sp_axis)
+
+    def reduce_grads(grads):
+        # The step's shard_map runs with check_vma=True, so JAX's transpose
+        # rules already insert the correct cross-shard psums: for every
+        # mesh axis a parameter is replicated over, its gradient arrives as
+        # Σ_shards ∂L_shard/∂θ (this is also what makes tp/pp gradients
+        # correct — with the check off they come out ×tp·pp, a bug this
+        # framework hit; see tests/test_llama.py SGD equivalence).  The
+        # auto-inserted psums are small per-parameter all-reduces that
+        # XLA's all-reduce combiner batches into fused transfers — the
+        # reference's fusion buffer realized as a compiler pass.
+        #
+        # dp and sp are loss-averaging axes (each shard's local_loss is the
+        # mean over its own tokens), so the summed gradient only needs a
+        # uniform 1/(dp·sp): the same rule covers dense (replicated) and
+        # MoE expert (dp-sharded, backward-all_to_all-summed) parameters.
+        scale = 1.0 / (dp * sp)
+        if scale == 1.0:
+            return grads
+        return jax.tree_util.tree_map(
+            lambda g: g * jnp.asarray(scale, g.dtype), grads)
+
+    def local_loss(params, tokens, targets):
+        loss = llama_mod.loss_fn(params, tokens, targets, cfg, par,
+                                 n_microbatches)
+        if par.pp_axis is not None:
+            # only the last stage's loss is real; broadcast it over pp so
+            # every shard (and the grads of shared leaves) agree
+            is_last = lax.axis_index(par.pp_axis) == pp - 1
+            loss = lax.psum(jnp.where(is_last, loss, 0.0), par.pp_axis)
+        return loss
+
+    def shard_step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(local_loss)(params, tokens, targets)
+        grads = reduce_grads(grads)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        for ax in (par.dp_axis, par.sp_axis):
+            if ax is not None:
+                loss = lax.pmean(loss, ax)
+        if par.tp_axis is not None:
+            loss = lax.pmean(loss, par.tp_axis)
+        return params, opt_state, loss
+
+    pspec_tree = specs
+    param_shapes = jax.eval_shape(
+        partial(llama_mod.init_params, cfg, tp=1), jax.random.PRNGKey(0))
+    opt_state_shape = jax.eval_shape(lambda p: opt.init(p), param_shapes)
+    opt_specs = opt_state_partition_specs(
+        opt_state_shape, param_shapes, pspec_tree)
+    opt_sharding = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), opt_specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+    # donate params/opt_state: the updated pytrees reuse the same HBM,
+    # halving peak memory and avoiding a full copy per step
+    step_fn = jax.jit(jax.shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(pspec_tree, opt_specs, data_spec, data_spec),
+        out_specs=(pspec_tree, opt_specs, P()),
+        check_vma=True), donate_argnums=(0, 1))
+
+    def init_fn(rng):
+        params = jax.jit(
+            partial(llama_mod.init_params, cfg, tp=1),
+            out_shardings=param_sharding)(rng)
+        opt_state = jax.jit(
+            opt.init, out_shardings=opt_sharding)(params)
+        return params, opt_state
+
+    return TrainStep(step_fn=step_fn, init_fn=init_fn, par=par, mesh=mesh,
+                     data_spec=data_spec, param_sharding=param_sharding)
+
+
+def make_data_sharding(ts: TrainStep):
+    return NamedSharding(ts.mesh, ts.data_spec)
