@@ -88,6 +88,10 @@ class Config:
     rendezvous_addr: Optional[str] = None
     rendezvous_port: Optional[int] = None
     controller: Optional[str] = None
+    # explicit process topology from the hvdrun launcher (one JAX process
+    # may drive many chips, so process count != worker count in general)
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
     # --- TPU-specific additions ---
     # mesh axis name used for the data-parallel worker axis
     worker_axis: str = "workers"
@@ -140,6 +144,10 @@ class Config:
         port = _env_int("HOROVOD_GLOO_RENDEZVOUS_PORT", -1)
         c.rendezvous_port = None if port < 0 else port
         c.controller = _env_str("HOROVOD_CONTROLLER", c.controller)
+        c.num_processes = _env_int("HOROVOD_NUM_PROCESSES", -1)
+        c.num_processes = None if c.num_processes < 0 else c.num_processes
+        c.process_id = _env_int("HOROVOD_PROCESS_ID", -1)
+        c.process_id = None if c.process_id < 0 else c.process_id
         c.use_native_core = _env_bool(
             "HOROVOD_TPU_NATIVE_CORE", c.use_native_core)
         c.hierarchical_allreduce = _env_bool(

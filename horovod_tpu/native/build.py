@@ -1,0 +1,80 @@
+"""In-tree builder for the native core extension.
+
+Compiles ``core.cpp`` into ``_hvd_core`` next to this file.  Safe to call
+from multiple processes concurrently (the launcher spawns several workers
+that may all trigger a first-use build): an fcntl file lock serializes the
+build, and losers of the race pick up the winner's artifact.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import sysconfig
+
+logger = logging.getLogger("horovod_tpu")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "core.cpp")
+
+
+def _ext_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_HERE, "_hvd_core" + suffix)
+
+
+def built() -> bool:
+    out = _ext_path()
+    return (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(_SRC))
+
+
+def build(timeout: float = 300.0) -> bool:
+    """Compile the extension; returns True on success."""
+    if built():
+        return True
+    lock_path = os.path.join(_HERE, ".build.lock")
+    with open(lock_path, "w") as lock_f:
+        import fcntl
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+        try:
+            if built():  # another process won the race
+                return True
+            return _compile(timeout)
+        finally:
+            fcntl.flock(lock_f, fcntl.LOCK_UN)
+
+
+def _compile(timeout: float) -> bool:
+    include = sysconfig.get_paths()["include"]
+    out = _ext_path()
+    obj = os.path.join(_HERE, "core.o")
+    cxx = os.environ.get("CXX", "g++")
+    try:
+        subprocess.run(
+            [cxx, "-std=c++17", "-O2", "-fPIC", "-fvisibility=hidden",
+             f"-I{include}", "-c", _SRC, "-o", obj],
+            check=True, capture_output=True, timeout=timeout)
+        subprocess.run(
+            [cxx, "-shared", obj, "-o", out],
+            check=True, capture_output=True, timeout=timeout)
+        logger.info("built native core: %s", out)
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError) as exc:
+        stderr = getattr(exc, "stderr", b"") or b""
+        logger.warning("native core build failed (%s); falling back to the "
+                       "Python control plane.\n%s", exc,
+                       stderr.decode(errors="replace"))
+        return False
+    finally:
+        if os.path.exists(obj):
+            os.unlink(obj)
+
+
+if __name__ == "__main__":
+    ok = build()
+    print("built" if ok else "FAILED", _ext_path())
+    sys.exit(0 if ok else 1)

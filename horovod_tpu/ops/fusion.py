@@ -180,8 +180,8 @@ def get_planner(cfg):
             from ..native import loader
             core = loader.load()
             if core is not None:
-                return core.plan_fusion_sigs, ResponseCache(
-                    cfg.cache_capacity)
+                return (core.plan_fusion_sigs,
+                        core.ResponseCache(cfg.cache_capacity))
         except Exception:  # noqa: BLE001 - fall back to Python planner
             pass
     cap = cfg.cache_capacity if cfg is not None else 1024

@@ -26,6 +26,10 @@ import jax
 import numpy as np
 
 AXIS_ORDER = ("dp", "pp", "sp", "tp")  # ep is aliased onto dp by default
+# With a dedicated expert axis, ep sits between sp and tp: all_to_all token
+# routing is bandwidth-bound but per-layer, so it deserves faster links than
+# dp/pp, while tp (latency-bound matmul collectives) keeps the innermost ring.
+AXIS_ORDER_EP = ("dp", "pp", "sp", "ep", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,10 +42,13 @@ class MeshConfig:
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.pp * self.sp * self.tp
+        return self.dp * self.pp * self.sp * self.tp * (self.ep or 1)
 
     def axis_sizes(self) -> Dict[str, int]:
-        return {"dp": self.dp, "pp": self.pp, "sp": self.sp, "tp": self.tp}
+        sizes = {"dp": self.dp, "pp": self.pp, "sp": self.sp, "tp": self.tp}
+        if self.ep:
+            sizes["ep"] = self.ep
+        return sizes
 
 
 class ParallelMesh:
@@ -61,9 +68,10 @@ class ParallelMesh:
             raise ValueError(
                 f"mesh needs {n} devices ({config}), only "
                 f"{len(devices)} available")
-        shape = tuple(config.axis_sizes()[a] for a in AXIS_ORDER)
+        axes = AXIS_ORDER_EP if config.ep else AXIS_ORDER
+        shape = tuple(config.axis_sizes()[a] for a in axes)
         arr = np.array(devices[:n]).reshape(shape)
-        self.mesh = jax.sharding.Mesh(arr, AXIS_ORDER)
+        self.mesh = jax.sharding.Mesh(arr, axes)
         self.ep_axis = "ep" if config.ep else "dp"
 
     @property
@@ -71,8 +79,8 @@ class ParallelMesh:
         return self.mesh.axis_names
 
     def axis_size(self, name: str) -> int:
-        if name == "ep":
-            return self.config.ep or self.config.dp
+        if name == "ep" and self.config.ep is None:
+            return self.config.dp  # aliased onto dp
         return self.config.axis_sizes()[name]
 
     def __enter__(self):

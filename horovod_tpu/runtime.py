@@ -233,18 +233,47 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
                 "horovod_tpu.init(comm=...) with a custom communicator is not "
                 "supported on TPU; use process_sets for sub-groups.")
         cfg = Config.from_env()
-        _STATE.config = cfg
         _setup_logging(cfg)
+
+        # Elastic: under the elastic driver, the env-var assignment is only
+        # the initial one — pull the CURRENT epoch's assignment (rank/size/
+        # coordinator) so re-init after a membership change re-rendezvouses
+        # into the new world (reference: elastic rendezvous re-query, §3.5).
+        if cfg.elastic:
+            from .elastic.worker import fetch_assignment
+            asg = fetch_assignment()
+            if asg is not None:
+                cfg.rank = asg["rank"]
+                cfg.size = asg["size"]
+                cfg.local_rank = asg["local_rank"]
+                cfg.local_size = asg["local_size"]
+                cfg.cross_rank = asg["cross_rank"]
+                cfg.cross_size = asg["cross_size"]
+                cfg.rendezvous_addr = asg["coordinator_addr"]
+                cfg.rendezvous_port = asg["coordinator_port"]
+                cfg.num_processes = asg["size"]
+                cfg.process_id = asg["rank"]
+        _STATE.config = cfg
 
         # Multi-process rendezvous via the JAX coordination service (the
         # TPU-native replacement for MPI/Gloo rendezvous, SURVEY.md §5.8).
-        if cfg.size is not None and cfg.size > 1 and cfg.rendezvous_addr:
+        # Process count/id resolution: prefer the launcher's explicit
+        # HOROVOD_NUM_PROCESSES/PROCESS_ID; fall back to the cross_* vars
+        # (one process per host driving all its chips) and finally to
+        # rank/size (one process per worker).
+        n_procs = cfg.num_processes or cfg.cross_size or cfg.size
+        if n_procs is not None and n_procs > 1 and cfg.rendezvous_addr:
             coordinator = f"{cfg.rendezvous_addr}:{cfg.rendezvous_port or 9999}"
+            if cfg.process_id is not None:
+                proc_id = cfg.process_id
+            elif cfg.num_processes is None and cfg.cross_rank is not None:
+                proc_id = cfg.cross_rank
+            else:
+                proc_id = cfg.rank
             jax.distributed.initialize(
                 coordinator_address=coordinator,
-                num_processes=cfg.cross_size or cfg.size,
-                process_id=cfg.cross_rank
-                if cfg.cross_rank is not None else cfg.rank,
+                num_processes=n_procs,
+                process_id=proc_id,
             )
             _STATE.owns_jax_distributed = True
 
@@ -269,11 +298,13 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
         from .timeline import Timeline
         from .stall import StallInspector
         _STATE.timeline = Timeline(
-            cfg.timeline_path, mark_cycles=cfg.timeline_mark_cycles)
+            cfg.timeline_path, mark_cycles=cfg.timeline_mark_cycles,
+            use_native=cfg.use_native_core)
         _STATE.stall_inspector = StallInspector(
             check_time=cfg.stall_check_time,
             shutdown_time=cfg.stall_shutdown_time,
-            disabled=cfg.stall_check_disable)
+            disabled=cfg.stall_check_disable,
+            use_native=cfg.use_native_core)
 
         if cfg.autotune:
             from .autotune import ParameterManager

@@ -25,24 +25,41 @@ logger = logging.getLogger("horovod_tpu")
 
 class StallInspector:
     def __init__(self, check_time: float = 60.0, shutdown_time: float = 0.0,
-                 disabled: bool = False):
+                 disabled: bool = False, use_native: bool = True):
         self.check_time = check_time
         self.shutdown_time = shutdown_time
         self.disabled = disabled or check_time <= 0
         self._pending: Dict[str, float] = {}
         self._warned: Dict[str, float] = {}
         self.warnings_issued = 0
+        # Native bookkeeping (reference: stall_inspector.cc) when built.
+        self._native = None
+        if not self.disabled and use_native:
+            try:
+                from .native import loader
+                core = loader.load()
+                if core is not None:
+                    self._native = core.StallTracker(
+                        check_time=check_time, shutdown_time=shutdown_time)
+            except Exception:  # noqa: BLE001 - Python fallback
+                self._native = None
 
     def record_enqueue(self, name: str, t: float):
         if self.disabled:
             return
-        self._pending.setdefault(name, t)
+        if self._native is not None:
+            self._native.record_enqueue(name, t)
+        else:
+            self._pending.setdefault(name, t)
 
     def record_complete(self, name: str):
         if self.disabled:
             return
-        self._pending.pop(name, None)
-        self._warned.pop(name, None)
+        if self._native is not None:
+            self._native.record_complete(name)
+        else:
+            self._pending.pop(name, None)
+            self._warned.pop(name, None)
 
     def check(self, now: float = None):
         """Scan pending tensors; warn on stalls, raise past the shutdown bar.
@@ -53,6 +70,16 @@ class StallInspector:
         if self.disabled:
             return
         now = time.monotonic() if now is None else now
+        if self._native is not None:
+            stalled, shutdown = self._native.check(now)
+            if shutdown is not None:
+                name, age = shutdown
+                raise StallError(
+                    f"tensor {name} stalled for {age:.0f}s, past "
+                    f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
+                    f"{self.shutdown_time:.0f}; aborting")
+            self._warn(stalled)
+            return
         stalled = []
         for name, t0 in self._pending.items():
             age = now - t0
@@ -64,12 +91,16 @@ class StallInspector:
                     f"tensor {name} stalled for {age:.0f}s, past "
                     f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
                     f"{self.shutdown_time:.0f}; aborting")
-        if stalled:
-            self.warnings_issued += 1
-            names = ", ".join(f"{n} ({a:.0f}s)" for n, a in stalled)
-            logger.warning(
-                "One or more tensors were submitted to be reduced/gathered "
-                "but were not dispatched for over %.0f seconds: [%s]. This "
-                "usually means a participating process has stopped feeding "
-                "the same program (the SPMD analog of missing ranks).",
-                self.check_time, names)
+        self._warn(stalled)
+
+    def _warn(self, stalled):
+        if not stalled:
+            return
+        self.warnings_issued += 1
+        names = ", ".join(f"{n} ({a:.0f}s)" for n, a in stalled)
+        logger.warning(
+            "One or more tensors were submitted to be reduced/gathered "
+            "but were not dispatched for over %.0f seconds: [%s]. This "
+            "usually means a participating process has stopped feeding "
+            "the same program (the SPMD analog of missing ranks).",
+            self.check_time, names)

@@ -26,8 +26,11 @@ from typing import List, Optional
 
 
 class Timeline:
-    def __init__(self, path: Optional[str], mark_cycles: bool = False):
+    def __init__(self, path: Optional[str], mark_cycles: bool = False,
+                 use_native: bool = True):
         self._path = None
+        self._native = None
+        self._use_native = use_native
         self._mark_cycles = mark_cycles
         self._queue: "queue.Queue" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
@@ -48,8 +51,26 @@ class Timeline:
         self.close()
         self._path = path
         self._mark_cycles = mark_cycles
-        self._file = open(path, "w")
-        self._file.write("[\n")
+        # Prefer the native writer (reference: timeline.cc TimelineWriter —
+        # file I/O on a dedicated C++ thread).  Either way the hot path only
+        # enqueues the event dict; serialization happens on the Python
+        # writer thread, which hands JSON lines to the native queue or
+        # writes them to the file directly.
+        core = None
+        if self._use_native:
+            try:
+                from .native import loader
+                core = loader.load()
+            except Exception:  # noqa: BLE001
+                core = None
+        if core is not None:
+            try:
+                self._native = core.TimelineWriter(path)
+            except OSError:
+                self._native = None
+        if self._native is None:
+            self._file = open(path, "w")
+            self._file.write("[\n")
         self._first = True
         self._stop = False
         self._thread = threading.Thread(
@@ -57,15 +78,19 @@ class Timeline:
         self._thread.start()
 
     def close(self):
-        if self._file is None:
+        if self._file is None and self._native is None:
             return
         self._queue.put(None)
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
-        self._file.write("\n]\n")
-        self._file.close()
-        self._file = None
+        if self._native is not None:
+            self._native.close()
+            self._native = None
+        if self._file is not None:
+            self._file.write("\n]\n")
+            self._file.close()
+            self._file = None
         self._path = None
 
     # -- event API (called from the engine) ---------------------------------
@@ -133,7 +158,7 @@ class Timeline:
                     "args": {"cycle": cycle}})
 
     def _emit(self, event: dict):
-        if self._file is not None:
+        if self._native is not None or self._file is not None:
             self._queue.put(event)
 
     def _writer_loop(self):
@@ -141,9 +166,16 @@ class Timeline:
             ev = self._queue.get()
             if ev is None:
                 return
+            s = json.dumps(ev)
+            native, f = self._native, self._file
+            if native is not None:
+                native.write(s)  # no-op after native close
+                continue
+            if f is None:
+                return  # closed out from under us (join timed out)
             prefix = "" if self._first else ",\n"
             self._first = False
             try:
-                self._file.write(prefix + json.dumps(ev))
+                f.write(prefix + s)
             except ValueError:
                 return  # file closed

@@ -68,12 +68,19 @@ def _axis_or_none(pmesh: ParallelMesh, name: str) -> Optional[str]:
 
 def make_llama_parallel_spec(pmesh: ParallelMesh, attn: str = "ring",
                              use_ep: bool = False) -> ParallelSpec:
+    # Experts shard over pmesh.ep_axis: the dedicated "ep" axis when
+    # MeshConfig.ep is set, else aliased onto dp (mesh.py).  Either way the
+    # batch is sharded over that axis too (see data_spec below), so the MoE
+    # all_to_all routes distinct tokens between expert shards.
+    ep = pmesh.ep_axis if use_ep else None
+    if ep is not None and pmesh.axis_size(ep) <= 1:
+        ep = None
     return ParallelSpec(
         dp_axis=_axis_or_none(pmesh, "dp"),
         tp_axis=_axis_or_none(pmesh, "tp"),
         sp_axis=_axis_or_none(pmesh, "sp"),
         pp_axis=_axis_or_none(pmesh, "pp"),
-        ep_axis=(_axis_or_none(pmesh, "dp") if use_ep else None),
+        ep_axis=ep,
         attn=attn)
 
 
@@ -81,9 +88,7 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
                           optimizer: Optional[optax.GradientTransformation]
                           = None,
                           attn: str = "ring",
-                          n_microbatches: int = 0,
-                          fusion_threshold: Optional[int] = None
-                          ) -> TrainStep:
+                          n_microbatches: int = 0) -> TrainStep:
     """Build the full data/tensor/sequence/pipeline/expert-parallel step."""
     par = make_llama_parallel_spec(pmesh, attn, use_ep=cfg.n_experts > 0)
     mesh = pmesh.mesh
@@ -92,9 +97,15 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
     pp = pmesh.config.pp
     dp = pmesh.config.dp
     sp = pmesh.config.sp
-    if cfg.n_experts > 0 and cfg.n_experts % dp:
-        raise ValueError(
-            f"n_experts={cfg.n_experts} must divide over ep=dp={dp}")
+    # a dedicated ep axis multiplies the data-parallel degree (experts shard
+    # over it; everything else treats it as extra dp)
+    ep_dedicated = pmesh.config.ep or 1
+    if cfg.n_experts > 0 and par.ep_axis is not None:
+        ep_size = pmesh.axis_size(par.ep_axis)
+        if cfg.n_experts % ep_size:
+            raise ValueError(
+                f"n_experts={cfg.n_experts} must divide over "
+                f"{par.ep_axis}={ep_size}")
     if tp > 1 and (cfg.n_heads % tp or cfg.n_kv_heads % tp
                    or cfg.d_ff % tp):
         raise ValueError(
@@ -108,8 +119,14 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
     param_sharding = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P))
-    # data: batch over dp, sequence over sp
-    data_spec = P(par.dp_axis, par.sp_axis)
+    # data: batch over dp (and the dedicated ep axis, which acts as extra
+    # data parallelism for non-expert compute), sequence over sp
+    if ep_dedicated > 1 and par.ep_axis == "ep":
+        batch_axes = tuple(a for a in (par.dp_axis, "ep") if a is not None)
+        data_spec = P(batch_axes if len(batch_axes) > 1 else batch_axes[0],
+                      par.sp_axis)
+    else:
+        data_spec = P(par.dp_axis, par.sp_axis)
 
     def reduce_grads(grads):
         # The step's shard_map runs with check_vma=True, so JAX's transpose
@@ -122,11 +139,12 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
         # XLA's all-reduce combiner batches into fused transfers — the
         # reference's fusion buffer realized as a compiler pass.
         #
-        # dp and sp are loss-averaging axes (each shard's local_loss is the
+        # dp, sp — and a dedicated ep axis, which carries extra batch
+        # shards — are loss-averaging axes (each shard's local_loss is the
         # mean over its own tokens), so the summed gradient only needs a
-        # uniform 1/(dp·sp): the same rule covers dense (replicated) and
-        # MoE expert (dp-sharded, backward-all_to_all-summed) parameters.
-        scale = 1.0 / (dp * sp)
+        # uniform 1/(dp·sp·ep): the same rule covers dense (replicated) and
+        # MoE expert (ep-sharded, backward-all_to_all-summed) parameters.
+        scale = 1.0 / (dp * sp * ep_dedicated)
         if scale == 1.0:
             return grads
         return jax.tree_util.tree_map(
@@ -147,11 +165,12 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
         grads = reduce_grads(grads)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        for ax in (par.dp_axis, par.sp_axis):
+        loss_axes = [par.dp_axis, par.sp_axis, par.tp_axis]
+        if ep_dedicated > 1:
+            loss_axes.append("ep")
+        for ax in loss_axes:
             if ax is not None:
                 loss = lax.pmean(loss, ax)
-        if par.tp_axis is not None:
-            loss = lax.pmean(loss, par.tp_axis)
         return params, opt_state, loss
 
     pspec_tree = specs

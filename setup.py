@@ -1,0 +1,38 @@
+"""Build for horovod_tpu, including the native core extension.
+
+The reference builds its C++ core per-framework via a CMake superbuild
+(reference: setup.py + CMakeLists.txt, SURVEY.md §2.1 "Build system").  On
+TPU there is exactly one framework ABI (CPython), so a single setuptools
+Extension suffices: ``horovod_tpu.native._hvd_core`` holds the control-plane
+hot paths (fusion planner, response cache, timeline writer, stall tracker).
+
+Build in place with::
+
+    python setup.py build_ext --inplace
+
+or let ``horovod_tpu.native.loader`` build it on first use.
+"""
+
+from setuptools import Extension, find_packages, setup
+
+ext = Extension(
+    "horovod_tpu.native._hvd_core",
+    sources=["horovod_tpu/native/core.cpp"],
+    language="c++",
+    extra_compile_args=["-std=c++17", "-O2", "-fvisibility=hidden"],
+)
+
+setup(
+    name="horovod_tpu",
+    version="0.1.0",
+    description="TPU-native distributed training framework "
+                "(capability rebuild of Horovod)",
+    packages=find_packages(exclude=("tests", "tests.*")),
+    ext_modules=[ext],
+    entry_points={
+        "console_scripts": [
+            "hvdrun = horovod_tpu.runner.launch:main",
+        ],
+    },
+    python_requires=">=3.10",
+)

@@ -88,6 +88,26 @@ def test_moe_expert_parallel_tracks_baseline(hvd):
     np.testing.assert_allclose(ep, base, atol=5e-2)
 
 
+def test_moe_dedicated_ep_axis_tracks_baseline(hvd):
+    """MeshConfig.ep creates a real expert axis: batch shards over dp×ep,
+    experts over ep; must track the single-shard baseline like aliased ep."""
+    cfg = dataclasses.replace(CFG, n_experts=4, expert_top_k=2,
+                              capacity_factor=2.0)
+    base = run_steps(cfg, MeshConfig(1, 1, 1, 1))
+    ded = run_steps(cfg, MeshConfig(dp=2, ep=2, tp=2))
+    np.testing.assert_allclose(ded, base, atol=5e-2)
+
+
+def test_moe_dedicated_ep_axis_sgd(hvd):
+    """SGD variant catches gradient-scale bugs on the dedicated ep axis
+    (dense grads must be scaled 1/(dp·sp·ep), not 1/(dp·sp))."""
+    cfg = dataclasses.replace(CFG, n_experts=4, expert_top_k=2,
+                              capacity_factor=2.0)
+    base = run_steps(cfg, MeshConfig(1, 1, 1, 1), sgd=True)
+    ded = run_steps(cfg, MeshConfig(dp=2, ep=2, tp=1), sgd=True)
+    np.testing.assert_allclose(ded, base, atol=5e-2)
+
+
 def test_moe_pipeline_rejected(hvd):
     cfg = dataclasses.replace(CFG, n_experts=4)
     with pytest.raises(Exception, match="pipeline \\+ MoE"):

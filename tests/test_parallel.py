@@ -84,6 +84,64 @@ def test_ring_attention_gradients(sp_mesh):
                                    atol=1e-4)
 
 
+def test_ring_attention_gqa_matches_repeated_kv(sp_mesh):
+    """GQA grouped path (Hkv < H circulating the ring) must equal the
+    naive repeat-kv-to-H reference — with 1/4 the ring bytes."""
+    q, _, _ = _qkv(H=8, seed=5)
+    _, k, v = _qkv(H=2, seed=6)  # 2 kv heads, group size 4
+    rep = jnp.repeat(k, 4, axis=2), jnp.repeat(v, 4, axis=2)
+    ref = ring_attention(q, *rep, axis_name=None, causal=True)
+    # single-shard grouped
+    got0 = ring_attention(q, k, v, axis_name=None, causal=True)
+    np.testing.assert_allclose(np.asarray(got0), np.asarray(ref), atol=1e-5)
+    # ring grouped: only the 2 kv heads rotate
+    out = _sharded(sp_mesh, lambda q, k, v: ring_attention(
+        q, k, v, "sp", causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_attention_gqa_gradients(sp_mesh):
+    q, _, _ = _qkv(B=1, T=32, H=4, D=8, seed=7)
+    _, k, v = _qkv(B=1, T=32, H=2, D=8, seed=8)
+
+    def ref_loss(q, k, v):
+        return (ring_attention(q, jnp.repeat(k, 2, axis=2),
+                               jnp.repeat(v, 2, axis=2), None,
+                               causal=True) ** 2).sum()
+
+    ref_grads = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    g = jax.jit(jax.shard_map(
+        jax.grad(lambda q, k, v: (ring_attention(
+            q, k, v, "sp", causal=True) ** 2).sum(), argnums=(0, 1, 2)),
+        mesh=sp_mesh, in_specs=(P(None, "sp"),) * 3,
+        out_specs=P(None, "sp"), check_vma=False))(q, k, v)
+    for got, want in zip(g, ref_grads):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4)
+
+
+def test_ulysses_gqa_grouped(sp_mesh):
+    """Ulysses with Hkv divisible by sp scatters only the kv heads."""
+    q, _, _ = _qkv(H=16, seed=9)
+    _, k, v = _qkv(H=8, seed=10)  # Hkv=8 divisible by sp=8 → grouped path
+    ref = ring_attention(q, jnp.repeat(k, 2, axis=2),
+                         jnp.repeat(v, 2, axis=2), None, causal=True)
+    out = _sharded(sp_mesh, lambda q, k, v: ulysses_attention(
+        q, k, v, "sp", causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ulysses_gqa_indivisible_kv_falls_back(sp_mesh):
+    """Hkv=2 < sp=8: repeat path still gives exact results."""
+    q, _, _ = _qkv(H=16, seed=11)
+    _, k, v = _qkv(H=2, seed=12)
+    ref = ring_attention(q, jnp.repeat(k, 8, axis=2),
+                         jnp.repeat(v, 8, axis=2), None, causal=True)
+    out = _sharded(sp_mesh, lambda q, k, v: ulysses_attention(
+        q, k, v, "sp", causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
 def test_ulysses_matches_reference(sp_mesh):
     q, k, v = _qkv(seed=4)
     ref = ring_attention(q, k, v, axis_name=None, causal=True)
@@ -140,3 +198,18 @@ def test_mesh_config_and_factor(hvd):
 def test_mesh_too_few_devices(hvd):
     with pytest.raises(ValueError, match="devices"):
         ParallelMesh(MeshConfig(dp=16, pp=1, sp=1, tp=1))
+
+
+def test_dedicated_ep_axis():
+    """MeshConfig.ep creates a real mesh axis usable by shard_map."""
+    import jax
+    from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
+    pm = ParallelMesh(MeshConfig(dp=2, ep=2, tp=2))
+    assert pm.ep_axis == "ep"
+    assert "ep" in pm.mesh.axis_names
+    assert pm.mesh.shape["ep"] == 2
+    assert pm.axis_size("ep") == 2
+    # aliased default: ep rides the dp axis
+    pm2 = ParallelMesh(MeshConfig(dp=4, tp=2))
+    assert pm2.ep_axis == "dp" and "ep" not in pm2.mesh.axis_names
+    assert pm2.axis_size("ep") == 4
