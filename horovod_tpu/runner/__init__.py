@@ -1,0 +1,11 @@
+"""Process launcher (reference: horovod/runner/ — ``horovodrun``).
+
+``hvdrun`` spawns one worker process per slot across hosts, exports the
+reference's §3.4 environment contract (HOROVOD_RANK/SIZE/LOCAL_RANK/...,
+rendezvous address), and streams rank-prefixed output.  The rendezvous
+itself is the JAX coordination service (``jax.distributed.initialize``),
+the TPU-native replacement for the reference's Gloo HTTP KV store / mpirun.
+"""
+
+from .api import run  # noqa: F401
+from .launch import main, parse_args  # noqa: F401

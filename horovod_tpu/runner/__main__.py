@@ -1,0 +1,8 @@
+"""``python -m horovod_tpu.runner`` == ``hvdrun``."""
+
+import sys
+
+from .launch import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""``horovod_tpu.runner.run`` — the in-Python launch API.
+
+Reference parity: ``horovod.run(fn, args=..., np=N, hosts=...)``
+(horovod/runner/launch.py ``run`` / ``_run``): pickle a function, launch
+``np`` workers that each call it under an initialized runtime, and return
+the list of per-rank results ordered by rank.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import tempfile
+from typing import Any, Callable, List, Optional
+
+from . import spawn
+from .hosts import assign_slots, effective_hosts
+from .launch import DEFAULT_PORT, _coordinator_addr
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        np: int = 1, hosts: Optional[str] = None,
+        hostfile: Optional[str] = None, port: int = DEFAULT_PORT,
+        env: Optional[dict] = None, verbose: bool = False,
+        prefix_output: bool = True) -> List[Any]:
+    """Run ``fn(*args, **kwargs)`` on ``np`` workers; returns per-rank
+    results ordered by rank.  Raises RuntimeError if any worker fails."""
+    kwargs = kwargs or {}
+    host_list = effective_hosts(hosts, hostfile, np)
+    slots = assign_slots(host_list, np)
+    addr = _coordinator_addr(host_list)
+    with tempfile.TemporaryDirectory(prefix="hvdrun_") as tmp:
+        payload = os.path.join(tmp, "payload.pkl")
+        with open(payload, "wb") as f:
+            pickle.dump((fn, args, kwargs), f)
+        results_dir = os.path.join(tmp, "results")
+        os.makedirs(results_dir)
+        command = [sys.executable, "-m", "horovod_tpu.runner.run_task",
+                   payload, results_dir]
+        base_env = dict(os.environ)
+        if env:
+            base_env.update(env)
+        procs = spawn.spawn_workers(
+            slots, command, addr, port, prefix_output=prefix_output,
+            base_env=base_env)
+        rc = spawn.wait_workers(procs)
+        if rc != 0:
+            raise RuntimeError(f"horovod_tpu.runner.run failed with exit "
+                               f"code {rc}")
+        results = []
+        for slot in slots:
+            path = os.path.join(results_dir, f"rank_{slot.rank}.pkl")
+            if not os.path.exists(path):
+                raise RuntimeError(
+                    f"worker rank {slot.rank} exited 0 but wrote no result")
+            with open(path, "rb") as f:
+                results.append(pickle.load(f))
+        return results

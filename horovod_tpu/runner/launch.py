@@ -1,0 +1,95 @@
+"""``hvdrun`` CLI (reference: ``horovodrun``, horovod/runner/launch.py §3.4).
+
+Flags mirror the reference where the concept survives on TPU: ``-np``,
+``-H``/``--hostfile``, ``--output-filename``, ``--verbose``,
+``--start-timeout``, ``--disable-cache`` analogs via env.  MPI/Gloo
+selection flags are gone: the rendezvous is always the JAX coordination
+service.  Elastic flags (``--min-np``/``--max-np``/
+``--host-discovery-script``) hand off to the elastic driver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+from typing import List, Optional
+
+from . import spawn
+from .hosts import assign_slots, effective_hosts
+
+DEFAULT_PORT = 29410
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu job across hosts/slots "
+                    "(TPU-native horovodrun).")
+    p.add_argument("-np", "--num-proc", dest="np", type=int, default=None,
+                   help="total number of worker processes")
+    p.add_argument("-H", "--hosts", dest="hosts", default=None,
+                   help="comma-separated host:slots list, e.g. a:4,b:4")
+    p.add_argument("--hostfile", default=None,
+                   help="hostfile with 'hostname slots=N' lines")
+    p.add_argument("-p", "--port", type=int, default=DEFAULT_PORT,
+                   help="coordination-service port on the first host")
+    p.add_argument("--output-filename", default=None,
+                   help="redirect each worker's output to FILE.<rank>")
+    p.add_argument("--no-prefix-output", action="store_true",
+                   help="do not prefix worker output with [rank]<host>")
+    p.add_argument("--start-timeout", type=float, default=600.0,
+                   help="seconds to wait for the job to finish rendezvous")
+    p.add_argument("--verbose", "-v", action="store_true")
+    # elastic (reference: --min-np/--max-np/--host-discovery-script)
+    p.add_argument("--min-np", type=int, default=None)
+    p.add_argument("--max-np", type=int, default=None)
+    p.add_argument("--host-discovery-script", default=None,
+                   help="executable printing current 'host:slots' lines; "
+                        "enables elastic mode")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="the training command, e.g. python train.py")
+    args = p.parse_args(argv)
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    if not args.command:
+        p.error("no command given")
+    if args.np is None and not args.host_discovery_script:
+        p.error("-np is required (or use --host-discovery-script)")
+    return args
+
+
+def _coordinator_addr(hosts) -> str:
+    first = hosts[0].hostname
+    if spawn.is_local(first):
+        return socket.gethostname()
+    return first
+
+
+def run_launcher(args: argparse.Namespace) -> int:
+    if args.host_discovery_script:
+        from ..elastic.driver import run_elastic_launcher
+        return run_elastic_launcher(args)
+    hosts = effective_hosts(args.hosts, args.hostfile, args.np)
+    slots = assign_slots(hosts, args.np)
+    addr = _coordinator_addr(hosts)
+    if args.verbose:
+        for s in slots:
+            print(f"hvdrun: rank {s.rank} -> {s.hostname} "
+                  f"(local {s.local_rank}/{s.local_size})", file=sys.stderr)
+        print(f"hvdrun: coordinator {addr}:{args.port}", file=sys.stderr)
+    procs = spawn.spawn_workers(
+        slots, args.command, addr, args.port,
+        prefix_output=not args.no_prefix_output,
+        output_filename=args.output_filename,
+        base_env=dict(os.environ))
+    return spawn.wait_workers(procs, timeout=args.start_timeout)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return run_launcher(parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,166 @@
+"""Worker spawning: command construction + process supervision.
+
+Reference parity: ``horovod/runner/gloo_run.py`` (per-slot worker exec with
+the env contract pointing at the rendezvous) and ``mpi_run.py`` (remote
+command construction — we assert the *generated command line* in tests the
+same way ``test/single/test_run.py`` does).  Remote hosts are reached over
+ssh like the reference's bootstrap; localhost workers are plain
+subprocesses.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from .hosts import SlotAssignment
+
+LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
+
+SSH_OPTS = ["-o", "StrictHostKeyChecking=no", "-o", "BatchMode=yes"]
+
+
+def is_local(hostname: str) -> bool:
+    import socket
+    return (hostname in LOCAL_NAMES
+            or hostname == socket.gethostname()
+            or hostname == socket.getfqdn())
+
+
+def worker_env(slot: SlotAssignment, coordinator_addr: str,
+               coordinator_port: int,
+               base_env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """The full §3.4 environment contract for one worker."""
+    env = dict(base_env if base_env is not None else os.environ)
+    env.update(slot.to_env())
+    env.update({
+        # reference names kept for script compatibility; the address points
+        # at the JAX coordination service, not a Gloo store
+        "HOROVOD_GLOO_RENDEZVOUS_ADDR": coordinator_addr,
+        "HOROVOD_GLOO_RENDEZVOUS_PORT": str(coordinator_port),
+        "HOROVOD_CONTROLLER": "jax",
+        "HOROVOD_NUM_PROCESSES": str(slot.size),
+        "HOROVOD_PROCESS_ID": str(slot.rank),
+    })
+    return env
+
+
+def remote_command(slot: SlotAssignment, command: Sequence[str],
+                   env: Dict[str, str], cwd: str) -> List[str]:
+    """Build the ssh command line for a remote worker (reference: mpi_run /
+    gloo_run remote exec).  Only HOROVOD_*/JAX_/XLA_ vars are forwarded —
+    the reference forwards an explicit allowlist via ``-x`` for the same
+    reason (remote shells own the rest of their environment)."""
+    forwarded = {k: v for k, v in env.items()
+                 if k.startswith(("HOROVOD_", "JAX_", "XLA_", "TPU_",
+                                  "PYTHONPATH", "LIBTPU"))}
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in sorted(forwarded.items()))
+    remote = f"cd {shlex.quote(cwd)} && env {exports} " + " ".join(
+        shlex.quote(c) for c in command)
+    return ["ssh", *SSH_OPTS, slot.hostname, remote]
+
+
+class WorkerProcess:
+    def __init__(self, slot: SlotAssignment, popen: subprocess.Popen):
+        self.slot = slot
+        self.popen = popen
+        self.pump: Optional[threading.Thread] = None
+
+
+def _pump_output(proc: WorkerProcess, prefix: bool, out_file=None):
+    stream = proc.popen.stdout
+    tag = f"[{proc.slot.rank}]<{proc.slot.hostname}>"
+    for raw in iter(stream.readline, b""):
+        line = raw.decode(errors="replace")
+        if out_file is not None:
+            out_file.write(line)
+            out_file.flush()
+        else:
+            sys.stdout.write(f"{tag}: {line}" if prefix else line)
+            sys.stdout.flush()
+
+
+def spawn_workers(slots: List[SlotAssignment], command: Sequence[str],
+                  coordinator_addr: str, coordinator_port: int,
+                  prefix_output: bool = True,
+                  output_filename: Optional[str] = None,
+                  base_env: Optional[Dict[str, str]] = None
+                  ) -> List[WorkerProcess]:
+    procs: List[WorkerProcess] = []
+    cwd = os.getcwd()
+    for slot in slots:
+        env = worker_env(slot, coordinator_addr, coordinator_port, base_env)
+        if is_local(slot.hostname):
+            cmd, popen_env = list(command), env
+        else:
+            cmd, popen_env = remote_command(slot, command, env, cwd), None
+        popen = subprocess.Popen(
+            cmd, env=popen_env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            start_new_session=True)
+        proc = WorkerProcess(slot, popen)
+        out_file = (open(f"{output_filename}.{slot.rank}", "w")
+                    if output_filename else None)
+        proc.pump = threading.Thread(
+            target=_pump_output, args=(proc, prefix_output, out_file),
+            daemon=True)
+        proc.pump.start()
+        procs.append(proc)
+    return procs
+
+
+def wait_workers(procs: List[WorkerProcess],
+                 timeout: Optional[float] = None) -> int:
+    """Wait for all workers; on first failure terminate the rest.
+
+    Returns the exit code to propagate (0 iff every worker exited 0) —
+    the reference's gloo_run semantics.
+    """
+    exit_code = 0
+    pending = list(procs)
+    try:
+        while pending:
+            for p in list(pending):
+                try:
+                    rc = p.popen.wait(timeout=0.2)
+                except subprocess.TimeoutExpired:
+                    continue
+                pending.remove(p)
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    sys.stderr.write(
+                        f"hvdrun: worker rank {p.slot.rank} "
+                        f"({p.slot.hostname}) exited with {rc}; "
+                        f"terminating remaining workers\n")
+                    for q in pending:
+                        _terminate(q)
+    except KeyboardInterrupt:
+        for q in pending:
+            _terminate(q)
+        exit_code = 128 + signal.SIGINT
+    for p in procs:
+        if p.pump is not None:
+            p.pump.join(timeout=2)
+    return exit_code
+
+
+def _terminate(p: WorkerProcess, grace: float = 5.0):
+    if p.popen.poll() is not None:
+        return
+    try:
+        os.killpg(p.popen.pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        return
+    try:
+        p.popen.wait(timeout=grace)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.popen.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass

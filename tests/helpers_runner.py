@@ -1,0 +1,36 @@
+"""Worker functions for launcher tests (importable by spawned processes)."""
+
+
+def topology_fn():
+    import jax
+    import horovod_tpu as hvd
+    return {
+        "rank": hvd.rank(),
+        "size": hvd.size(),
+        "cross_rank": hvd.cross_rank(),
+        "cross_size": hvd.cross_size(),
+        "process_count": jax.process_count(),
+    }
+
+
+def cross_process_sum_fn():
+    """A REAL cross-process collective: each process contributes its rank;
+    the jitted global sum must see both shards over the DCN-analog link."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import horovod_tpu as hvd
+
+    mesh, axis = hvd.mesh(), hvd.worker_axis()
+    n = hvd.size()
+    sh = NamedSharding(mesh, P(axis))
+    data = np.arange(n, dtype=np.float32) * 10.0
+    arr = jax.make_array_from_callback((n,), sh, lambda idx: data[idx])
+    total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+    return {"rank": hvd.rank(), "sum": float(total),
+            "procs": jax.process_count()}
+
+
+def failing_fn():
+    raise RuntimeError("worker deliberately fails")

@@ -1,0 +1,158 @@
+"""Launcher tests (reference: test/single/test_run.py — assert generated
+command lines / env contracts without launching; plus real 2-process
+localhost launches, the reference's test_parallel style)."""
+
+import os
+import sys
+
+import pytest
+
+from horovod_tpu.runner import parse_args
+from horovod_tpu.runner.hosts import (
+    HostInfo, SlotAssignment, assign_slots, effective_hosts, parse_hostfile,
+    parse_hosts)
+from horovod_tpu.runner.spawn import remote_command, worker_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- arg parsing (reference: test_run.py parse tests) -----------------------
+
+def test_parse_args_basic():
+    a = parse_args(["-np", "4", "-H", "a:2,b:2", "python", "train.py"])
+    assert a.np == 4 and a.hosts == "a:2,b:2"
+    assert a.command == ["python", "train.py"]
+
+
+def test_parse_args_separator_and_defaults():
+    a = parse_args(["-np", "2", "--", "python", "train.py", "--lr", "0.1"])
+    assert a.command == ["python", "train.py", "--lr", "0.1"]
+    assert a.hosts is None and a.hostfile is None
+
+
+def test_parse_args_requires_np_and_command():
+    with pytest.raises(SystemExit):
+        parse_args(["python", "train.py"])
+    with pytest.raises(SystemExit):
+        parse_args(["-np", "2"])
+
+
+# --- host parsing ----------------------------------------------------------
+
+def test_parse_hosts():
+    assert parse_hosts("a:4,b:2") == [HostInfo("a", 4), HostInfo("b", 2)]
+    assert parse_hosts("solo") == [HostInfo("solo", 1)]
+
+
+def test_parse_hostfile(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("# comment\nnode1 slots=4\nnode2 2\nnode3\n")
+    assert parse_hostfile(str(hf)) == [
+        HostInfo("node1", 4), HostInfo("node2", 2), HostInfo("node3", 1)]
+
+
+def test_effective_hosts_default_localhost():
+    assert effective_hosts(None, None, 8) == [HostInfo("localhost", 8)]
+    with pytest.raises(ValueError):
+        effective_hosts("a:1", "file", 1)
+
+
+# --- slot assignment (host-major, reference order) -------------------------
+
+def test_assign_slots_host_major():
+    slots = assign_slots([HostInfo("a", 2), HostInfo("b", 2)], 4)
+    assert [(s.rank, s.hostname, s.local_rank, s.cross_rank)
+            for s in slots] == [
+        (0, "a", 0, 0), (1, "a", 1, 0), (2, "b", 0, 1), (3, "b", 1, 1)]
+    assert all(s.size == 4 and s.cross_size == 2 for s in slots)
+
+
+def test_assign_slots_partial_last_host():
+    slots = assign_slots([HostInfo("a", 4), HostInfo("b", 4)], 5)
+    assert slots[4].hostname == "b" and slots[4].local_size == 1
+    assert slots[0].local_size == 4
+
+
+def test_assign_slots_overflow():
+    with pytest.raises(ValueError, match="exceeds"):
+        assign_slots([HostInfo("a", 2)], 3)
+
+
+# --- env contract (§3.4) ---------------------------------------------------
+
+def test_worker_env_contract():
+    slot = SlotAssignment(rank=3, size=8, local_rank=1, local_size=4,
+                          cross_rank=0, cross_size=2, hostname="a")
+    env = worker_env(slot, "10.0.0.1", 29410, base_env={"PATH": "/bin"})
+    assert env["HOROVOD_RANK"] == "3"
+    assert env["HOROVOD_SIZE"] == "8"
+    assert env["HOROVOD_LOCAL_RANK"] == "1"
+    assert env["HOROVOD_LOCAL_SIZE"] == "4"
+    assert env["HOROVOD_CROSS_RANK"] == "0"
+    assert env["HOROVOD_CROSS_SIZE"] == "2"
+    assert env["HOROVOD_HOSTNAME"] == "a"
+    assert env["HOROVOD_GLOO_RENDEZVOUS_ADDR"] == "10.0.0.1"
+    assert env["HOROVOD_GLOO_RENDEZVOUS_PORT"] == "29410"
+    assert env["HOROVOD_CONTROLLER"] == "jax"
+    assert env["HOROVOD_NUM_PROCESSES"] == "8"
+    assert env["HOROVOD_PROCESS_ID"] == "3"
+    assert env["PATH"] == "/bin"  # base env preserved
+
+
+def test_remote_command_construction():
+    """Assert the generated ssh command line (reference: mpirun cmdline
+    asserts in test_run.py)."""
+    slot = SlotAssignment(rank=2, size=4, local_rank=0, local_size=2,
+                          cross_rank=1, cross_size=2, hostname="nodeb")
+    env = {"HOROVOD_RANK": "2", "SECRET": "x", "PYTHONPATH": "/repo",
+           "XLA_FLAGS": "--foo"}
+    cmd = remote_command(slot, ["python", "train.py"], env, "/work dir")
+    assert cmd[0] == "ssh"
+    assert "nodeb" in cmd
+    remote = cmd[-1]
+    assert remote.startswith("cd '/work dir' && env ")
+    assert "HOROVOD_RANK=2" in remote
+    assert "PYTHONPATH=/repo" in remote
+    assert "XLA_FLAGS=--foo" in remote
+    assert "SECRET" not in remote          # only allowlisted vars forwarded
+    assert remote.endswith("python train.py")
+
+
+# --- real multi-process launches (localhost, CPU platform) ------------------
+
+def _run_env():
+    return {
+        "HOROVOD_TPU_FORCE_PLATFORM": "cpu",
+        "PYTHONPATH": REPO + ":" + os.path.join(REPO, "tests"),
+        # keep worker JAX quiet and CPU-only, one device per process
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "HOROVOD_CYCLE_TIME": "0.2",
+    }
+
+
+def test_run_api_two_process_topology():
+    import helpers_runner
+    from horovod_tpu.runner import run
+    results = run(helpers_runner.topology_fn, np=2, env=_run_env(),
+                  port=29511)
+    assert len(results) == 2
+    assert [r["rank"] for r in results] == [0, 1]
+    assert all(r["size"] == 2 for r in results)
+    assert all(r["process_count"] == 2 for r in results)
+
+
+def test_run_api_real_cross_process_collective():
+    import helpers_runner
+    from horovod_tpu.runner import run
+    results = run(helpers_runner.cross_process_sum_fn, np=2, env=_run_env(),
+                  port=29513)
+    # sum of 0*10 + 1*10 computed via a jitted global reduction
+    assert all(r["sum"] == 10.0 for r in results)
+    assert all(r["procs"] == 2 for r in results)
+
+
+def test_run_api_worker_failure_propagates():
+    import helpers_runner
+    from horovod_tpu.runner import run
+    with pytest.raises(RuntimeError, match="failed with exit code"):
+        run(helpers_runner.failing_fn, np=2, env=_run_env(), port=29515)
