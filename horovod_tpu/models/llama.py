@@ -189,11 +189,8 @@ def _attention(x, lp, cfg: LlamaConfig, par: ParallelSpec, positions):
     v = (x @ lp["wv"].astype(x.dtype)).reshape(B, Tl, Hkvl, Dh)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    # GQA: repeat kv heads up to q heads
-    if Hkvl != Hl:
-        rep = Hl // Hkvl
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    # GQA kv heads pass through as-is: ring circulates only the Hkv heads,
+    # ulysses repeats to lcm(Hkv, sp) internally only when it must.
     if par.attn == "ulysses":
         o = ulysses_attention(q, k, v, par.sp_axis, causal=True)
     else:

@@ -30,10 +30,26 @@ NEG_INF = -1e30
 def _block_attend(q, k, v, m, l, o, q_blk, kv_blk, t_local, causal, scale):
     """One tile: scores q·k with causal masking by global block position,
     folded into the (m, l, o) online-softmax accumulator.  fp32 accumulate
-    regardless of input dtype (MXU-native bf16 inputs are fine)."""
-    # q: [B, Tq, H, D], k/v: [B, Tk, H, D]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
+    regardless of input dtype (MXU-native bf16 inputs are fine).
+
+    GQA: when q has H heads and k/v have Hkv < H heads (H % Hkv == 0),
+    queries are grouped so each kv head serves H/Hkv query heads — kv
+    blocks circulate the ring at 1/(H/Hkv) the bytes of the repeated form.
+    Query head h maps to kv head h // (H/Hkv), matching
+    ``jnp.repeat(k, H//Hkv, axis=2)`` semantics.
+    """
+    # q: [B, Tq, H, D], k/v: [B, Tk, Hkv, D]
+    B, Tq, H, D = q.shape
+    Tk, Hkv = k.shape[1], k.shape[2]
+    if H == Hkv:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+    else:
+        g = H // Hkv
+        qg = q.reshape(B, Tq, Hkv, g, D)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                       preferred_element_type=jnp.float32) * scale
+        s = s.reshape(B, H, Tq, Tk)
     if causal:
         tq = jnp.arange(t_local)[:, None] + q_blk * t_local
         tk = jnp.arange(t_local)[None, :] + kv_blk * t_local
@@ -42,8 +58,16 @@ def _block_attend(q, k, v, m, l, o, q_blk, kv_blk, t_local, causal, scale):
     p = jnp.exp(s - m_new[..., None])                  # [B, H, Tq, Tk]
     corr = jnp.exp(m - m_new)                          # [B, H, Tq]
     l_new = l * corr + p.sum(axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
-                    preferred_element_type=jnp.float32)
+    vf = v.astype(jnp.float32)
+    if H == Hkv:
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, vf,
+                        preferred_element_type=jnp.float32)
+    else:
+        g = H // Hkv
+        pg = p.reshape(B, Hkv, g, Tq, Tk)
+        pv = jnp.einsum("bhgqk,bkhd->bqhgd", pg, vf,
+                        preferred_element_type=jnp.float32)
+        pv = pv.reshape(B, Tq, H, D)
     o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, o_new
 
@@ -54,7 +78,9 @@ def ring_attention(q, k, v, axis_name: Optional[str] = None,
 
     Args:
       q, k, v: ``[batch, t_local, heads, head_dim]`` — the local sequence
-        shard.  (GQA callers repeat k/v heads before calling.)
+        shard.  k/v may carry fewer heads than q (GQA): with
+        ``Hkv = k.shape[2]`` dividing ``H = q.shape[2]``, the grouped path
+        circulates only the Hkv kv heads around the ring.
       axis_name: the sp mesh axis; ``None`` (or size 1) → single-shard path.
       causal: apply a causal mask using *global* token positions.
       sm_scale: softmax scale; default ``1/sqrt(head_dim)``.

@@ -51,7 +51,15 @@ def ulysses_attention(q, k, v, axis_name: Optional[str] = None,
     q/k/v: ``[B, T/sp, H, D]`` sequence-sharded.  ``attn_fn(q, k, v)`` runs
     full attention on head-sharded tensors; defaults to the single-shard
     path of :func:`ring_attention` (exact softmax attention).
+
+    GQA (``Hkv = k.shape[2] < H``): when sp divides Hkv the kv tensors
+    scatter as-is (each chip holds Hkv/sp kv heads serving its H/sp query
+    heads — the grouped head layout keeps every query's kv head local);
+    otherwise kv heads are repeated to ``lcm(Hkv, sp)``, the minimum that
+    scatters evenly, before the all_to_all.
     """
+    import math
+
     from .ring_attention import ring_attention
     if attn_fn is None:
         def attn_fn(q, k, v):
@@ -59,6 +67,15 @@ def ulysses_attention(q, k, v, axis_name: Optional[str] = None,
                                   sm_scale=sm_scale)
     if axis_name is None or lax.axis_size(axis_name) == 1:
         return attn_fn(q, k, v)
+    n = lax.axis_size(axis_name)
+    H, Hkv = q.shape[2], k.shape[2]
+    if Hkv != H and Hkv % n:
+        # lcm(Hkv, n) divides H whenever Hkv | H and n | H, so the
+        # partially-repeated layout still scatters evenly and the grouped
+        # q-head → kv-head mapping stays chip-local after the all_to_all.
+        rep = (n * Hkv // math.gcd(Hkv, n)) // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     qh = seq_to_heads(q, axis_name)
     kh = seq_to_heads(k, axis_name)
     vh = seq_to_heads(v, axis_name)
