@@ -1,0 +1,73 @@
+"""Adasum gradient combining — the reference's ``examples/adasum`` analog.
+
+Scale-invariant gradient merging (``op=hvd.Adasum``): instead of averaging,
+worker gradients combine pairwise by projection so the effective step is
+robust to the number of workers — no LR rescale needed when scaling out.
+Reference: ``horovod/common/ops/adasum/`` (SURVEY.md §2.1).
+
+    python examples/adasum_mnist.py
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import mnist as mnist_model
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=1e-3)
+    args = p.parse_args()
+
+    hvd.init()
+    mesh, axis = hvd.mesh(), hvd.worker_axis()
+    cfg = mnist_model.MnistConfig()
+    params = hvd.broadcast_parameters(
+        mnist_model.init(cfg, jax.random.PRNGKey(0)))
+    # the only change vs. plain DP: op=hvd.Adasum
+    opt = hvd.DistributedOptimizer(optax.adam(args.lr), axis_name=axis,
+                                   op=hvd.Adasum)
+    opt_state = jax.jit(opt.init)(params)
+
+    rng = np.random.RandomState(0)
+    B = args.batch_size * hvd.size()
+    images = jnp.asarray(rng.rand(B, 28, 28, 1), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 10, B), jnp.int32)
+    data_sh = NamedSharding(mesh, P(axis))
+    images = jax.device_put(images, data_sh)
+    labels = jax.device_put(labels, data_sh)
+
+    @jax.jit
+    def train_step(params, opt_state, x, y):
+        def shard(params, opt_state, x, y):
+            def loss_fn(params):
+                logits = mnist_model.forward(params, x, cfg)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y).mean()
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state,
+                    jax.lax.pmean(loss, axis))
+        return jax.shard_map(shard, mesh=mesh,
+                             in_specs=(P(), P(), P(axis), P(axis)),
+                             out_specs=(P(), P(), P()),
+                             check_vma=True)(params, opt_state, x, y)
+
+    for step in range(args.steps):
+        params, opt_state, loss = train_step(params, opt_state,
+                                             images, labels)
+        if hvd.rank() == 0 and step % 10 == 0:
+            print(f"step {step}: loss={float(loss):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

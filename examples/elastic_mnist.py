@@ -1,0 +1,105 @@
+"""Elastic MNIST training — benchmark config 5.
+
+TPU-native analog of the reference's ``examples/elastic/pytorch``: wrap the
+training body in ``@hvd.elastic.run`` with an ``ArrayState``; on a collective
+failure (slice preemption → HorovodInternalError) the state rolls back to the
+last commit, on a membership change (HostsUpdatedInterrupt) it re-syncs from
+the new rank 0, and the body re-enters either way.
+
+    python examples/elastic_mnist.py
+    hvdrun -np 2 python examples/elastic_mnist.py
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.elastic import ArrayState, ElasticSampler
+from horovod_tpu.models import mnist as mnist_model
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--commit-every", type=int, default=10)
+    args = p.parse_args()
+
+    hvd.init()
+    mesh, axis = hvd.mesh(), hvd.worker_axis()
+    cfg = mnist_model.MnistConfig()
+    params = hvd.broadcast_parameters(
+        mnist_model.init(cfg, jax.random.PRNGKey(0)))
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3), axis_name=axis)
+    opt_state = jax.jit(opt.init)(params)
+
+    rng = np.random.RandomState(0)
+    n = 2048
+    images = rng.rand(n, 28, 28, 1).astype(np.float32)
+    labels = rng.randint(0, 10, n).astype(np.int32)
+    # partition samples over processes; each process feeds its local chips
+    sampler = ElasticSampler(n, rank=hvd.process_index(),
+                             num_replicas=hvd.process_count())
+    per_proc = args.batch_size * hvd.local_size()
+
+    @jax.jit
+    def train_step(params, opt_state, x, y):
+        def shard(params, opt_state, x, y):
+            def loss_fn(params):
+                logits = mnist_model.forward(params, x, cfg)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y).mean()
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state,
+                    jax.lax.pmean(loss, axis))
+        return jax.shard_map(shard, mesh=mesh,
+                             in_specs=(P(), P(), P(axis), P(axis)),
+                             out_specs=(P(), P(), P()),
+                             check_vma=True)(params, opt_state, x, y)
+
+    state = ArrayState(params=params, opt_state=opt_state,
+                       epoch=0, sampler_state=sampler.state_dict())
+
+    @hvd.elastic.run
+    def train(state):
+        data_sh = NamedSharding(hvd.mesh(), P(hvd.worker_axis()))
+        # after a reset the sampler re-partitions the *remaining* indices
+        # over the new worker set (no sample dropped or duplicated)
+        sampler.load_state_dict(state.sampler_state)
+        while state.epoch < args.epochs:
+            if sampler.epoch != state.epoch:
+                sampler.set_epoch(state.epoch)
+            local = list(sampler)
+            loss = None
+            for i in range(len(local) // per_proc):
+                idx = local[i * per_proc:(i + 1) * per_proc]
+                x = jax.make_array_from_process_local_data(
+                    data_sh, images[idx])
+                y = jax.make_array_from_process_local_data(
+                    data_sh, labels[idx])
+                p2, o2, loss = train_step(state.params, state.opt_state, x, y)
+                state.params, state.opt_state = p2, o2
+                sampler.record_indices(idx)
+                if (i + 1) % args.commit_every == 0:
+                    state.sampler_state = sampler.state_dict()
+                    state.commit()
+            if hvd.rank() == 0 and loss is not None:
+                print(f"epoch {state.epoch}: loss={float(loss):.4f} "
+                      f"(size={hvd.size()})")
+            state.epoch += 1
+            sampler.set_epoch(state.epoch)
+            state.sampler_state = sampler.state_dict()
+            state.commit()
+
+    train(state)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

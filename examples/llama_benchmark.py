@@ -1,0 +1,94 @@
+"""Llama training benchmark — benchmark config 4 (flagship model).
+
+Data/tensor/sequence/pipeline/expert-parallel Llama training over a device
+mesh with fused gradient all-reduce, reporting tokens/sec and MFU.  The
+reference stops at DP; the mesh axes here go beyond it (SURVEY.md §2.9).
+
+    python examples/llama_benchmark.py --dp 1 --preset 250m --num-iters 5
+    python examples/llama_benchmark.py --dp 2 --tp 2 --sp 2  # 8 virtual chips
+"""
+
+import argparse
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import training
+from horovod_tpu.models import llama
+from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
+
+PRESETS = {
+    "tiny": dict(vocab_size=4096, d_model=256, n_layers=4, n_heads=8,
+                 n_kv_heads=4, d_ff=1024, max_seq_len=512),
+    "250m": dict(vocab_size=32768, d_model=1024, n_layers=16, n_heads=16,
+                 n_kv_heads=8, d_ff=4096, max_seq_len=2048),
+    "1b": dict(vocab_size=32768, d_model=2048, n_layers=24, n_heads=32,
+               n_kv_heads=8, d_ff=8192, max_seq_len=4096),
+    "8b": dict(vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+               n_kv_heads=8, d_ff=14336, max_seq_len=8192),
+}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="tiny", choices=sorted(PRESETS))
+    p.add_argument("--dp", type=int, default=0, help="0 = all local chips")
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=4, help="per dp shard")
+    p.add_argument("--seq-len", type=int, default=0, help="0 = preset max")
+    p.add_argument("--num-warmup", type=int, default=2)
+    p.add_argument("--num-iters", type=int, default=5)
+    p.add_argument("--attn", default="ring", choices=["ring", "ulysses"])
+    args = p.parse_args()
+
+    hvd.init()
+    n_chips = jax.local_device_count()
+    dp = args.dp or max(1, n_chips // (args.tp * args.sp * args.pp))
+    mc = MeshConfig(dp=dp, tp=args.tp, sp=args.sp, pp=args.pp)
+    cfg = llama.LlamaConfig(**PRESETS[args.preset])
+    seq = args.seq_len or cfg.max_seq_len
+    pmesh = ParallelMesh(mc)
+    ts = training.make_llama_train_step(
+        cfg, pmesh, attn=args.attn,
+        n_microbatches=2 * args.pp if args.pp > 1 else 0)
+    params, opt_state = ts.init_fn(jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+    rng = np.random.RandomState(0)
+    B = args.batch_size * dp
+    sh = training.make_data_sharding(ts)
+    toks = jax.device_put(jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (B, seq)), jnp.int32), sh)
+    tgts = jax.device_put(jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (B, seq)), jnp.int32), sh)
+
+    if hvd.rank() == 0:
+        print(f"Llama-{args.preset}: {n_params / 1e6:.0f}M params, "
+              f"mesh dp{dp}/pp{args.pp}/sp{args.sp}/tp{args.tp}, "
+              f"batch {B}x{seq}")
+
+    for _ in range(args.num_warmup):
+        params, opt_state, loss = ts.step_fn(params, opt_state, toks, tgts)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        params, opt_state, loss = ts.step_fn(params, opt_state, toks, tgts)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    if hvd.rank() == 0:
+        tok_s = B * seq * args.num_iters / dt
+        step_flops = 6 * n_params * B * seq  # fwd+bwd matmul FLOPs
+        print(f"loss={float(loss):.4f}  tokens/sec={tok_s:,.0f}  "
+              f"tokens/sec/chip={tok_s / n_chips:,.0f}  "
+              f"TFLOP/s/chip={step_flops * args.num_iters / dt / n_chips / 1e12:.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""MNIST data-parallel training — benchmark config 1.
+
+The TPU-native analog of the reference's ``examples/pytorch/pytorch_mnist.py``:
+init → broadcast parameters → DistributedOptimizer → shard the batch over the
+worker mesh → train.  Synthetic MNIST-style data keeps the script hermetic
+(no downloads); pass ``--data-dir`` with ``train-images-idx3-ubyte`` files to
+use the real dataset.
+
+Run (single process, all local chips):  python examples/mnist.py
+Multi-process:                          hvdrun -np 2 python examples/mnist.py
+"""
+
+import argparse
+import gzip
+import os
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import mnist as mnist_model
+
+
+def load_mnist(data_dir, n):
+    """Real MNIST if present, else a deterministic synthetic stand-in of
+    blurred class-dependent digit blobs (learnable, hermetic)."""
+    path = os.path.join(data_dir or "", "train-images-idx3-ubyte.gz")
+    if data_dir and os.path.exists(path):
+        with gzip.open(path, "rb") as f:
+            _, num, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), np.uint8).reshape(
+                num, rows, cols, 1)[:n] / 255.0
+        with gzip.open(os.path.join(
+                data_dir, "train-labels-idx1-ubyte.gz"), "rb") as f:
+            f.read(8)
+            labels = np.frombuffer(f.read(), np.uint8)[:n]
+        return images.astype(np.float32), labels.astype(np.int32)
+    rng = np.random.RandomState(42)
+    labels = rng.randint(0, 10, n).astype(np.int32)
+    images = np.zeros((n, 28, 28, 1), np.float32)
+    for i, y in enumerate(labels):  # a bright patch whose position encodes y
+        r, c = divmod(int(y), 4)
+        images[i, 4 + r * 8:12 + r * 8, 2 + c * 6:10 + c * 6, 0] = 1.0
+    images += rng.rand(n, 28, 28, 1).astype(np.float32) * 0.3
+    return images, labels
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64,
+                   help="per-worker batch size")
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--n-train", type=int, default=4096)
+    p.add_argument("--data-dir", default=None)
+    args = p.parse_args()
+
+    hvd.init()
+    mesh = hvd.mesh()
+    axis = hvd.worker_axis()
+    n_shards = hvd.size()
+    if hvd.rank() == 0:
+        print(f"workers={n_shards} local chips={jax.local_device_count()}")
+
+    cfg = mnist_model.MnistConfig()
+    params = mnist_model.init(cfg, jax.random.PRNGKey(0))
+    # every worker starts from rank 0's weights (reference: hvd.broadcast_parameters)
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    opt = hvd.DistributedOptimizer(optax.adam(args.lr), axis_name=axis)
+    opt_state = jax.jit(opt.init)(params)
+
+    def loss_fn(params, x, y):
+        logits = mnist_model.forward(params, x, cfg)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    @jax.jit
+    def train_step(params, opt_state, x, y):
+        def shard(params, opt_state, x, y):
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, jax.lax.pmean(loss, axis)
+        return jax.shard_map(
+            shard, mesh=mesh,
+            in_specs=(P(), P(), P(axis), P(axis)),
+            out_specs=(P(), P(), P()), check_vma=True)(
+                params, opt_state, x, y)
+
+    images, labels = load_mnist(args.data_dir, args.n_train)
+    global_bs = args.batch_size * n_shards
+    data_sh = NamedSharding(mesh, P(axis))
+    steps = len(images) // global_bs
+    for epoch in range(args.epochs):
+        for i in range(steps):
+            lo = i * global_bs
+            x = jax.device_put(jnp.asarray(images[lo:lo + global_bs]), data_sh)
+            y = jax.device_put(jnp.asarray(labels[lo:lo + global_bs]), data_sh)
+            params, opt_state, loss = train_step(params, opt_state, x, y)
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={float(loss):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

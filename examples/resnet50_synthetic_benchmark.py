@@ -1,0 +1,93 @@
+"""ResNet-50 synthetic benchmark — benchmark config 2.
+
+TPU-native analog of the reference's
+``examples/pytorch/pytorch_synthetic_benchmark.py``: synthetic ImageNet-shape
+batches through a data-parallel ResNet train step, reporting img/sec (total
+and per chip).  ``--bf16`` mirrors the reference's ``--fp16-allreduce`` knob —
+on TPU the natural low-precision wire format is bfloat16.
+
+    python examples/resnet50_synthetic_benchmark.py --num-iters 10
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding
+
+import horovod_tpu as hvd
+from horovod_tpu import training
+from horovod_tpu.models import resnet
+from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", type=int, default=50,
+                   choices=sorted(resnet.VARIANTS))
+    p.add_argument("--batch-size", type=int, default=32,
+                   help="per-chip batch size")
+    p.add_argument("--num-warmup-batches", type=int, default=3)
+    p.add_argument("--num-batches-per-iter", type=int, default=5)
+    p.add_argument("--num-iters", type=int, default=4)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--bf16", action="store_true", default=True)
+    p.add_argument("--fp32", dest="bf16", action="store_false")
+    p.add_argument("--no-sync-bn", dest="sync_bn", action="store_false")
+    args = p.parse_args()
+
+    hvd.init()
+    n_chips = jax.local_device_count()
+    cfg = resnet.ResNetConfig(
+        variant=args.model,
+        dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+    pmesh = ParallelMesh(MeshConfig(dp=n_chips))
+    ts = training.make_classifier_train_step(
+        lambda p_, s, x, train, axis_name: resnet.forward(
+            p_, s, x, cfg, train=train, axis_name=axis_name),
+        lambda rng: resnet.init(cfg, rng), pmesh,
+        optimizer=optax.sgd(0.01, momentum=0.9), sync_bn=args.sync_bn)
+    params, state, opt_state = ts.init_fn(jax.random.PRNGKey(0))
+
+    rng = np.random.RandomState(0)
+    B = args.batch_size * n_chips
+    sh = NamedSharding(ts.mesh, ts.data_spec)
+    x = jax.device_put(jnp.asarray(
+        rng.rand(B, args.image_size, args.image_size, 3), jnp.float32), sh)
+    y = jax.device_put(jnp.asarray(rng.randint(0, 1000, B), jnp.int32), sh)
+
+    if hvd.rank() == 0:
+        print(f"Model: ResNet-{args.model} ({resnet.num_params(params) / 1e6:.1f}M params)")
+        print(f"Batch size: {args.batch_size}/chip x {n_chips} chips")
+
+    def run_batches(n):
+        nonlocal params, state, opt_state
+        for _ in range(n):
+            params, state, opt_state, loss, _ = ts.step_fn(
+                params, state, opt_state, x, y)
+        jax.block_until_ready(loss)
+        return loss
+
+    run_batches(args.num_warmup_batches)
+    rates = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        run_batches(args.num_batches_per_iter)
+        dt = time.perf_counter() - t0
+        rate = B * args.num_batches_per_iter / dt
+        rates.append(rate)
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {rate:.1f} img/sec total")
+    if hvd.rank() == 0:
+        mean = np.mean(rates)
+        print(f"Img/sec/chip: {mean / n_chips:.1f} +- "
+              f"{1.96 * np.std(rates) / n_chips:.1f}")
+        print(f"Total img/sec on {n_chips} chip(s): {mean:.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

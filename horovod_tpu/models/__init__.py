@@ -6,4 +6,4 @@ Llama-3-style decoder (config 4, flagship) and a Mixtral-style MoE variant
 params, stacked-layer ``lax.scan`` bodies, explicit mesh-axis hooks.
 """
 
-from . import llama  # noqa: F401  (mlp/resnet/bert/moe import on demand)
+from . import llama, mnist, resnet  # noqa: F401  (bert/moe import on demand)

@@ -1,0 +1,59 @@
+"""MNIST convnet — benchmark config 1 (``pytorch_mnist.py`` analog).
+
+Reference parity: ``examples/pytorch/pytorch_mnist.py`` (two convs + two
+fully-connected layers trained data-parallel with DistributedOptimizer).
+Same capacity here, TPU idioms: NHWC, bf16 compute / fp32 params, pure
+functions over an explicit param pytree.  Stateless (no batch norm), so
+``forward(params, images)`` → logits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class MnistConfig:
+    num_classes: int = 10
+    c1: int = 32
+    c2: int = 64
+    hidden: int = 128
+    dtype: Any = jnp.bfloat16
+
+
+def init(cfg: MnistConfig, rng) -> dict:
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+
+    def he(rng, shape, fan_in):
+        return jax.random.normal(rng, shape, jnp.float32) * (2.0 / fan_in) ** 0.5
+
+    return {
+        "conv1": he(k1, (3, 3, 1, cfg.c1), 9),
+        "conv2": he(k2, (3, 3, cfg.c1, cfg.c2), 9 * cfg.c1),
+        # two 2x stride convs: 28 -> 14 -> 7
+        "fc1": {"w": he(k3, (7 * 7 * cfg.c2, cfg.hidden), 7 * 7 * cfg.c2),
+                "b": jnp.zeros(cfg.hidden, jnp.float32)},
+        "fc2": {"w": he(k4, (cfg.hidden, cfg.num_classes), cfg.hidden),
+                "b": jnp.zeros(cfg.num_classes, jnp.float32)},
+    }
+
+
+def forward(params, images, cfg: MnistConfig = MnistConfig()):
+    """images: [B, 28, 28, 1] → fp32 logits [B, 10]."""
+    x = images.astype(cfg.dtype)
+    for name in ("conv1", "conv2"):
+        x = lax.conv_general_dilated(
+            x, params[name].astype(x.dtype), (2, 2), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc1"]["w"].astype(x.dtype)
+                    + params["fc1"]["b"].astype(x.dtype))
+    logits = (x.astype(jnp.float32) @ params["fc2"]["w"]
+              + params["fc2"]["b"])
+    return logits

@@ -106,8 +106,15 @@ def adasum_arrays(arrays: List, ps, prescale_factor=None,
 
 
 def adasum_p(x, axis_name: str):
-    """Traceable Adasum for use inside shard_map programs."""
+    """Traceable Adasum for use inside shard_map programs (check_vma-safe)."""
     n = lax.axis_size(axis_name)
     flat = x.reshape(-1)
     allv = lax.all_gather(flat, axis_name)
-    return adasum_tree([allv[i] for i in range(n)]).reshape(x.shape)
+    combined = adasum_tree([allv[i] for i in range(n)])
+    # Every shard computed the identical combining tree, but all_gather
+    # output is formally still axis-varying under the vma system; a masked
+    # psum (rank 0's copy) converts it to provably-replicated so the result
+    # can feed P() out_specs under check_vma=True.
+    mask = (lax.axis_index(axis_name) == 0).astype(combined.dtype)
+    combined = lax.psum(combined * mask, axis_name)
+    return combined.reshape(x.shape)

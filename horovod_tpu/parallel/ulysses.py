@@ -12,6 +12,7 @@ reference's ``hvd.alltoall`` is exactly the primitive this builds on.
 
 from __future__ import annotations
 
+import math
 from typing import Callable, Optional
 
 import jax.numpy as jnp
@@ -58,8 +59,6 @@ def ulysses_attention(q, k, v, axis_name: Optional[str] = None,
     otherwise kv heads are repeated to ``lcm(Hkv, sp)``, the minimum that
     scatters evenly, before the all_to_all.
     """
-    import math
-
     from .ring_attention import ring_attention
     if attn_fn is None:
         def attn_fn(q, k, v):

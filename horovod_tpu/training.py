@@ -205,3 +205,91 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
 
 def make_data_sharding(ts: TrainStep):
     return NamedSharding(ts.mesh, ts.data_spec)
+
+
+@dataclasses.dataclass
+class ClassifierTrainStep:
+    """Compiled DP image-classifier step (benchmark configs 1/2/5)."""
+    step_fn: Callable    # (params, state, opt_state, images, labels) ->
+    #                      (params, state, opt_state, loss, accuracy)
+    init_fn: Callable    # (rng) -> (params, state, opt_state)
+    eval_fn: Callable    # (params, state, images) -> logits [batch-sharded]
+    mesh: Any
+    data_spec: Any
+
+
+def make_classifier_train_step(forward_fn, model_init_fn, pmesh: ParallelMesh,
+                               optimizer: Optional[
+                                   optax.GradientTransformation] = None,
+                               sync_bn: bool = True) -> ClassifierTrainStep:
+    """Data-parallel training step for image classifiers (ResNet/MNIST).
+
+    ``forward_fn(params, state, images, train, axis_name)`` must return
+    ``(logits, new_state)`` — stateless models pass state through
+    untouched.  ``model_init_fn(rng) -> (params, state)``.
+
+    The reference's equivalent is DistributedOptimizer around a torch
+    module with opt-in SyncBatchNorm (SURVEY.md §2.2); here the gradient
+    all-reduce AND the batch-stat sync compile into the one step program,
+    so XLA overlaps both with compute.
+    """
+    mesh = pmesh.mesh
+    opt = optimizer if optimizer is not None else optax.sgd(0.1, momentum=0.9)
+    dp = pmesh.config.dp
+    dp_axis = "dp" if dp > 1 else None
+    bn_axis = dp_axis if sync_bn else None
+    data_spec = P(dp_axis)
+
+    def local_loss(params, state, images, labels):
+        logits, new_state = forward_fn(params, state, images, train=True,
+                                       axis_name=bn_axis)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        acc = (logits.argmax(-1) == labels).mean()
+        return loss, (new_state, acc)
+
+    def shard_step(params, state, opt_state, images, labels):
+        (loss, (state, acc)), grads = jax.value_and_grad(
+            local_loss, has_aux=True)(params, state, images, labels)
+        if dp > 1:
+            # check_vma inserted the cross-shard psum; normalize the
+            # summed gradient of the per-shard mean losses
+            grads = jax.tree_util.tree_map(
+                lambda g: g * jnp.asarray(1.0 / dp, g.dtype), grads)
+            loss = lax.pmean(loss, "dp")
+            acc = lax.pmean(acc, "dp")
+            if not sync_bn:
+                # unsynced batch stats diverge per shard; average so the
+                # replicated state stays identical everywhere
+                state = jax.tree_util.tree_map(
+                    lambda s: lax.pmean(s, "dp"), state)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, state, opt_state, loss, acc
+
+    step_fn = jax.jit(jax.shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(P(), P(), P(), data_spec, data_spec),
+        out_specs=(P(), P(), P(), P(), P()),
+        check_vma=True), donate_argnums=(0, 1, 2))
+
+    def shard_eval(params, state, images):
+        logits, _ = forward_fn(params, state, images, train=False,
+                               axis_name=None)
+        return logits
+
+    eval_fn = jax.jit(jax.shard_map(
+        shard_eval, mesh=mesh, in_specs=(P(), P(), data_spec),
+        out_specs=data_spec, check_vma=True))
+
+    replicated = NamedSharding(mesh, P())
+
+    def init_fn(rng):
+        params, state = jax.jit(model_init_fn,
+                                out_shardings=replicated)(rng)
+        opt_state = jax.jit(opt.init, out_shardings=replicated)(params)
+        return params, state, opt_state
+
+    return ClassifierTrainStep(step_fn=step_fn, init_fn=init_fn,
+                               eval_fn=eval_fn, mesh=mesh,
+                               data_spec=data_spec)

@@ -1,0 +1,176 @@
+"""ResNet / MNIST model family + sync batch norm + classifier train step.
+
+Mirrors the reference's test posture (SURVEY.md §4): rank-dependent inputs
+prove real cross-shard communication — here, sync-BN over an 8-way dp mesh
+must equal single-shard BN over the concatenated batch.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu import training
+from horovod_tpu.models import mnist, resnet
+from horovod_tpu.ops.sync_batch_norm import sync_batch_norm, sync_batch_stats
+from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
+
+
+def _tiny_cfg(variant=18):
+    return resnet.ResNetConfig(variant=variant, num_classes=10, width=8,
+                               dtype=jnp.float32)
+
+
+def test_sync_batch_stats_match_global_batch():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 4, 4, 3), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+
+    def local(x):
+        m, v = sync_batch_stats(x, (0, 1, 2), "dp")
+        return jnp.stack([m, v])
+
+    out = jax.jit(jax.shard_map(local, mesh=mesh, in_specs=P("dp"),
+                                out_specs=P()))(x)
+    want_m = x.mean((0, 1, 2))
+    want_v = x.var((0, 1, 2))
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(want_m),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(want_v),
+                               atol=1e-5)
+
+
+def test_sync_batch_norm_dp_equals_single_process():
+    """8-way sharded sync-BN == unsharded BN on the full batch (the
+    reference's SyncBatchNorm contract), including running-stat updates."""
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(16, 2, 2, 5), jnp.float32)
+    scale = jnp.asarray(rng.rand(5) + 0.5, jnp.float32)
+    bias = jnp.asarray(rng.randn(5), jnp.float32)
+    rm = jnp.zeros(5)
+    rv = jnp.ones(5)
+    want_y, want_m, want_v = sync_batch_norm(x, scale, bias, rm, rv,
+                                             axis_name=None)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    got_y, got_m, got_v = jax.jit(jax.shard_map(
+        lambda x: sync_batch_norm(x, scale, bias, rm, rv, axis_name="dp"),
+        mesh=mesh, in_specs=P("dp"), out_specs=(P("dp"), P(), P())))(x)
+    np.testing.assert_allclose(np.asarray(got_y), np.asarray(want_y),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_m), np.asarray(want_m),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(want_v),
+                               atol=1e-6)
+
+
+def test_resnet50_param_count():
+    """ResNet-50/1000-class must land on the canonical ~25.5M params."""
+    cfg = resnet.ResNetConfig(variant=50, num_classes=1000)
+    params, _ = jax.eval_shape(lambda: resnet.init(cfg, jax.random.PRNGKey(0)))
+    n = resnet.num_params(params)
+    assert abs(n - 25_557_032) < 30_000, n
+
+
+@pytest.mark.parametrize("variant", [18, 50])
+def test_resnet_forward_shapes(variant):
+    cfg = _tiny_cfg(variant)
+    params, state = resnet.init(cfg, jax.random.PRNGKey(0))
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    logits, new_state = jax.jit(
+        lambda p, s, x: resnet.forward(p, s, x, cfg))(params, state, x)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    assert jax.tree_util.tree_structure(new_state) == \
+        jax.tree_util.tree_structure(state)
+
+
+def test_resnet_eval_uses_running_stats():
+    cfg = _tiny_cfg()
+    params, state = resnet.init(cfg, jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3), jnp.float32)
+    _, s1 = resnet.forward(params, state, x, cfg, train=False)
+    # eval must not touch the stats
+    for a, b in zip(jax.tree_util.tree_leaves(s1),
+                    jax.tree_util.tree_leaves(state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_classifier_train_step_resnet_dp8_loss_decreases():
+    cfg = _tiny_cfg()
+    pmesh = ParallelMesh(MeshConfig(dp=8), devices=jax.devices()[:8])
+    ts = training.make_classifier_train_step(
+        lambda p, s, x, train, axis_name: resnet.forward(
+            p, s, x, cfg, train=train, axis_name=axis_name),
+        lambda rng: resnet.init(cfg, rng), pmesh)
+    params, state, opt_state = ts.init_fn(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    import jax.sharding as shd
+    data_sh = shd.NamedSharding(ts.mesh, ts.data_spec)
+    x = jax.device_put(jnp.asarray(rng.randn(16, 32, 32, 3), jnp.float32),
+                       data_sh)
+    y = jax.device_put(jnp.asarray(rng.randint(0, 10, 16), jnp.int32),
+                       data_sh)
+    losses = []
+    for _ in range(12):
+        params, state, opt_state, loss, acc = ts.step_fn(
+            params, state, opt_state, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses
+    assert np.isfinite(losses).all()
+
+
+def test_classifier_train_step_dp_matches_single_device():
+    """The distributed-consistency contract: 8-way DP training (sync-BN)
+    must produce the same params trajectory as 1-device training on the
+    same global batch."""
+    cfg = _tiny_cfg()
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(8, 32, 32, 3), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 10, 8), jnp.int32)
+    runs = {}
+    for dp in (1, 8):
+        pmesh = ParallelMesh(MeshConfig(dp=dp), devices=jax.devices()[:dp])
+        ts = training.make_classifier_train_step(
+            lambda p, s, x, train, axis_name: resnet.forward(
+                p, s, x, cfg, train=train, axis_name=axis_name),
+            lambda rng: resnet.init(cfg, rng), pmesh)
+        params, state, opt_state = ts.init_fn(jax.random.PRNGKey(7))
+        import jax.sharding as shd
+        data_sh = shd.NamedSharding(ts.mesh, ts.data_spec)
+        xs = jax.device_put(x, data_sh)
+        ys = jax.device_put(y, data_sh)
+        for _ in range(3):
+            params, state, opt_state, loss, _ = ts.step_fn(
+                params, state, opt_state, xs, ys)
+        runs[dp] = (jax.tree_util.tree_leaves(params), float(loss))
+    for a, b in zip(runs[1][0], runs[8][0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+    assert abs(runs[1][1] - runs[8][1]) < 1e-5
+
+
+def test_mnist_train_step_dp8():
+    cfg = mnist.MnistConfig(dtype=jnp.float32)
+    pmesh = ParallelMesh(MeshConfig(dp=8), devices=jax.devices()[:8])
+    import optax
+    ts = training.make_classifier_train_step(
+        lambda p, s, x, train, axis_name: (mnist.forward(p, x, cfg), s),
+        lambda rng: (mnist.init(cfg, rng), {}), pmesh,
+        optimizer=optax.adam(3e-3))
+    params, state, opt_state = ts.init_fn(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(3)
+    import jax.sharding as shd
+    data_sh = shd.NamedSharding(ts.mesh, ts.data_spec)
+    x = jax.device_put(jnp.asarray(rng.rand(32, 28, 28, 1), jnp.float32),
+                       data_sh)
+    y = jax.device_put(jnp.asarray(rng.randint(0, 10, 32), jnp.int32),
+                       data_sh)
+    first = None
+    for _ in range(20):
+        params, state, opt_state, loss, acc = ts.step_fn(
+            params, state, opt_state, x, y)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first * 0.5, (first, float(loss))
+    assert float(acc) > 0.5
