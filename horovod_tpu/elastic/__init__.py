@@ -14,3 +14,6 @@ from .state import (  # noqa: F401
 )
 from .runner import run  # noqa: F401
 from .sampler import ElasticSampler  # noqa: F401
+from .discovery import (  # noqa: F401
+    HostDiscovery, HostDiscoveryScript, FixedHostDiscovery,
+)

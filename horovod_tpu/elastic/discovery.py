@@ -1,0 +1,56 @@
+"""Host discovery for elastic training.
+
+Reference parity: ``horovod/runner/elastic/discovery.py`` — the driver polls
+a user-supplied executable that prints the currently-available hosts, one
+per line, as ``hostname:slots`` (or bare ``hostname`` for a default slot
+count).  On TPU the script typically wraps a GKE/slice-pool query; tests
+use a shell script echoing a mutable hostfile (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Dict
+
+
+class HostDiscovery:
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        """Current host → slot-count map (ordering is preserved)."""
+        raise NotImplementedError
+
+
+class HostDiscoveryScript(HostDiscovery):
+    def __init__(self, discovery_script: str, default_slots: int = 1,
+                 timeout: float = 60.0):
+        self.discovery_script = discovery_script
+        self.default_slots = default_slots
+        self.timeout = timeout
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = subprocess.check_output(
+            self.discovery_script, shell=True, timeout=self.timeout)
+        return parse_host_lines(out.decode(), self.default_slots)
+
+
+class FixedHostDiscovery(HostDiscovery):
+    """Static host set (non-elastic fallback / unit tests)."""
+
+    def __init__(self, hosts: Dict[str, int]):
+        self._hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._hosts)
+
+
+def parse_host_lines(text: str, default_slots: int = 1) -> Dict[str, int]:
+    hosts: Dict[str, int] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if ":" in line:
+            name, slots = line.rsplit(":", 1)
+            hosts[name.strip()] = int(slots)
+        else:
+            hosts[line] = default_slots
+    return hosts

@@ -1,0 +1,400 @@
+"""The elastic driver: discovery polling, worker lifecycle, notifications.
+
+Reference parity: ``horovod/runner/elastic/driver.py`` ``ElasticDriver`` +
+``rendezvous.py`` (SURVEY.md §3.5, §5.3): poll the host-discovery script;
+on a membership delta recompute rank assignments, notify live workers (they
+raise ``HostsUpdatedInterrupt`` at the next commit), spawn workers on new
+hosts; on worker failure count it against the host and blacklist repeat
+offenders; hold below ``min_np``, cap at ``max_np``.
+
+TPU redesign: there is no Gloo rendezvous KV store to re-seed — the
+"rendezvous" is the JAX coordination service, which forms afresh each epoch
+at ``coordinator_addr:coordinator_port(epoch)`` when workers re-call
+``jax.distributed.initialize`` (runtime.init pulls the epoch assignment via
+``fetch_assignment``).  The driver only has to hand out consistent
+assignments and bump the epoch.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..runner import spawn
+from ..runner.hosts import HostInfo, assign_slots
+from ..runner.rpc import JsonRpcServer, json_request
+from . import registration
+from .discovery import HostDiscovery, HostDiscoveryScript
+from .worker import HostUpdateResult
+
+logger = logging.getLogger("horovod_tpu")
+
+DEFAULT_DISCOVERY_INTERVAL = float(
+    os.environ.get("HOROVOD_ELASTIC_DISCOVERY_INTERVAL", "1.0"))
+
+
+class _Worker:
+    def __init__(self, worker_id: int, slot, proc: spawn.WorkerProcess,
+                 epoch: int):
+        self.worker_id = worker_id
+        self.slot = slot
+        self.proc = proc
+        self.epoch = epoch
+        self.expected_exit = False
+
+
+class ElasticDriver:
+    def __init__(self, discovery: HostDiscovery, command: List[str],
+                 min_np: int = 1, max_np: Optional[int] = None,
+                 port: int = 29410,
+                 discovery_interval: float = DEFAULT_DISCOVERY_INTERVAL,
+                 blacklist_threshold: int = 3,
+                 start_timeout: float = 600.0,
+                 reset_limit: Optional[int] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 verbose: bool = False):
+        self.discovery = discovery
+        self.command = list(command)
+        self.min_np = min_np
+        self.max_np = max_np
+        self.port = port
+        self.interval = discovery_interval
+        self.start_timeout = start_timeout
+        self.reset_limit = reset_limit
+        self.extra_env = dict(env or {})
+        self.verbose = verbose
+        self.registry = registration.WorkerStateRegistry(blacklist_threshold)
+
+        self._lock = threading.Lock()
+        self._epoch = -1
+        self._assignment: Dict[int, dict] = {}   # worker_id → assignment
+        self._workers: Dict[int, _Worker] = {}   # live workers by id
+        self._notif: Dict[int, tuple] = {}       # worker_id → (addr, port)
+        self._next_worker_id = 0
+        self._hosts: Dict[str, int] = {}
+        self._shutdown = False
+        self._reset_count = 0
+        self._job_done = False   # a worker's train fn returned successfully
+        self._server = JsonRpcServer({
+            "assignment": self._handle_assignment,
+            "result": self._handle_result,
+            "register_notification": self._handle_register_notification,
+            "request_reform": self._handle_request_reform,
+        }, port=self.port)
+
+    # --- rpc handlers ------------------------------------------------------
+
+    def _handle_assignment(self, payload):
+        wid = int(payload["worker_id"])
+        min_epoch = int(payload.get("min_epoch", 0))
+        with self._lock:
+            if self._epoch >= min_epoch:
+                asg = self._assignment.get(wid)
+                if asg is not None:
+                    return dict(asg, ready=True, epoch=self._epoch)
+                return {"removed": True}
+            return {"ready": False, "retry_after": 0.2}
+
+    def _handle_result(self, payload):
+        wid = int(payload["worker_id"])
+        with self._lock:
+            w = self._workers.get(wid)
+            expected = ((w is not None and w.expected_exit)
+                        or wid not in self._assignment)
+        if payload["status"] == registration.FAILURE and expected:
+            # a worker removed by scale-down errors out on its way down;
+            # that is not a host failure and must not feed the blacklist
+            return {"ok": True}
+        self.registry.record_result(wid, payload["status"],
+                                    payload.get("hostname"))
+        if payload["status"] == registration.SUCCESS and not expected:
+            # the training function returned: the job is complete — peers
+            # stop at the same step, so don't re-form on their way out
+            with self._lock:
+                self._job_done = True
+        return {"ok": True}
+
+    def _handle_request_reform(self, payload):
+        """A worker hit a collective failure with no process exit and no
+        discovery delta (transient ICI/coordination error): re-form the
+        current host set under a fresh epoch so re-rendezvous can proceed.
+        Debounced on the epoch the requester last saw."""
+        seen = int(payload.get("seen_epoch", -1))
+        with self._lock:
+            if self._epoch > seen or self._job_done:
+                return {"ok": True, "epoch": self._epoch}  # already re-formed
+        try:
+            hosts = self._discover()
+        except Exception:  # noqa: BLE001 - discovery flake
+            hosts = dict(self._hosts)
+        if self._total_slots(hosts) >= self.min_np:
+            self._apply_hosts(hosts, HostUpdateResult.MIXED)
+        return {"ok": True, "epoch": self._epoch}
+
+    def _handle_register_notification(self, payload):
+        with self._lock:
+            self._notif[int(payload["worker_id"])] = (
+                payload["addr"], int(payload["port"]))
+        return {"ok": True}
+
+    # --- assignment / spawn ------------------------------------------------
+
+    def _discover(self) -> Dict[str, int]:
+        hosts = self.discovery.find_available_hosts_and_slots()
+        return {h: s for h, s in hosts.items()
+                if not self.registry.is_blacklisted(h)}
+
+    def _total_slots(self, hosts: Dict[str, int]) -> int:
+        return sum(hosts.values())
+
+    def _epoch_coordinator(self, slots) -> tuple:
+        first = slots[0].hostname
+        addr = socket.gethostname() if spawn.is_local(first) else first
+        # fresh port per epoch so a re-forming coordination service never
+        # collides with a half-closed predecessor
+        return addr, self.port + 1 + (self._epoch % 512)
+
+    def _apply_hosts(self, hosts: Dict[str, int], update_res: int):
+        """Recompute assignments for a new host set and reconcile workers.
+        Caller must NOT hold the lock."""
+        np_ = self._total_slots(hosts)
+        if self.max_np is not None:
+            np_ = min(np_, self.max_np)
+        host_infos = [HostInfo(h, s) for h, s in hosts.items()]
+        slots = assign_slots(host_infos, np_)
+        with self._lock:
+            self._epoch += 1
+            self._hosts = dict(hosts)
+            coord_addr, coord_port = self._epoch_coordinator(slots)
+            # keep existing workers on their host where possible: workers
+            # are pinned to (hostname, local slot index)
+            by_hostslot = {
+                (w.slot.hostname, w.slot.local_rank): w
+                for w in self._workers.values() if not w.expected_exit}
+            new_assignment: Dict[int, dict] = {}
+            to_spawn = []
+            assigned_wids = set()
+            for slot in slots:
+                w = by_hostslot.get((slot.hostname, slot.local_rank))
+                if w is not None:
+                    wid = w.worker_id
+                    w.slot = slot
+                    w.epoch = self._epoch
+                else:
+                    wid = self._next_worker_id
+                    self._next_worker_id += 1
+                    to_spawn.append((wid, slot))
+                assigned_wids.add(wid)
+                new_assignment[wid] = {
+                    "rank": slot.rank, "size": slot.size,
+                    "local_rank": slot.local_rank,
+                    "local_size": slot.local_size,
+                    "cross_rank": slot.cross_rank,
+                    "cross_size": slot.cross_size,
+                    "coordinator_addr": coord_addr,
+                    "coordinator_port": coord_port,
+                }
+            for w in self._workers.values():
+                if w.worker_id not in assigned_wids:
+                    w.expected_exit = True
+            self._assignment = new_assignment
+            epoch = self._epoch
+            notify = [(wid, ep) for wid, ep in self._notif.items()
+                      if wid in assigned_wids]
+        if self.verbose:
+            print(f"elastic: epoch {epoch} — {np_} slots on "
+                  f"{list(hosts)}", file=sys.stderr)
+        for wid, slot in to_spawn:
+            self._spawn_worker(wid, slot, coord_addr, coord_port, epoch)
+        self._notify_workers(notify, update_res)
+
+    def _spawn_worker(self, wid: int, slot, coord_addr, coord_port, epoch):
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env.update({
+            "HOROVOD_ELASTIC": "1",
+            "HOROVOD_ELASTIC_WORKER_ID": str(wid),
+            "HOROVOD_ELASTIC_DRIVER_ADDR": socket.gethostname(),
+            "HOROVOD_ELASTIC_DRIVER_PORT": str(self.port),
+            "HOROVOD_HOSTNAME": slot.hostname,
+        })
+        proc = self._launch(slot, coord_addr, coord_port, env)
+        with self._lock:
+            self._workers[wid] = _Worker(wid, slot, proc, epoch)
+        self.registry.record_ready(wid, slot.hostname)
+
+    def _launch(self, slot, coord_addr, coord_port, env):
+        """Process creation seam (tests substitute a stub)."""
+        return spawn.spawn_workers(
+            [slot], self.command, coord_addr, coord_port,
+            prefix_output=True, base_env=env)[0]
+
+    def _notify_workers(self, targets, update_res: int):
+        ts = time.time()
+        for wid, (addr, port) in targets:
+            try:
+                json_request(addr, port, "hosts_updated",
+                             {"timestamp": ts, "res": update_res},
+                             timeout=5.0)
+            except Exception:  # noqa: BLE001 - worker may be mid-restart
+                logger.debug("notify worker %d failed", wid, exc_info=True)
+
+    # --- monitoring loop ---------------------------------------------------
+
+    def _host_delta(self, new: Dict[str, int]) -> Optional[int]:
+        if new == self._hosts:
+            return None
+        added = any(h not in self._hosts or s > self._hosts[h]
+                    for h, s in new.items())
+        removed = any(h not in new or s < self._hosts[h]
+                      for h, s in self._hosts.items())
+        if added and removed:
+            return HostUpdateResult.MIXED
+        return (HostUpdateResult.ADDED if added
+                else HostUpdateResult.REMOVED)
+
+    def run(self) -> int:
+        # wait for enough capacity to start
+        deadline = time.monotonic() + self.start_timeout
+        while True:
+            hosts = self._discover()
+            if self._total_slots(hosts) >= self.min_np:
+                break
+            if time.monotonic() > deadline:
+                print(f"elastic: timed out below min_np={self.min_np}",
+                      file=sys.stderr)
+                return 1
+            time.sleep(self.interval)
+        self._apply_hosts(hosts, HostUpdateResult.ADDED)
+
+        try:
+            return self._monitor()
+        finally:
+            self._server.close()
+
+    def _monitor(self) -> int:
+        last_poll = 0.0
+        done_since = None
+        while True:
+            now = time.monotonic()
+            with self._lock:
+                job_done = self._job_done
+            if job_done:
+                if done_since is None:
+                    done_since = now
+                elif now - done_since > 60.0:
+                    # stragglers stuck in teardown; the job itself finished
+                    logger.warning("terminating straggler workers")
+                    self._terminate_all()
+                    return 0
+            if not job_done and now - last_poll >= self.interval:
+                last_poll = now
+                try:
+                    hosts = self._discover()
+                except Exception:  # noqa: BLE001 - discovery flake
+                    logger.warning("host discovery failed", exc_info=True)
+                    hosts = self._hosts
+                delta = self._host_delta(hosts)
+                if delta is not None:
+                    if self._total_slots(hosts) < self.min_np:
+                        print("elastic: below min_np; waiting for hosts",
+                              file=sys.stderr)
+                        self._hosts = dict(hosts)  # keep watching
+                    else:
+                        self._reset_count += 1
+                        if (self.reset_limit is not None
+                                and self._reset_count > self.reset_limit):
+                            print("elastic: reset limit exceeded",
+                                  file=sys.stderr)
+                            self._terminate_all()
+                            return 1
+                        self._apply_hosts(hosts, delta)
+
+            exit_code = self._reap_workers()
+            if exit_code is not None:
+                return exit_code
+            time.sleep(0.1)
+
+    def _reap_workers(self) -> Optional[int]:
+        """Handle worker exits; return a final exit code when the job is
+        done (all workers succeeded, or failure is unrecoverable)."""
+        with self._lock:
+            live = list(self._workers.values())
+        respawn_needed = False
+        for w in live:
+            rc = w.proc.popen.poll()
+            if rc is None:
+                continue
+            with self._lock:
+                self._workers.pop(w.worker_id, None)
+                self._notif.pop(w.worker_id, None)
+            if w.expected_exit:
+                continue
+            if rc == 0 or self.registry.state(
+                    w.worker_id) == registration.SUCCESS:
+                # a worker that reported SUCCESS before exiting finished
+                # its training fn — a messy teardown (e.g. coordination-
+                # service race) must not count as a host failure
+                self.registry.record_result(
+                    w.worker_id, registration.SUCCESS)
+            else:
+                self.registry.record_result(
+                    w.worker_id, registration.FAILURE, w.slot.hostname)
+                logger.warning("worker %d on %s exited rc=%d",
+                               w.worker_id, w.slot.hostname, rc)
+                respawn_needed = True
+
+        with self._lock:
+            n_live = sum(1 for w in self._workers.values()
+                         if not w.expected_exit)
+            job_done = self._job_done
+        if job_done:
+            if n_live == 0:
+                return 0
+            return None  # let the remaining workers drain
+        if respawn_needed:
+            hosts = self._discover()
+            if self._total_slots(hosts) < self.min_np:
+                if n_live == 0:
+                    print("elastic: no capacity left above failures",
+                          file=sys.stderr)
+                    return 1
+            else:
+                self._reset_count += 1
+                if (self.reset_limit is not None
+                        and self._reset_count > self.reset_limit):
+                    self._terminate_all()
+                    return 1
+                # re-form the job without the failed worker's process;
+                # a replacement is spawned if its host still has capacity
+                self._apply_hosts(hosts, HostUpdateResult.MIXED)
+            return None
+        if n_live == 0:
+            # everyone exited voluntarily: success iff no failures recorded
+            return 0
+        return None
+
+    def _terminate_all(self):
+        with self._lock:
+            live = list(self._workers.values())
+        for w in live:
+            try:
+                w.proc.popen.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def run_elastic_launcher(args) -> int:
+    """Entry from ``hvdrun --host-discovery-script ...`` (launch.py)."""
+    discovery = HostDiscoveryScript(args.host_discovery_script)
+    min_np = args.min_np or args.np or 1
+    driver = ElasticDriver(
+        discovery, args.command, min_np=min_np, max_np=args.max_np,
+        port=args.port, start_timeout=args.start_timeout,
+        verbose=args.verbose)
+    return driver.run()

@@ -15,10 +15,32 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 
 from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
 
 logger = logging.getLogger("horovod_tpu")
+
+try:  # a dead peer surfaces as an XLA runtime error from the collective
+    from jax.errors import JaxRuntimeError as _CollectiveRuntimeError
+except ImportError:  # pragma: no cover - older jax
+    _CollectiveRuntimeError = ()
+
+# Substrings that mark a JaxRuntimeError as a *communication* failure
+# (recoverable by re-rendezvous).  Anything else — OOM, invalid argument,
+# runtime asserts — is deterministic and must surface, not loop forever.
+_RECOVERABLE_MARKERS = (
+    "coordination", "heartbeat", "preempt", "unavailable", "deadline",
+    "connection", "peer", "aborted", "barrier", "gloo", "socket",
+    "cancelled", "timed out", "timeout",
+)
+
+
+def _is_recoverable(exc) -> bool:
+    if isinstance(exc, HorovodInternalError):
+        return True
+    msg = str(exc).lower()
+    return any(m in msg for m in _RECOVERABLE_MARKERS)
 
 
 def run(func=None, *, reset_limit: int = None):
@@ -27,32 +49,57 @@ def run(func=None, *, reset_limit: int = None):
 
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
-        from .. import runtime
+        from . import worker
         notification_manager = _get_notification_manager()
         if notification_manager is not None:
             notification_manager.register_listener(state)
+        if worker._last_epoch > 0:
+            # this worker joined a mid-flight job (spawned at epoch > 0 by
+            # the driver): receive current state before training — the
+            # incumbents' HostsUpdatedInterrupt path issues the matching
+            # sync on their side
+            logger.info("joined elastic job at epoch %d; syncing state",
+                        worker._last_epoch)
+            state.sync()
         reset_count = 0
         try:
             while True:
                 if reset_count > 0:
                     state.on_reset()
                 try:
-                    return func(state, *args, **kwargs)
-                except HorovodInternalError:
+                    result = func(state, *args, **kwargs)
+                    worker.record_result("SUCCESS")
+                    return result
+                except (HorovodInternalError, _CollectiveRuntimeError) as e:
+                    if not _is_recoverable(e):
+                        raise  # deterministic error (OOM, bad arg, …)
                     logger.warning(
-                        "collective failure; restoring last committed state "
-                        "and re-initializing")
+                        "collective failure (%s); restoring last committed "
+                        "state and re-initializing", type(e).__name__)
+                    state.evacuate()
+                    # no process died and discovery may be unchanged — ask
+                    # the driver for a fresh epoch to rendezvous under
+                    worker.request_reform()
                     _reinitialize()
                     state.restore()
                     _sync_after_reset(state, skip_sync=False)
                 except HostsUpdatedInterrupt as e:
                     logger.info("hosts updated; syncing state")
-                    _reinitialize()
+                    state.evacuate()
+                    cleared = _reinitialize()
+                    if e.skip_sync and cleared:
+                        # backends were torn down, so live device arrays
+                        # died with them — reload the last commit even
+                        # though no cross-worker sync is needed
+                        state.restore()
                     _sync_after_reset(state, skip_sync=e.skip_sync)
                 reset_count += 1
                 if reset_limit is not None and reset_count > reset_limit:
                     raise RuntimeError(
                         f"exceeded elastic reset limit ({reset_limit})")
+        except BaseException:
+            worker.record_result("FAILURE")
+            raise
         finally:
             if notification_manager is not None:
                 notification_manager.remove_listener(state)
@@ -60,12 +107,16 @@ def run(func=None, *, reset_limit: int = None):
     return wrapper
 
 
-def _reinitialize():
+def _reinitialize() -> bool:
     """Tear down and re-init the runtime so the mesh reflects the new
-    membership (reference: shutdown + init with HOROVOD_ELASTIC reset)."""
+    membership (reference: shutdown + init with HOROVOD_ELASTIC reset).
+    Returns True when the device backends were torn down (multi-process
+    re-rendezvous), which invalidates live device arrays."""
     from .. import runtime
+    cleared = runtime._state().owns_jax_distributed
     runtime.shutdown()
     runtime.init()
+    return cleared
 
 
 def _sync_after_reset(state, skip_sync: bool):
@@ -77,6 +128,21 @@ _notification_manager = None
 
 
 def _get_notification_manager():
+    """The worker's host-update listener; auto-created under the elastic
+    driver (HOROVOD_ELASTIC_DRIVER_ADDR set by driver spawn)."""
+    global _notification_manager
+    if (_notification_manager is None
+            and os.environ.get("HOROVOD_ELASTIC_DRIVER_ADDR")):
+        from .worker import WorkerNotificationManager
+        mgr = WorkerNotificationManager()
+        try:
+            mgr.init()
+        except Exception:  # noqa: BLE001 - driver unreachable; run solo
+            logger.warning("could not register with elastic driver",
+                           exc_info=True)
+            mgr.close()
+            return None
+        _notification_manager = mgr
     return _notification_manager
 
 

@@ -41,11 +41,14 @@ class State:
     def process_incoming_updates(self):
         """Raise HostsUpdatedInterrupt if the driver flagged a change."""
         from ..exceptions import HostsUpdatedInterrupt
+        from .worker import HostUpdateResult
         if self._host_messages:
             msgs = self._host_messages
             self._host_messages = []
-            # skip sync only if every update was a pure addition
-            skip = all(res == 1 for _, res in msgs)
+            # sync is skippable only when hosts were purely REMOVED: the
+            # survivors already hold consistent state, whereas any added
+            # worker starts empty and must receive state via sync
+            skip = all(res == HostUpdateResult.REMOVED for _, res in msgs)
             raise HostsUpdatedInterrupt(skip_sync=skip)
 
     # subclass interface ----------------------------------------------------
@@ -65,6 +68,10 @@ class State:
 
     def sync(self):
         raise NotImplementedError
+
+    def evacuate(self):
+        """Move snapshots to host memory ahead of a re-rendezvous (which
+        tears down device backends).  No-op for host-resident state."""
 
     def reset(self):
         pass
@@ -108,7 +115,10 @@ class ArrayState(State):
     The TPU-native analog of the reference's ``TorchState`` (model +
     optimizer + sampler): holds named pytrees of arrays; ``commit``
     device-copies them (cheap snapshot in HBM), ``restore`` re-installs,
-    ``sync`` broadcasts from worker 0 after a membership change.
+    ``sync`` broadcasts from worker 0 after a membership change.  Before a
+    re-rendezvous tears down the device backends, ``evacuate()`` (called by
+    the elastic run wrapper) moves the snapshot to host memory so it
+    survives; the per-commit path stays on-device.
     """
 
     def __init__(self, **trees):
@@ -153,6 +163,18 @@ class ArrayState(State):
             "scalars": copy.deepcopy(self._scalar_state),
         }
 
+    def evacuate(self):
+        import numpy as np
+
+        def to_host(x):
+            if hasattr(x, "dtype") and not isinstance(x, np.ndarray):
+                return np.asarray(x)
+            return x
+
+        self._saved["trees"] = {
+            k: jax.tree_util.tree_map(to_host, v)
+            for k, v in self._saved.get("trees", {}).items()}
+
     def restore(self):
         for k, v in self._saved.get("trees", {}).items():
             self._trees[k] = jax.tree_util.tree_map(_copy_leaf, v)
@@ -161,9 +183,14 @@ class ArrayState(State):
     def sync(self):
         from .. import api
         for k, tree in self._trees.items():
+            try:  # live values when valid (keeps un-committed progress)
+                live = jax.tree_util.tree_map(_copy_leaf, tree)
+            except Exception:  # noqa: BLE001 - device arrays died with the
+                # old backends during re-rendezvous; fall back to the commit
+                live = self._saved.get("trees", {}).get(k, tree)
             self._trees[k] = jax.tree_util.tree_map(
                 lambda p: api.broadcast(p, 0) if hasattr(p, "dtype") else p,
-                tree)
+                live)
         self._scalar_state = api.broadcast_object(self._scalar_state, 0)
         self.save()
 
@@ -178,6 +205,9 @@ def _is_pytree(x) -> bool:
 
 def _copy_leaf(x):
     if hasattr(x, "dtype"):
+        # device-side copy: commit() runs per batch, so the snapshot stays
+        # in HBM (cheap).  evacuate() moves it to host right before a
+        # re-rendezvous invalidates device arrays.
         import jax.numpy as jnp
         return jnp.array(x)
     return copy.deepcopy(x)

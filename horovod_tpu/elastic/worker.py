@@ -1,0 +1,152 @@
+"""Worker-side elastic services: assignment fetch + host-update listener.
+
+Reference parity: ``horovod/runner/elastic/worker.py``
+(``WorkerNotificationService`` / ``WorkerNotificationManager``): each worker
+runs a small RPC server whose address it registers with the driver; the
+driver pushes ``hosts_updated`` events there, and the next ``state.commit()``
+surfaces them as ``HostsUpdatedInterrupt``.  ``fetch_assignment`` is the
+rendezvous re-query (SURVEY.md §3.5): after a reset, the worker asks the
+driver for its place in the *current* epoch instead of trusting the spawn
+env vars.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import time
+from typing import Optional
+
+from ..runner.rpc import JsonRpcServer, json_request
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class HostUpdateResult:
+    ADDED = 1
+    REMOVED = 2
+    MIXED = 3
+
+
+def _driver_endpoint():
+    addr = os.environ.get("HOROVOD_ELASTIC_DRIVER_ADDR")
+    port = os.environ.get("HOROVOD_ELASTIC_DRIVER_PORT")
+    if not addr or not port:
+        return None
+    return addr, int(port)
+
+
+def worker_id() -> Optional[int]:
+    wid = os.environ.get("HOROVOD_ELASTIC_WORKER_ID")
+    return int(wid) if wid is not None else None
+
+
+_last_epoch = -1
+
+
+def fetch_assignment(min_epoch: Optional[int] = None,
+                     timeout: float = 600.0) -> Optional[dict]:
+    """Ask the driver for this worker's current-epoch assignment.
+
+    Blocks (polling) until the driver publishes an epoch ``>= min_epoch``
+    (default: newer than the last one this worker saw) that includes this
+    worker.  Returns None when not running under the elastic driver;
+    raises RuntimeError if the worker has been removed from the job.
+    """
+    global _last_epoch
+    ep = _driver_endpoint()
+    wid = worker_id()
+    if ep is None or wid is None:
+        return None
+    want = _last_epoch + 1 if min_epoch is None else min_epoch
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            reply = json_request(ep[0], ep[1], "assignment",
+                                 {"worker_id": wid, "min_epoch": want})
+        except Exception:  # noqa: BLE001 - transient RPC failure (driver
+            # busy re-forming / network blip): the deadline absorbs it
+            logger.debug("assignment poll failed; retrying", exc_info=True)
+            reply = {}
+        if reply.get("removed"):
+            raise RuntimeError(
+                "this worker was removed from the elastic job "
+                f"(worker_id={wid})")
+        if reply.get("ready"):
+            _last_epoch = reply["epoch"]
+            return reply
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"no elastic assignment for worker {wid} within {timeout}s")
+        time.sleep(reply.get("retry_after", 0.5))
+
+
+def request_reform():
+    """Ask the driver to re-form the job under a fresh epoch (called on a
+    collective failure that kills no process — without this, every worker
+    would wait out the full assignment timeout for an epoch bump that
+    never comes).  Best effort."""
+    ep = _driver_endpoint()
+    wid = worker_id()
+    if ep is None or wid is None:
+        return
+    try:
+        json_request(ep[0], ep[1], "request_reform",
+                     {"worker_id": wid, "seen_epoch": _last_epoch},
+                     timeout=10.0)
+    except Exception:  # noqa: BLE001
+        logger.debug("reform request failed", exc_info=True)
+
+
+def record_result(status: str):
+    """Report this worker's terminal state to the driver (best effort)."""
+    ep = _driver_endpoint()
+    wid = worker_id()
+    if ep is None or wid is None:
+        return
+    try:
+        json_request(ep[0], ep[1], "result",
+                     {"worker_id": wid, "status": status,
+                      "hostname": os.environ.get("HOROVOD_HOSTNAME",
+                                                 socket.gethostname())})
+    except Exception:  # noqa: BLE001 - driver may already be gone
+        logger.debug("result report failed", exc_info=True)
+
+
+class WorkerNotificationManager:
+    """In-worker listener the driver pushes host updates to."""
+
+    def __init__(self):
+        self._listeners = []
+        self._server = JsonRpcServer({"hosts_updated": self._on_update})
+        self._registered = False
+
+    def init(self):
+        """Register this worker's listener address with the driver."""
+        ep = _driver_endpoint()
+        wid = worker_id()
+        if ep is None or wid is None or self._registered:
+            return
+        json_request(ep[0], ep[1], "register_notification",
+                     {"worker_id": wid,
+                      "addr": socket.gethostname(),
+                      "port": self._server.port})
+        self._registered = True
+
+    def _on_update(self, payload):
+        ts = payload.get("timestamp", time.time())
+        res = payload.get("res", HostUpdateResult.MIXED)
+        for listener in list(self._listeners):
+            listener.on_hosts_updated(ts, res)
+        return {"ok": True}
+
+    def register_listener(self, state):
+        self._listeners.append(state)
+
+    def remove_listener(self, state):
+        if state in self._listeners:
+            self._listeners.remove(state)
+
+    def close(self):
+        self._server.close()

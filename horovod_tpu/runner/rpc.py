@@ -1,0 +1,75 @@
+"""Tiny JSON-over-HTTP RPC used by the elastic driver and workers.
+
+Reference parity: ``horovod/runner/http/http_server.py`` (the launcher's
+HTTP KV rendezvous store) and ``horovod/runner/common/service/*`` (driver/
+task services over sockets).  One mechanism covers both here: a threaded
+HTTP server dispatching POSTed JSON bodies to named handlers.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class JsonRpcServer:
+    """HTTP server mapping POST /<name> with a JSON body to
+    ``handlers[name](payload) -> response dict``."""
+
+    def __init__(self, handlers: Dict[str, Callable],
+                 port: int = 0, host: str = "0.0.0.0"):
+        self._handlers = dict(handlers)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 (stdlib API name)
+                name = self.path.strip("/")
+                fn = outer._handlers.get(name)
+                if fn is None:
+                    self.send_error(404, f"no handler: {name}")
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    resp = fn(payload) or {}
+                    body = json.dumps(resp).encode()
+                except Exception as e:  # noqa: BLE001 - report to caller
+                    logger.exception("rpc handler %s failed", name)
+                    self.send_error(500, str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def json_request(addr: str, port: int, name: str,
+                 payload: Optional[dict] = None,
+                 timeout: float = 30.0) -> dict:
+    """POST ``payload`` to http://addr:port/<name>; returns the JSON reply."""
+    req = urllib.request.Request(
+        f"http://{addr}:{port}/{name}",
+        data=json.dumps(payload or {}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read() or b"{}")

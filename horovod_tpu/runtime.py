@@ -31,6 +31,7 @@ import threading
 from typing import Dict, List, Optional, Sequence
 
 import jax
+import jax.extend.backend
 import numpy as np
 
 from .config import Config
@@ -270,10 +271,34 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
                 proc_id = cfg.cross_rank
             else:
                 proc_id = cfg.rank
+            dist_kwargs = {}
+            if cfg.elastic:
+                # survive peer death instead of LOG(FATAL)-ing: collectives
+                # fail with a catchable error (→ HorovodInternalError path)
+                # and this process can re-rendezvous at the next epoch
+                try:
+                    jax.config.update("jax_enable_recoverability", True)
+                except Exception:  # noqa: BLE001 - older jax
+                    logger.warning("jax recoverability unavailable")
+                hb = int(os.environ.get(
+                    "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", "10"))
+                dist_kwargs = dict(heartbeat_timeout_seconds=hb,
+                                   shutdown_timeout_seconds=hb)
+            try:
+                # a prior solo epoch (job shrunk to 1 process: distributed
+                # init skipped) may have lazily created local backends;
+                # they must go before the world re-forms
+                from jax._src import xla_bridge as _xb
+                if _xb.backends_are_initialized():
+                    jax.extend.backend.clear_backends()
+            except Exception:  # noqa: BLE001 - internal API drift
+                logger.debug("pre-init backend clear skipped",
+                             exc_info=True)
             jax.distributed.initialize(
                 coordinator_address=coordinator,
                 num_processes=n_procs,
                 process_id=proc_id,
+                **dist_kwargs,
             )
             _STATE.owns_jax_distributed = True
 
@@ -342,6 +367,22 @@ def shutdown():
                     logger.exception("shutdown hook failed")
         finally:
             if _STATE.owns_jax_distributed:
+                # With recoverable tasks the default shutdown barrier is
+                # skipped, so the leader can tear the coordination service
+                # down while peers are still disconnecting (they then die
+                # fatally).  Meet at an explicit barrier first, as the
+                # coordination service docs prescribe for recoverable mode.
+                try:
+                    from jax._src import distributed as _dist
+                    client = _dist.global_state.client
+                    if client is not None and jax.process_count() > 1:
+                        client.wait_at_barrier(
+                            "horovod_tpu_shutdown",
+                            int(float(os.environ.get(
+                                "HOROVOD_SHUTDOWN_BARRIER_TIMEOUT",
+                                "15")) * 1000))
+                except Exception:  # noqa: BLE001 - peers may be gone
+                    logger.debug("shutdown barrier failed", exc_info=True)
                 # release the coordination-service connection so an elastic
                 # re-init can re-join the (possibly re-formed) cluster
                 try:
@@ -349,6 +390,15 @@ def shutdown():
                 except Exception:  # noqa: BLE001 - peer may already be gone
                     logger.warning("jax.distributed.shutdown failed",
                                    exc_info=True)
+                # the device clients embed the old distributed world (size,
+                # process id); drop them so re-init builds fresh ones.
+                # NOTE: live device arrays die with the backends — the
+                # elastic run wrapper calls state.evacuate() (snapshot →
+                # host) before re-initializing for exactly this reason.
+                try:
+                    jax.extend.backend.clear_backends()
+                except Exception:  # noqa: BLE001 - best effort
+                    logger.warning("clear_backends failed", exc_info=True)
                 _STATE.owns_jax_distributed = False
             _STATE.initialized = False
             _STATE.engine = None

@@ -1,0 +1,350 @@
+"""Elastic driver: discovery, registry, assignment logic, live resize.
+
+Mirrors the reference's split (SURVEY.md §4): unit tests assert the
+driver's *decisions* (assignments, notifications, blacklist) without
+processes; the integration test spins up real localhost worker processes
+with a fake discovery script backed by a mutable hostfile — the reference's
+``test/integration/test_elastic_torch.py`` pattern.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from horovod_tpu.elastic import discovery, registration
+from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.elastic.worker import HostUpdateResult
+from horovod_tpu.runner.rpc import JsonRpcServer, json_request
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+# --- discovery --------------------------------------------------------------
+
+def test_parse_host_lines():
+    hosts = discovery.parse_host_lines(
+        "a:4\n\n# comment\nb:2\nbare-host\n")
+    assert hosts == {"a": 4, "b": 2, "bare-host": 1}
+
+
+def test_host_discovery_script(tmp_path):
+    hf = tmp_path / "hosts.txt"
+    hf.write_text("localhost:3\n")
+    d = discovery.HostDiscoveryScript(f"cat {hf}")
+    assert d.find_available_hosts_and_slots() == {"localhost": 3}
+    hf.write_text("localhost:1\nother:2\n")
+    assert d.find_available_hosts_and_slots() == {"localhost": 1, "other": 2}
+
+
+# --- registry ---------------------------------------------------------------
+
+def test_registry_blacklist():
+    reg = registration.WorkerStateRegistry(blacklist_threshold=2)
+    reg.record_ready(0, "hostA")
+    reg.record_result(0, registration.FAILURE)
+    assert not reg.is_blacklisted("hostA")
+    reg.record_result(1, registration.FAILURE, "hostA")
+    assert reg.is_blacklisted("hostA")
+    assert reg.blacklisted_hosts() == ("hostA",)
+    assert reg.failure_count("hostA") == 2
+
+
+# --- driver decision logic (no processes) -----------------------------------
+
+class _StubProc:
+    class _Popen:
+        def poll(self):
+            return None
+
+        def terminate(self):
+            pass
+
+    def __init__(self):
+        self.popen = self._Popen()
+
+
+class _NoSpawnDriver(ElasticDriver):
+    """Driver with process launch/notification captured, decisions real."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.spawned = []
+        self.notified = []
+
+    def _launch(self, slot, coord_addr, coord_port, env):
+        self.spawned.append(
+            (int(env["HOROVOD_ELASTIC_WORKER_ID"]), slot.hostname,
+             slot.rank))
+        return _StubProc()
+
+    def _notify_workers(self, targets, update_res):
+        self.notified.append((sorted(wid for wid, _ in targets), update_res))
+
+
+@pytest.fixture
+def nospawn():
+    d = _NoSpawnDriver(
+        discovery.FixedHostDiscovery({"localhost": 2}),
+        ["true"], min_np=1, port=free_port())
+    yield d
+    d._server.close()
+
+
+def test_driver_initial_assignment(nospawn):
+    nospawn._apply_hosts({"localhost": 2}, HostUpdateResult.ADDED)
+    assert [w for w, _, _ in nospawn.spawned] == [0, 1]
+    asg0 = nospawn._handle_assignment({"worker_id": 0, "min_epoch": 0})
+    asg1 = nospawn._handle_assignment({"worker_id": 1, "min_epoch": 0})
+    assert asg0["ready"] and asg1["ready"]
+    assert asg0["rank"] == 0 and asg1["rank"] == 1
+    assert asg0["size"] == 2 == asg1["size"]
+    assert asg0["coordinator_port"] == asg1["coordinator_port"]
+    # not-yet-published epoch blocks
+    assert nospawn._handle_assignment(
+        {"worker_id": 0, "min_epoch": 1}) == {"ready": False,
+                                              "retry_after": 0.2}
+
+
+def test_driver_scale_up_spawns_and_notifies(nospawn):
+    nospawn._apply_hosts({"localhost": 2}, HostUpdateResult.ADDED)
+    # register a notification endpoint for worker 0 only
+    nospawn._handle_register_notification(
+        {"worker_id": 0, "addr": "localhost", "port": 1})
+    nospawn.spawned.clear()
+    nospawn._apply_hosts({"localhost": 3}, HostUpdateResult.ADDED)
+    # one new worker spawned with a fresh id; survivors keep their ids
+    assert [w for w, _, _ in nospawn.spawned] == [2]
+    assert nospawn.notified[-1] == ([0], HostUpdateResult.ADDED)
+    asg = nospawn._handle_assignment({"worker_id": 2, "min_epoch": 0})
+    assert asg["rank"] == 2 and asg["size"] == 3
+
+
+def test_driver_removed_worker_gets_removed_reply(nospawn):
+    nospawn._apply_hosts({"localhost": 2, "hostB": 1},
+                         HostUpdateResult.ADDED)
+    # worker 2 lives on hostB; hostB disappears
+    nospawn._apply_hosts({"localhost": 2}, HostUpdateResult.REMOVED)
+    assert nospawn._handle_assignment(
+        {"worker_id": 2, "min_epoch": 0}) == {"removed": True}
+    # survivors re-assigned at size 2 under a bumped epoch
+    asg = nospawn._handle_assignment({"worker_id": 0, "min_epoch": 1})
+    assert asg["ready"] and asg["size"] == 2 and asg["epoch"] == 1
+
+
+def test_driver_max_np_caps_slots(nospawn):
+    nospawn.max_np = 2
+    nospawn._apply_hosts({"localhost": 8}, HostUpdateResult.ADDED)
+    assert len(nospawn.spawned) == 2
+
+
+def test_driver_blacklisted_host_excluded(nospawn):
+    for _ in range(3):
+        nospawn.registry.record_result(99, registration.FAILURE, "badhost")
+    nospawn.discovery = discovery.FixedHostDiscovery(
+        {"localhost": 1, "badhost": 4})
+    assert nospawn._discover() == {"localhost": 1}
+
+
+# --- rpc --------------------------------------------------------------------
+
+def test_json_rpc_roundtrip():
+    got = {}
+
+    def handler(payload):
+        got.update(payload)
+        return {"echo": payload["x"] * 2}
+
+    srv = JsonRpcServer({"f": handler})
+    try:
+        reply = json_request("localhost", srv.port, "f", {"x": 21})
+        assert reply == {"echo": 42}
+        assert got == {"x": 21}
+        with pytest.raises(Exception):
+            json_request("localhost", srv.port, "nope", {})
+    finally:
+        srv.close()
+
+
+# --- integration: real processes, fake discovery script ---------------------
+
+WORKER_SCRIPT = r"""
+import json, os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+import horovod_tpu as hvd
+from horovod_tpu.elastic import ObjectState
+
+TOTAL = int(os.environ["TEST_TOTAL_STEPS"])
+out = os.environ["TEST_OUT"] + "." + os.environ["HOROVOD_ELASTIC_WORKER_ID"]
+
+hvd.init()
+
+@hvd.elastic.run
+def train(state):
+    while state.step < TOTAL:
+        mesh, axis = hvd.mesh(), hvd.worker_axis()
+        n = hvd.size()
+        sh = NamedSharding(mesh, P(axis))
+        ones = np.ones(n, np.float32)
+        arr = jax.make_array_from_callback((n,), sh, lambda idx: ones[idx])
+        total = jax.jit(jnp.sum,
+                        out_shardings=NamedSharding(mesh, P()))(arr)
+        rec = {"step": state.step, "rank": hvd.rank(), "size": n,
+               "sum": float(total)}
+        with open(out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        state.step += 1
+        time.sleep(0.2)
+        state.commit()
+    return state.step
+
+train(ObjectState(step=0))
+hvd.shutdown()
+"""
+
+
+def _read_records(out_base: Path):
+    recs = []
+    for f in out_base.parent.glob(out_base.name + ".*"):
+        for line in f.read_text().splitlines():
+            recs.append(json.loads(line))
+    return recs
+
+
+def test_elastic_integration_scale_up(tmp_path):
+    """2 localhost workers → hostfile grows to 3 → job re-forms at size 3
+    and runs to completion; collective sums prove real communication."""
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("localhost:2\n")
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER_SCRIPT)
+    out_base = tmp_path / "out"
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        "TEST_TOTAL_STEPS": "14",
+        "TEST_OUT": str(out_base),
+        "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        # workers are plain CPU processes; keep them off any TPU and undo
+        # the test runner's 8-virtual-device flag (1 device per worker)
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+        "HOROVOD_CYCLE_TIME": "0.2",
+    }
+    driver = ElasticDriver(
+        discovery.HostDiscoveryScript(f"cat {hostfile}"),
+        [sys.executable, str(worker_py)],
+        min_np=2, port=free_port(), discovery_interval=0.3,
+        start_timeout=60.0, blacklist_threshold=8, env=env, verbose=False)
+
+    rc = {}
+    t = threading.Thread(target=lambda: rc.update(code=driver.run()),
+                         daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            recs = _read_records(out_base)
+            if sum(1 for r in recs if r["size"] == 2) >= 4:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"no size-2 progress; records={recs}")
+
+        hostfile.write_text("localhost:3\n")
+
+        while time.monotonic() < deadline:
+            recs = _read_records(out_base)
+            if sum(1 for r in recs if r["size"] == 3) >= 3:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"never re-formed at size 3; records={recs}")
+
+        t.join(timeout=120)
+        assert not t.is_alive(), "driver did not finish"
+        assert rc.get("code") == 0, rc
+    finally:
+        driver._terminate_all()
+        driver._server.close()
+
+    recs = _read_records(out_base)
+    # every record's allreduced sum equals its world size (real comm)
+    assert all(r["sum"] == r["size"] for r in recs), recs
+    sizes = {r["size"] for r in recs}
+    assert sizes == {2, 3}, sizes
+    # three distinct ranks participated after the resize
+    assert {r["rank"] for r in recs if r["size"] == 3} == {0, 1, 2}
+
+
+def test_elastic_integration_worker_failure_recovers(tmp_path):
+    """SIGKILL one of two workers mid-job: the driver counts the host
+    failure and re-forms the job; the survivor restores its last commit
+    (HorovodInternalError path) and training completes."""
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("localhost:2\n")
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER_SCRIPT)
+    out_base = tmp_path / "out"
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        "TEST_TOTAL_STEPS": "10",
+        "TEST_OUT": str(out_base),
+        "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+        "HOROVOD_CYCLE_TIME": "0.2",
+    }
+    driver = ElasticDriver(
+        discovery.HostDiscoveryScript(f"cat {hostfile}"),
+        [sys.executable, str(worker_py)],
+        min_np=1, port=free_port(), discovery_interval=0.3,
+        start_timeout=60.0, blacklist_threshold=5, env=env)
+
+    rc = {}
+    t = threading.Thread(target=lambda: rc.update(code=driver.run()),
+                         daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sum(1 for r in _read_records(out_base)
+                   if r["size"] == 2) >= 4:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail("no initial progress")
+
+        # SIGKILL the rank-1 worker
+        with driver._lock:
+            victim = next(w for w in driver._workers.values()
+                          if w.slot.rank == 1)
+        victim.proc.popen.kill()
+
+        t.join(timeout=180)
+        assert not t.is_alive(), "driver did not finish after failure"
+    finally:
+        driver._terminate_all()
+        driver._server.close()
+
+    assert driver.registry.failure_count("localhost") >= 1
+    recs = _read_records(out_base)
+    last_steps = {}
+    for r in recs:
+        last_steps[r["rank"]] = max(last_steps.get(r["rank"], -1), r["step"])
+    # the job reached the final step after recovery
+    assert max(last_steps.values()) == 9, last_steps

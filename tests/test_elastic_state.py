@@ -95,12 +95,20 @@ def test_state_host_update_raises_interrupt(hvd):
     assert not exc_info.value.skip_sync
 
 
-def test_state_addition_only_skips_sync(hvd):
+def test_state_removal_only_skips_sync(hvd):
+    """Sync is skippable only for pure removals: survivors already hold
+    consistent state.  Additions must sync — the joiner starts empty."""
+    from horovod_tpu.elastic.worker import HostUpdateResult
     state = ObjectState(step=0)
-    state.on_hosts_updated(0.0, 1)  # pure addition
+    state.on_hosts_updated(0.0, HostUpdateResult.REMOVED)
     with pytest.raises(HostsUpdatedInterrupt) as exc_info:
         state.commit()
     assert exc_info.value.skip_sync
+
+    state.on_hosts_updated(0.0, HostUpdateResult.ADDED)
+    with pytest.raises(HostsUpdatedInterrupt) as exc_info:
+        state.commit()
+    assert not exc_info.value.skip_sync
 
 
 # ---------------------------------------------------------------------------
