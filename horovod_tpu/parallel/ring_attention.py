@@ -27,10 +27,13 @@ from jax import lax
 NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, m, l, o, q_blk, kv_blk, t_local, causal, scale):
-    """One tile: scores q·k with causal masking by global block position,
+def _block_attend(q, k, v, m, l, o, q_start, k_start, causal, scale):
+    """One tile: scores q·k with causal masking by global token position,
     folded into the (m, l, o) online-softmax accumulator.  fp32 accumulate
     regardless of input dtype (MXU-native bf16 inputs are fine).
+
+    ``q_start``/``k_start`` are the global positions of the first query /
+    key row in this tile (q and k may be different block sizes).
 
     GQA: when q has H heads and k/v have Hkv < H heads (H % Hkv == 0),
     queries are grouped so each kv head serves H/Hkv query heads — kv
@@ -51,8 +54,8 @@ def _block_attend(q, k, v, m, l, o, q_blk, kv_blk, t_local, causal, scale):
                        preferred_element_type=jnp.float32) * scale
         s = s.reshape(B, H, Tq, Tk)
     if causal:
-        tq = jnp.arange(t_local)[:, None] + q_blk * t_local
-        tk = jnp.arange(t_local)[None, :] + kv_blk * t_local
+        tq = jnp.arange(Tq)[:, None] + q_start
+        tk = jnp.arange(Tk)[None, :] + k_start
         s = jnp.where((tk <= tq)[None, None], s, NEG_INF)
     m_new = jnp.maximum(m, s.max(axis=-1))            # [B, H, Tq]
     p = jnp.exp(s - m_new[..., None])                  # [B, H, Tq, Tk]
@@ -70,6 +73,62 @@ def _block_attend(q, k, v, m, l, o, q_blk, kv_blk, t_local, causal, scale):
         pv = pv.reshape(B, Tq, H, D)
     o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, o_new
+
+
+def local_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_size: int = 512):
+    """Exact single-shard attention with O(T·block) live memory.
+
+    Online-softmax ``lax.scan`` over key/value blocks; each block step is
+    ``jax.checkpoint``-ed so the backward pass recomputes tiles instead of
+    saving the ``[B,H,T,T]`` score matrix (the flash-attention recurrence
+    expressed in XLA).  On TPU the fused Pallas kernel path
+    (:mod:`horovod_tpu.ops.flash_attention`) is preferred when the shapes
+    fit; this is the portable fallback and the CPU-mesh test path.
+
+    q: ``[B, T, H, D]``; k/v: ``[B, Tk, Hkv, D]`` with ``Hkv | H`` (GQA).
+    """
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    B, T, H, D = q.shape
+    Tk, Hkv = k.shape[1], k.shape[2]
+
+    from ..ops import flash_attention as _fa
+    if _fa.supported(q, k, v, causal):
+        return _fa.flash_attention(q, k, v, causal=causal, sm_scale=scale)
+
+    blk = min(block_size, Tk)
+    if Tk % blk:
+        # largest divisor of Tk that fits the requested block, so the
+        # O(T·blk) bound survives odd sequence lengths; truly degenerate
+        # sizes (no divisor ≥ 64) collapse to one checkpointed tile
+        blk = next((b for b in range(blk, 63, -1) if Tk % b == 0), Tk)
+    nblk = Tk // blk
+
+    m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    o0 = jnp.zeros((B, T, H, D), jnp.float32)
+    if nblk == 1:
+        attend_once = jax.checkpoint(
+            functools.partial(_block_attend, causal=causal, scale=scale))
+        m, l, o = attend_once(q, k, v, m0, l0, o0, 0, 0)
+        return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+    attend = jax.checkpoint(
+        functools.partial(_block_attend, causal=causal, scale=scale))
+    # kv laid out block-major as scan xs: [nblk, B, blk, Hkv, D]
+    kb = k.reshape(B, nblk, blk, Hkv, D).swapaxes(0, 1)
+    vb = v.reshape(B, nblk, blk, Hkv, D).swapaxes(0, 1)
+
+    def step(carry, xs):
+        m, l, o = carry
+        kj, vj, k_start = xs
+        m, l, o = attend(q, kj, vj, m, l, o, 0, k_start)
+        return (m, l, o), None
+
+    starts = jnp.arange(nblk, dtype=jnp.int32) * blk
+    (m, l, o), _ = lax.scan(step, (m0, l0, o0), (kb, vb, starts))
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
 def ring_attention(q, k, v, axis_name: Optional[str] = None,
@@ -92,23 +151,18 @@ def ring_attention(q, k, v, axis_name: Optional[str] = None,
     B, Tl, H, D = q.shape
 
     if n == 1:
-        m = jnp.full((B, H, Tl), NEG_INF, jnp.float32)
-        l = jnp.zeros((B, H, Tl), jnp.float32)
-        o = jnp.zeros((B, Tl, H, D), jnp.float32)
-        m, l, o = _block_attend(q, k, v, m, l, o, 0, 0, Tl, causal, scale)
-        return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+        return local_attention(q, k, v, causal=causal, sm_scale=scale)
 
     my_blk = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     attend = jax.checkpoint(
-        functools.partial(_block_attend, t_local=Tl, causal=causal,
-                          scale=scale))
+        functools.partial(_block_attend, causal=causal, scale=scale))
 
     def step(carry, s):
         m, l, o, ck, cv = carry
         kv_blk = (my_blk - s) % n  # whose block we hold after s rotations
-        m, l, o = attend(q, ck, cv, m, l, o, my_blk, kv_blk)
+        m, l, o = attend(q, ck, cv, m, l, o, my_blk * Tl, kv_blk * Tl)
         # rotate k/v around the ICI ring (skipped result on last step is
         # dead code XLA drops)
         ck = lax.ppermute(ck, axis_name, perm)
