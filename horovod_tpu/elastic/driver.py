@@ -46,6 +46,12 @@ class _Worker:
         self.proc = proc
         self.epoch = epoch
         self.expected_exit = False
+        # True once the worker reported it finished rendezvous ("running"
+        # RPC).  Deaths before that are re-rendezvous churn — jax's
+        # coordination client LOG(FATAL)s on stale-epoch registration
+        # timeouts, and the respawn is the recovery — so they must not
+        # consume blacklist or reset budget.
+        self.started = False
 
 
 class ElasticDriver:
@@ -71,6 +77,13 @@ class ElasticDriver:
         self.registry = registration.WorkerStateRegistry(blacklist_threshold)
 
         self._lock = threading.Lock()
+        # serializes discover→apply sequences: concurrent reform requests
+        # (every worker reports the same collective failure at once, and
+        # the RPC server handles them concurrently) and the monitor thread
+        # must not each pass the epoch debounce and double-bump the epoch
+        # with two different coordinator ports.  RLock: _apply_hosts also
+        # takes it so every call site is covered.
+        self._reform_lock = threading.RLock()
         self._epoch = -1
         self._assignment: Dict[int, dict] = {}   # worker_id → assignment
         self._workers: Dict[int, _Worker] = {}   # live workers by id
@@ -80,9 +93,11 @@ class ElasticDriver:
         self._shutdown = False
         self._reset_count = 0
         self._job_done = False   # a worker's train fn returned successfully
+        self._last_progress = time.monotonic()
         self._server = JsonRpcServer({
             "assignment": self._handle_assignment,
             "result": self._handle_result,
+            "running": self._handle_running,
             "register_notification": self._handle_register_notification,
             "request_reform": self._handle_request_reform,
         }, port=self.port)
@@ -125,16 +140,29 @@ class ElasticDriver:
         current host set under a fresh epoch so re-rendezvous can proceed.
         Debounced on the epoch the requester last saw."""
         seen = int(payload.get("seen_epoch", -1))
+        with self._reform_lock:
+            # re-check the debounce inside the reform lock: only one
+            # reform per observed epoch may run
+            with self._lock:
+                if self._epoch > seen or self._job_done:
+                    return {"ok": True, "epoch": self._epoch}
+            hosts = self._discover_or_current("reform request")
+            if self._total_slots(hosts) >= self.min_np:
+                self._apply_hosts(hosts, HostUpdateResult.MIXED)
         with self._lock:
-            if self._epoch > seen or self._job_done:
-                return {"ok": True, "epoch": self._epoch}  # already re-formed
-        try:
-            hosts = self._discover()
-        except Exception:  # noqa: BLE001 - discovery flake
-            hosts = dict(self._hosts)
-        if self._total_slots(hosts) >= self.min_np:
-            self._apply_hosts(hosts, HostUpdateResult.MIXED)
-        return {"ok": True, "epoch": self._epoch}
+            return {"ok": True, "epoch": self._epoch}
+
+    def _handle_running(self, payload):
+        wid = int(payload["worker_id"])
+        epoch = int(payload.get("epoch", -1))
+        with self._lock:
+            w = self._workers.get(wid)
+            # ignore a late report from a previous epoch: the worker was
+            # re-pinned and must re-rendezvous before it counts as started
+            if w is not None and epoch == w.epoch:
+                w.started = True
+                self._last_progress = time.monotonic()
+        return {"ok": True}
 
     def _handle_register_notification(self, payload):
         with self._lock:
@@ -149,6 +177,17 @@ class ElasticDriver:
         return {h: s for h, s in hosts.items()
                 if not self.registry.is_blacklisted(h)}
 
+    def _discover_or_current(self, context: str) -> Dict[str, int]:
+        """Discover hosts; on a transient discovery flake fall back to the
+        current set instead of crashing the driver."""
+        try:
+            return self._discover()
+        except Exception:  # noqa: BLE001 - discovery flake
+            logger.warning("host discovery failed (%s)", context,
+                           exc_info=True)
+            with self._lock:
+                return dict(self._hosts)
+
     def _total_slots(self, hosts: Dict[str, int]) -> int:
         return sum(hosts.values())
 
@@ -161,7 +200,15 @@ class ElasticDriver:
 
     def _apply_hosts(self, hosts: Dict[str, int], update_res: int):
         """Recompute assignments for a new host set and reconcile workers.
-        Caller must NOT hold the lock."""
+        Caller must NOT hold ``self._lock`` (``self._reform_lock`` is
+        taken here and is reentrant)."""
+        self._reform_lock.acquire()
+        try:
+            self._apply_hosts_locked(hosts, update_res)
+        finally:
+            self._reform_lock.release()
+
+    def _apply_hosts_locked(self, hosts: Dict[str, int], update_res: int):
         np_ = self._total_slots(hosts)
         if self.max_np is not None:
             np_ = min(np_, self.max_np)
@@ -170,12 +217,20 @@ class ElasticDriver:
         with self._lock:
             self._epoch += 1
             self._hosts = dict(hosts)
+            # the new epoch gets a fresh rendezvous window: churn deaths
+            # are tolerated until start_timeout from THIS re-form, not
+            # from the last 'running' report hours ago
+            self._last_progress = time.monotonic()
             coord_addr, coord_port = self._epoch_coordinator(slots)
             # keep existing workers on their host where possible: workers
-            # are pinned to (hostname, local slot index)
+            # are pinned to (hostname, local slot index).  A worker whose
+            # process has already died must NOT be re-pinned — the new
+            # epoch would wait on a corpse — and is left un-"expected" so
+            # the reaper still accounts for its death (blacklist vs churn)
             by_hostslot = {
                 (w.slot.hostname, w.slot.local_rank): w
-                for w in self._workers.values() if not w.expected_exit}
+                for w in self._workers.values()
+                if not w.expected_exit and w.proc.popen.poll() is None}
             new_assignment: Dict[int, dict] = {}
             to_spawn = []
             assigned_wids = set()
@@ -185,6 +240,9 @@ class ElasticDriver:
                     wid = w.worker_id
                     w.slot = slot
                     w.epoch = self._epoch
+                    # must re-rendezvous into this epoch; deaths before the
+                    # fresh "running" report are churn, not host failures
+                    w.started = False
                 else:
                     wid = self._next_worker_id
                     self._next_worker_id += 1
@@ -200,7 +258,8 @@ class ElasticDriver:
                     "coordinator_port": coord_port,
                 }
             for w in self._workers.values():
-                if w.worker_id not in assigned_wids:
+                if (w.worker_id not in assigned_wids
+                        and w.proc.popen.poll() is None):
                     w.expected_exit = True
             self._assignment = new_assignment
             epoch = self._epoch
@@ -247,12 +306,12 @@ class ElasticDriver:
     # --- monitoring loop ---------------------------------------------------
 
     def _host_delta(self, new: Dict[str, int]) -> Optional[int]:
-        if new == self._hosts:
+        with self._lock:
+            cur = dict(self._hosts)
+        if new == cur:
             return None
-        added = any(h not in self._hosts or s > self._hosts[h]
-                    for h, s in new.items())
-        removed = any(h not in new or s < self._hosts[h]
-                      for h, s in self._hosts.items())
+        added = any(h not in cur or s > cur[h] for h, s in new.items())
+        removed = any(h not in new or s < cur[h] for h, s in cur.items())
         if added and removed:
             return HostUpdateResult.MIXED
         return (HostUpdateResult.ADDED if added
@@ -270,6 +329,8 @@ class ElasticDriver:
                       file=sys.stderr)
                 return 1
             time.sleep(self.interval)
+        with self._lock:
+            self._last_progress = time.monotonic()
         self._apply_hosts(hosts, HostUpdateResult.ADDED)
 
         try:
@@ -294,26 +355,28 @@ class ElasticDriver:
                     return 0
             if not job_done and now - last_poll >= self.interval:
                 last_poll = now
-                try:
-                    hosts = self._discover()
-                except Exception:  # noqa: BLE001 - discovery flake
-                    logger.warning("host discovery failed", exc_info=True)
-                    hosts = self._hosts
-                delta = self._host_delta(hosts)
-                if delta is not None:
-                    if self._total_slots(hosts) < self.min_np:
-                        print("elastic: below min_np; waiting for hosts",
-                              file=sys.stderr)
-                        self._hosts = dict(hosts)  # keep watching
-                    else:
-                        self._reset_count += 1
-                        if (self.reset_limit is not None
-                                and self._reset_count > self.reset_limit):
-                            print("elastic: reset limit exceeded",
+                hosts = self._discover_or_current("monitor poll")
+                with self._reform_lock:
+                    # delta computed INSIDE the reform lock: a concurrent
+                    # request_reform may have just applied this same host
+                    # set, and re-applying would double-bump the epoch and
+                    # spuriously consume reset budget
+                    delta = self._host_delta(hosts)
+                    if delta is not None:
+                        if self._total_slots(hosts) < self.min_np:
+                            print("elastic: below min_np; waiting for hosts",
                                   file=sys.stderr)
-                            self._terminate_all()
-                            return 1
-                        self._apply_hosts(hosts, delta)
+                            with self._lock:
+                                self._hosts = dict(hosts)  # keep watching
+                        else:
+                            self._reset_count += 1
+                            if (self.reset_limit is not None
+                                    and self._reset_count > self.reset_limit):
+                                print("elastic: reset limit exceeded",
+                                      file=sys.stderr)
+                                self._terminate_all()
+                                return 1
+                            self._apply_hosts(hosts, delta)
 
             exit_code = self._reap_workers()
             if exit_code is not None:
@@ -326,6 +389,7 @@ class ElasticDriver:
         with self._lock:
             live = list(self._workers.values())
         respawn_needed = False
+        counted_failure = False
         for w in live:
             rc = w.proc.popen.poll()
             if rc is None:
@@ -342,12 +406,22 @@ class ElasticDriver:
                 # service race) must not count as a host failure
                 self.registry.record_result(
                     w.worker_id, registration.SUCCESS)
+            elif not w.started:
+                # died before completing rendezvous: jax's coordination
+                # client FATALs on stale-epoch registration timeouts and
+                # dead-leader disconnects — the respawn is the recovery,
+                # so don't feed the blacklist or the reset budget
+                logger.info("worker %d on %s died during rendezvous "
+                            "(rc=%d); respawning", w.worker_id,
+                            w.slot.hostname, rc)
+                respawn_needed = True
             else:
                 self.registry.record_result(
                     w.worker_id, registration.FAILURE, w.slot.hostname)
                 logger.warning("worker %d on %s exited rc=%d",
                                w.worker_id, w.slot.hostname, rc)
                 respawn_needed = True
+                counted_failure = True
 
         with self._lock:
             n_live = sum(1 for w in self._workers.values()
@@ -358,18 +432,31 @@ class ElasticDriver:
                 return 0
             return None  # let the remaining workers drain
         if respawn_needed:
-            hosts = self._discover()
+            with self._lock:
+                stalled = (time.monotonic() - self._last_progress
+                           > self.start_timeout)
+            if not counted_failure and stalled:
+                # pure rendezvous churn with no worker EVER reaching
+                # running state for start_timeout: the job cannot form
+                print("elastic: no worker completed rendezvous within "
+                      f"{self.start_timeout}s", file=sys.stderr)
+                self._terminate_all()
+                return 1
+            hosts = self._discover_or_current("respawn")
             if self._total_slots(hosts) < self.min_np:
                 if n_live == 0:
                     print("elastic: no capacity left above failures",
                           file=sys.stderr)
                     return 1
             else:
-                self._reset_count += 1
-                if (self.reset_limit is not None
-                        and self._reset_count > self.reset_limit):
-                    self._terminate_all()
-                    return 1
+                if counted_failure:
+                    # reset budget is consumed by real failures only,
+                    # not by re-rendezvous churn respawns
+                    self._reset_count += 1
+                    if (self.reset_limit is not None
+                            and self._reset_count > self.reset_limit):
+                        self._terminate_all()
+                        return 1
                 # re-form the job without the failed worker's process;
                 # a replacement is spawned if its host still has capacity
                 self._apply_hosts(hosts, HostUpdateResult.MIXED)

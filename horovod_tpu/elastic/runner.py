@@ -21,12 +21,7 @@ from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
 
 logger = logging.getLogger("horovod_tpu")
 
-try:  # a dead peer surfaces as an XLA runtime error from the collective
-    from jax.errors import JaxRuntimeError as _CollectiveRuntimeError
-except ImportError:  # pragma: no cover - older jax
-    _CollectiveRuntimeError = ()
-
-# Substrings that mark a JaxRuntimeError as a *communication* failure
+# Substrings that mark an exception as a *communication* failure
 # (recoverable by re-rendezvous).  Anything else — OOM, invalid argument,
 # runtime asserts — is deterministic and must surface, not loop forever.
 _RECOVERABLE_MARKERS = (
@@ -35,10 +30,23 @@ _RECOVERABLE_MARKERS = (
     "cancelled", "timed out", "timeout",
 )
 
+# Exception types XLA uses to surface collective failures: JaxRuntimeError
+# on TPU, and plain ValueError("UNKNOWN: Gloo all-reduce failed ...") on
+# the CPU mesh.  The type gate keeps arbitrary user-code errors (network
+# libraries, assertions) whose messages happen to contain a marker from
+# triggering a global re-form loop.
+try:
+    from jax.errors import JaxRuntimeError as _JaxRuntimeError
+    _RECOVERABLE_TYPES = (HorovodInternalError, _JaxRuntimeError, ValueError)
+except ImportError:  # pragma: no cover - older jax
+    _RECOVERABLE_TYPES = (HorovodInternalError, ValueError)
+
 
 def _is_recoverable(exc) -> bool:
     if isinstance(exc, HorovodInternalError):
         return True
+    if not isinstance(exc, _RECOVERABLE_TYPES):
+        return False
     msg = str(exc).lower()
     return any(m in msg for m in _RECOVERABLE_MARKERS)
 
@@ -70,7 +78,21 @@ def run(func=None, *, reset_limit: int = None):
                     result = func(state, *args, **kwargs)
                     worker.record_result("SUCCESS")
                     return result
-                except (HorovodInternalError, _CollectiveRuntimeError) as e:
+                except HostsUpdatedInterrupt as e:
+                    logger.info("hosts updated; syncing state")
+                    state.evacuate()
+                    cleared = _reinitialize()
+                    if e.skip_sync and cleared:
+                        # backends were torn down, so live device arrays
+                        # died with them — reload the last commit even
+                        # though no cross-worker sync is needed
+                        state.restore()
+                    _sync_after_reset(state, skip_sync=e.skip_sync)
+                except Exception as e:  # noqa: BLE001 - XLA surfaces
+                    # collective failures inconsistently across backends:
+                    # JaxRuntimeError on TPU, plain ValueError("UNKNOWN:
+                    # Gloo all-reduce failed ...") on the CPU mesh — the
+                    # recoverability *markers* decide, not the type
                     if not _is_recoverable(e):
                         raise  # deterministic error (OOM, bad arg, …)
                     logger.warning(
@@ -83,16 +105,6 @@ def run(func=None, *, reset_limit: int = None):
                     _reinitialize()
                     state.restore()
                     _sync_after_reset(state, skip_sync=False)
-                except HostsUpdatedInterrupt as e:
-                    logger.info("hosts updated; syncing state")
-                    state.evacuate()
-                    cleared = _reinitialize()
-                    if e.skip_sync and cleared:
-                        # backends were torn down, so live device arrays
-                        # died with them — reload the last commit even
-                        # though no cross-worker sync is needed
-                        state.restore()
-                    _sync_after_reset(state, skip_sync=e.skip_sync)
                 reset_count += 1
                 if reset_limit is not None and reset_count > reset_limit:
                     raise RuntimeError(

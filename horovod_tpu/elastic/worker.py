@@ -99,6 +99,29 @@ def request_reform():
         logger.debug("reform request failed", exc_info=True)
 
 
+def record_running():
+    """Tell the driver this worker finished rendezvous and is training.
+
+    The driver uses this to tell real host failures (worker was running,
+    then died → blacklist accounting) from rendezvous churn (jax's
+    coordination client LOG(FATAL)s the process on a stale-epoch
+    registration timeout — the respawn IS the recovery, and must not
+    consume blacklist or reset budget).  Best effort.
+    """
+    ep = _driver_endpoint()
+    wid = worker_id()
+    if ep is None or wid is None:
+        return
+    try:
+        # carry the epoch this worker rendezvoused into so the driver can
+        # drop reports that raced with a newer re-form
+        json_request(ep[0], ep[1], "running",
+                     {"worker_id": wid, "epoch": _last_epoch},
+                     timeout=5.0)
+    except Exception:  # noqa: BLE001
+        logger.debug("running report failed", exc_info=True)
+
+
 def record_result(status: str):
     """Report this worker's terminal state to the driver (best effort)."""
     ep = _driver_endpoint()

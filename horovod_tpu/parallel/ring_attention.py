@@ -105,18 +105,21 @@ def local_attention(q, k, v, causal: bool = True,
         blk = next((b for b in range(blk, 63, -1) if Tk % b == 0), Tk)
     nblk = Tk // blk
 
-    m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, T), jnp.float32)
-    o0 = jnp.zeros((B, T, H, D), jnp.float32)
-    if nblk == 1:
-        attend_once = jax.checkpoint(
-            functools.partial(_block_attend, causal=causal, scale=scale))
-        m, l, o = attend_once(q, k, v, m0, l0, o0, 0, 0)
-        return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
-
+    # derive accumulators from the operands (×0) so they inherit their
+    # varying mesh axes (dp/tp/…) — scan carries must match the body
+    # output's VMA exactly under shard_map check_vma=True
+    opzero = ((q.astype(jnp.float32) * 0).sum()
+              + (k.astype(jnp.float32) * 0).sum()
+              + (v.astype(jnp.float32) * 0).sum())
+    zero_bht = (q[:, :, :, 0].transpose(0, 2, 1) * 0
+                ).astype(jnp.float32) + opzero
+    m0 = zero_bht + NEG_INF
+    l0 = zero_bht
+    o0 = (q * 0).astype(jnp.float32) + opzero
     attend = jax.checkpoint(
         functools.partial(_block_attend, causal=causal, scale=scale))
     # kv laid out block-major as scan xs: [nblk, B, blk, Hkv, D]
+    # (nblk == 1 degenerates to a length-1 scan over the single tile)
     kb = k.reshape(B, nblk, blk, Hkv, D).swapaxes(0, 1)
     vb = v.reshape(B, nblk, blk, Hkv, D).swapaxes(0, 1)
 

@@ -28,6 +28,7 @@ import atexit
 import logging
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -236,35 +237,50 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
         cfg = Config.from_env()
         _setup_logging(cfg)
 
-        # Elastic: under the elastic driver, the env-var assignment is only
-        # the initial one — pull the CURRENT epoch's assignment (rank/size/
-        # coordinator) so re-init after a membership change re-rendezvouses
-        # into the new world (reference: elastic rendezvous re-query, §3.5).
-        if cfg.elastic:
-            from .elastic.worker import fetch_assignment
-            asg = fetch_assignment()
-            if asg is not None:
-                cfg.rank = asg["rank"]
-                cfg.size = asg["size"]
-                cfg.local_rank = asg["local_rank"]
-                cfg.local_size = asg["local_size"]
-                cfg.cross_rank = asg["cross_rank"]
-                cfg.cross_size = asg["cross_size"]
-                cfg.rendezvous_addr = asg["coordinator_addr"]
-                cfg.rendezvous_port = asg["coordinator_port"]
-                cfg.num_processes = asg["size"]
-                cfg.process_id = asg["rank"]
         _STATE.config = cfg
 
-        # Multi-process rendezvous via the JAX coordination service (the
-        # TPU-native replacement for MPI/Gloo rendezvous, SURVEY.md §5.8).
-        # Process count/id resolution: prefer the launcher's explicit
-        # HOROVOD_NUM_PROCESSES/PROCESS_ID; fall back to the cross_* vars
-        # (one process per host driving all its chips) and finally to
-        # rank/size (one process per worker).
-        n_procs = cfg.num_processes or cfg.cross_size or cfg.size
-        if n_procs is not None and n_procs > 1 and cfg.rendezvous_addr:
-            coordinator = f"{cfg.rendezvous_addr}:{cfg.rendezvous_port or 9999}"
+        # Elastic rendezvous retry loop: a worker blocked in a stale
+        # epoch's coordination-service barrier (its peers died before
+        # joining) must not hang forever — each attempt re-fetches the
+        # driver's CURRENT assignment (reference: elastic rendezvous
+        # re-query, §3.5), so when the driver bumps the epoch mid-wait
+        # the next attempt rendezvouses into the new world.
+        start_deadline = time.monotonic() + float(os.environ.get(
+            "HOROVOD_ELASTIC_START_TIMEOUT", "600"))
+        attempt = 0
+        while True:
+            if cfg.elastic:
+                from .elastic import worker as elastic_worker
+                # first attempt wants an epoch newer than the last one this
+                # worker saw (request_reform guarantees the bump); retries
+                # accept the latest published epoch, whatever it is
+                min_ep = (None if attempt == 0
+                          else max(elastic_worker._last_epoch, 0))
+                asg = elastic_worker.fetch_assignment(min_epoch=min_ep)
+                if asg is not None:
+                    cfg.rank = asg["rank"]
+                    cfg.size = asg["size"]
+                    cfg.local_rank = asg["local_rank"]
+                    cfg.local_size = asg["local_size"]
+                    cfg.cross_rank = asg["cross_rank"]
+                    cfg.cross_size = asg["cross_size"]
+                    cfg.rendezvous_addr = asg["coordinator_addr"]
+                    cfg.rendezvous_port = asg["coordinator_port"]
+                    cfg.num_processes = asg["size"]
+                    cfg.process_id = asg["rank"]
+
+            # Multi-process rendezvous via the JAX coordination service
+            # (the TPU-native replacement for MPI/Gloo rendezvous, SURVEY.md
+            # §5.8).  Process count/id resolution: prefer the launcher's
+            # explicit HOROVOD_NUM_PROCESSES/PROCESS_ID; fall back to the
+            # cross_* vars (one process per host driving all its chips) and
+            # finally to rank/size (one process per worker).
+            n_procs = cfg.num_processes or cfg.cross_size or cfg.size
+            if not (n_procs is not None and n_procs > 1
+                    and cfg.rendezvous_addr):
+                break  # single-process: nothing to rendezvous
+            coordinator = (
+                f"{cfg.rendezvous_addr}:{cfg.rendezvous_port or 9999}")
             if cfg.process_id is not None:
                 proc_id = cfg.process_id
             elif cfg.num_processes is None and cfg.cross_rank is not None:
@@ -282,8 +298,11 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
                     logger.warning("jax recoverability unavailable")
                 hb = int(os.environ.get(
                     "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", "10"))
-                dist_kwargs = dict(heartbeat_timeout_seconds=hb,
-                                   shutdown_timeout_seconds=hb)
+                dist_kwargs = dict(
+                    heartbeat_timeout_seconds=hb,
+                    shutdown_timeout_seconds=hb,
+                    initialization_timeout=int(os.environ.get(
+                        "HOROVOD_ELASTIC_INIT_TIMEOUT", "30")))
             try:
                 # a prior solo epoch (job shrunk to 1 process: distributed
                 # init skipped) may have lazily created local backends;
@@ -294,13 +313,27 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
             except Exception:  # noqa: BLE001 - internal API drift
                 logger.debug("pre-init backend clear skipped",
                              exc_info=True)
-            jax.distributed.initialize(
-                coordinator_address=coordinator,
-                num_processes=n_procs,
-                process_id=proc_id,
-                **dist_kwargs,
-            )
-            _STATE.owns_jax_distributed = True
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator,
+                    num_processes=n_procs,
+                    process_id=proc_id,
+                    **dist_kwargs,
+                )
+                _STATE.owns_jax_distributed = True
+                break
+            except Exception as e:  # noqa: BLE001 - barrier timeout /
+                # half-dead coordinator; non-elastic jobs fail loudly
+                if not cfg.elastic or time.monotonic() > start_deadline:
+                    raise
+                attempt += 1
+                logger.warning(
+                    "elastic rendezvous attempt %d failed (%s); "
+                    "re-fetching assignment", attempt, e)
+                try:
+                    jax.distributed.shutdown()
+                except Exception:  # noqa: BLE001 - partial init
+                    pass
 
         _STATE.devices = list(jax.devices())
         n = len(_STATE.devices)
@@ -344,6 +377,11 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
 
         _STATE.initialized = True
         atexit.register(shutdown)
+        if cfg.elastic:
+            # rendezvous complete: the driver now counts a death of this
+            # worker as a real host failure, not re-rendezvous churn
+            from .elastic.worker import record_running
+            record_running()
         logger.info(
             "horovod_tpu initialized: %d workers (%d local), process %d/%d",
             n, jax.local_device_count(), jax.process_index(),
