@@ -280,21 +280,27 @@ def barrier(process_set=None):
         multihost_barrier("hvd_barrier")
 
 
-_joined = False
-
-
 def join(device: int = -1) -> int:
     """Signal that this worker has no more tensors to reduce this epoch.
 
-    Reference: hvd.join (JoinOp) — lets ranks with uneven batch counts
-    finish: remaining allreduces see zero contributions from joined ranks.
-    Under a single controller all chips run one program, so uneven
-    *per-chip* input cannot arise; ``join`` degenerates to a cross-process
-    barrier and returns the last joining worker's rank, preserving the
-    reference's return contract.
+    Reference: hvd.join (JoinOp, SURVEY §2.2) — lets processes with uneven
+    batch counts finish: while this process is joined it keeps answering
+    negotiation rounds and co-executes peers' remaining allreduces with
+    zero contributions, until every process has joined.  Returns the rank
+    of the last worker to join (the one with the most batches), matching
+    the reference's return contract.  ``device`` is accepted for API
+    compatibility and ignored (XLA owns device placement).
+
+    Within one process all chips run one program, so uneven *per-chip*
+    input cannot arise; single-process jobs return immediately.
     """
-    _require_init()
-    barrier()
+    st = _require_init()
+    import jax
+    eng = st.engine
+    if (eng is not None and eng._controller is not None
+            and eng._controller.enabled):
+        last_process = eng.join()
+        return last_process * max(jax.local_device_count(), 1)
     return runtime.size() - 1
 
 

@@ -97,6 +97,9 @@ class Config:
     worker_axis: str = "workers"
     # use the native C++ core (_hvd_core) when available
     use_native_core: bool = True
+    # cross-process negotiation controller (reference: controller.cc);
+    # HOROVOD_TPU_CONTROLLER=0 falls back to assumed-identical submission
+    controller_enabled: bool = True
     # operations forced on/off
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
@@ -150,6 +153,8 @@ class Config:
         c.process_id = None if c.process_id < 0 else c.process_id
         c.use_native_core = _env_bool(
             "HOROVOD_TPU_NATIVE_CORE", c.use_native_core)
+        c.controller_enabled = _env_bool(
+            "HOROVOD_TPU_CONTROLLER", c.controller_enabled)
         c.hierarchical_allreduce = _env_bool(
             "HOROVOD_HIERARCHICAL_ALLREDUCE", c.hierarchical_allreduce)
         c.hierarchical_allgather = _env_bool(

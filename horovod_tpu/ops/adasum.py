@@ -55,6 +55,11 @@ def adasum_tree(contribs: List[jnp.ndarray]) -> jnp.ndarray:
     return level[0]
 
 
+def reset_kernel_caches():
+    """See collectives.reset_kernel_caches (re-init invalidation)."""
+    _stacked_adasum_fn.cache_clear()
+
+
 @functools.lru_cache(maxsize=256)
 def _stacked_adasum_fn(mesh_key, axis, n, shapes, has_pre, has_post):
     from .collectives import _MESHES

@@ -62,18 +62,52 @@ def is_stacked(x, ps) -> bool:
     return False
 
 
+def spans_processes(ps) -> bool:
+    """True when the process set's mesh includes devices of other processes
+    (the collective must ride DCN/ICI across hosts).  Cached per set."""
+    return ps.spans_processes
+
+
 def stack_on_workers(values: Sequence, ps=None):
     """Build a stacked per-worker array: ``values[i]`` becomes worker *i*'s
     contribution.  TPU-native helper for the reference's rank-dependent-input
-    idiom (each rank constructs its own tensor)."""
+    idiom (each rank constructs its own tensor).
+
+    Multi-process: every process must call this with the same ``values``
+    (the SPMD contract); each materializes only its addressable shards.
+    """
     from .. import runtime
     ps = ps or runtime._get_global_process_set()
-    arr = jnp.stack([jnp.asarray(v) for v in values])
-    if arr.shape[0] != ps.size():
+    vals = [np.asarray(v) for v in values]
+    if len(vals) != ps.size():
         raise ValueError(
-            f"need one value per worker ({ps.size()}), got {arr.shape[0]}")
+            f"need one value per worker ({ps.size()}), got {len(vals)}")
+    arr = np.stack(vals)
     sharding = NamedSharding(ps.mesh, P(ps.axis))
-    return jax.device_put(arr, sharding)
+    if not spans_processes(ps):
+        return jax.device_put(jnp.asarray(arr), sharding)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
+def lift_to_workers(x, ps):
+    """Lift this process's local array to a stacked per-worker global array.
+
+    The eager multi-process contribution path (reference: each rank's
+    tensor in EnqueueTensorAllreduce): every chip this process drives
+    contributes ``x``; peer processes' chips contribute their own values.
+    All processes must lift the same (name, shape, dtype) in the same
+    cycle — the property the cross-process controller negotiates.
+    """
+    x = np.asarray(x)
+    n = ps.size()
+    sharding = NamedSharding(ps.mesh, P(ps.axis))
+
+    def cb(idx):
+        rows = len(range(*idx[0].indices(n)))
+        return np.broadcast_to(x, (rows,) + x.shape)
+
+    return jax.make_array_from_callback((n,) + x.shape, sharding, cb)
 
 
 def worker_values(fn, ps=None):
@@ -271,6 +305,23 @@ def mesh_key(ps) -> Tuple:
     return key
 
 
+def reset_kernel_caches():
+    """Drop every compiled-kernel cache.  Called by ``runtime.init`` on
+    re-initialization: after ``clear_backends`` the new incarnation's
+    device objects differ in identity while their ids collide with the
+    old mesh keys, so a cached jitted fn would be bound to dead devices.
+    """
+    _stacked_allreduce_fn.cache_clear()
+    _replicated_allreduce_fn.cache_clear()
+    _stacked_allgather_fn.cache_clear()
+    _broadcast_fn.cache_clear()
+    _alltoall_fn.cache_clear()
+    _stacked_reducescatter_fn.cache_clear()
+    _MESHES.clear()
+    from .adasum import reset_kernel_caches as _adasum_reset
+    _adasum_reset()
+
+
 # ---------------------------------------------------------------------------
 # public eager entry points (used by the engine; one-tensor fast paths)
 # ---------------------------------------------------------------------------
@@ -293,6 +344,11 @@ def allreduce_arrays(arrays: List, ps, op: str = ReduceOp.AVERAGE,
         stacked = is_stacked(arrays[0], ps)
     if stacked and any(is_stacked(a, ps) != stacked for a in arrays):
         raise ValueError("cannot fuse stacked and replicated tensors")
+    if not stacked and spans_processes(ps):
+        # eager multi-process: each process's local array is its
+        # contribution — lift onto the mesh for a real DCN/ICI reduction
+        arrays = [lift_to_workers(a, ps) for a in arrays]
+        stacked = True
     pre, has_pre = _scale_arg(prescale_factor)
     post, has_post = _scale_arg(postscale_factor)
     n = ps.size()
@@ -312,6 +368,9 @@ def allreduce_arrays(arrays: List, ps, op: str = ReduceOp.AVERAGE,
 def allgather_array(x, ps):
     if is_stacked(x, ps):
         return _stacked_allgather_fn(mesh_key(ps), ps.axis)(x)
+    if spans_processes(ps):
+        return _stacked_allgather_fn(mesh_key(ps), ps.axis)(
+            lift_to_workers(x, ps))
     # replicated: every worker contributes the same tensor → tile
     n = ps.size()
     return jnp.concatenate([x] * n, axis=0)
@@ -320,6 +379,9 @@ def allgather_array(x, ps):
 def broadcast_array(x, root_rank: int, ps):
     if is_stacked(x, ps):
         return _broadcast_fn(mesh_key(ps), ps.axis, int(root_rank))(x)
+    if spans_processes(ps):
+        return _broadcast_fn(mesh_key(ps), ps.axis, int(root_rank))(
+            lift_to_workers(x, ps))
     return x  # replicated: already everywhere
 
 
@@ -331,6 +393,8 @@ def alltoall_array(x, ps, splits=None):
             raise ValueError(f"splits must have length {n}")
         if not np.all(splits == splits[0]):
             return _alltoall_uneven(x, ps, splits)
+    if not is_stacked(x, ps) and spans_processes(ps):
+        x = lift_to_workers(x, ps)
     if is_stacked(x, ps):
         if x.shape[1] % n != 0:
             raise ValueError(
@@ -357,6 +421,8 @@ def _alltoall_uneven(x, ps, splits):
     """
     n = ps.size()
     offs = np.concatenate([[0], np.cumsum(splits)])
+    if not is_stacked(x, ps) and spans_processes(ps):
+        x = lift_to_workers(x, ps)
     if is_stacked(x, ps):
         full = _stacked_allgather_fn(mesh_key(ps), ps.axis)(x)
         per = x.shape[1]
@@ -372,6 +438,8 @@ def reducescatter_array(x, ps, op: str = ReduceOp.AVERAGE):
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         # matches the reference: reducescatter supports Sum/Average only
         raise ValueError(f"reducescatter unsupported op {op}")
+    if not is_stacked(x, ps) and spans_processes(ps):
+        x = lift_to_workers(x, ps)
     if is_stacked(x, ps):
         if x.shape[1] % n != 0:
             raise ValueError(

@@ -1,0 +1,410 @@
+"""Cross-process coordination controller: the negotiation protocol.
+
+Reference parity: ``horovod/common/controller.cc`` ``ComputeResponseList``
+(SURVEY.md §2.1, §3.2) — every rank announces the tensors it has ready;
+the set that is ready on *all* ranks is ordered deterministically and
+dispatched this cycle, stragglers stay queued, and divergence (a tensor
+some ranks never submit, or submit with a different shape) is *diagnosed*
+with tensor names and process ids instead of hanging the job.  The
+steady-state optimization — ``response_cache.cc``'s bit-vector exchange —
+appears here as a hash-only round: once a cycle signature has been fully
+negotiated, subsequent identical cycles exchange a 40-byte digest instead
+of the full request list.
+
+TPU-native redesign: the transport is the JAX coordination service's
+key-value store over DCN (``jax.distributed``), replacing
+``MPIController``'s Gatherv/Bcast and ``GlooController``'s HTTP store.
+The protocol is symmetric (no rank-0 coordinator): each process publishes
+its request list under a sequence-numbered key and reads every peer's; all
+processes evaluate the same deterministic decision function over the same
+data, so no response broadcast is needed.  Rounds are *lazy* — a process
+only negotiates when it has pending entries (or has joined), so an idle
+cluster costs zero control-plane traffic, unlike the reference's
+every-cycle bit-vector allreduce.
+
+Rounds are scoped per **member group** (the sorted processes owning the
+entry's process set), mirroring the reference's per-process-set
+controllers over sub-communicators: a collective on a subset process set
+never waits on non-member processes.  Keys are namespaced per runtime
+incarnation so an ``init → shutdown → init`` cycle against a persistent
+coordination service cannot read the previous incarnation's rounds.
+
+``join()`` semantics (reference: JoinOp, SURVEY §2.2): a joined process
+keeps answering global-group rounds with an empty request list and a
+joined flag; collectives that are ready on every *non-joined* process
+dispatch, with joined processes synthesizing zero contributions.  The
+round in which every process has joined resolves ``join()`` everywhere,
+returning the last joiner.  Join covers the global process set (as in
+the reference, where join has no process-set argument).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import threading
+import time
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
+
+import jax
+
+from ..exceptions import HorovodInternalError, StallError
+
+logger = logging.getLogger("horovod_tpu")
+
+_KEY_PREFIX = "hvdctl"
+
+
+def _client():
+    from jax._src import distributed
+    client = distributed.global_state.client
+    if client is None:
+        raise HorovodInternalError(
+            "JAX distributed runtime not initialized; cross-process "
+            "negotiation requires the coordination service")
+    return client
+
+
+def _kv_set(client, key: str, value: str):
+    try:
+        client.key_value_set(key, value, allow_overwrite=True)
+    except TypeError:  # older jax without allow_overwrite
+        client.key_value_set(key, value)
+
+
+@dataclasses.dataclass
+class NegotiationResult:
+    """Outcome of one negotiation round (the ResponseList analog)."""
+    # token -> number of instances every participant is ready to dispatch
+    counts: "Counter[str]" = dataclasses.field(default_factory=Counter)
+    # tensor name -> processes that have NOT submitted it (stall diagnosis)
+    missing: Dict[str, List[int]] = dataclasses.field(default_factory=dict)
+    all_joined: bool = False
+    last_joiner: int = -1       # process index of the last process to join
+    fast: bool = False          # hash-only round (response-cache steady state)
+
+
+def entry_token(entry) -> str:
+    """Canonical wire identity of a pending entry (the Request analog).
+
+    Covers everything two processes must agree on to co-execute the
+    collective: per-array signatures plus entry-level root/splits.
+    """
+    # group ids are per-process counters; only grouped-vs-not matters on
+    # the wire (group atomicity is entry-level: one entry holds the group)
+    sigs = [[s.name, s.op_type, s.reduce_op, s.dtype, list(s.shape),
+             s.process_set_id, bool(s.stacked),
+             -1 if s.group_id == -1 else 0,
+             s.prescale, s.postscale] for s in entry.sigs()]
+    splits = (None if entry.splits is None
+              else [int(x) for x in entry.splits])
+    return json.dumps({"s": sigs, "r": int(entry.root_rank), "sp": splits},
+                      separators=(",", ":"), sort_keys=True)
+
+
+def token_fields(token: str) -> dict:
+    return json.loads(token)
+
+
+def token_names(token: str) -> List[str]:
+    return [s[0] for s in json.loads(token)["s"]]
+
+
+class DivergenceError(HorovodInternalError):
+    """Raised on every process when ranks submit incompatible collectives."""
+
+
+class Controller:
+    """Per-process negotiation endpoint (reference: Controller subclass)."""
+
+    def __init__(self, cfg=None, stall=None, namespace: str = "0"):
+        self.stall = stall
+        self.namespace = str(namespace)
+        self._lock = threading.RLock()
+        # per member-group round counters and steady-state caches
+        self._seq: Dict[str, int] = {}
+        # (group, hash) -> sorted token list (reference: ResponseCache +
+        # CacheCoordinator bit vector)
+        self._hash_cache: Dict[Tuple[str, str], List[str]] = {}
+        self.joined = False
+        self._join_seq: Optional[int] = None
+        self._left = False
+        self._poll_s = 0.25
+        self._forced_off = False
+        if cfg is not None:
+            self._forced_off = not getattr(cfg, "controller_enabled", True)
+        self._peer_wait_warn_s = (
+            stall.check_time if stall is not None and not stall.disabled
+            else 60.0)
+        self._peer_wait_abort_s = (
+            stall.shutdown_time if stall is not None else 0.0)
+        # stats (reference: controller/response-cache counters)
+        self.rounds = 0
+        self.fast_rounds = 0
+        self.full_rounds = 0
+        self.tokens_deferred = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        if self._forced_off or self._left:
+            return False
+        try:
+            return jax.process_count() > 1
+        except Exception:  # noqa: BLE001 - backends torn down
+            return False
+
+    def _key(self, group: str, rest: str) -> str:
+        return f"{_KEY_PREFIX}/{self.namespace}/{group}/{rest}"
+
+    def leave(self):
+        """Announce departure so peers mid-negotiation fail fast instead of
+        waiting out the stall timeout (reference: shutdown sets a flag the
+        controller broadcasts in the next cycle)."""
+        if self._left:
+            return
+        self._left = True
+        try:
+            if jax.process_count() > 1:
+                _kv_set(_client(),
+                        f"{_KEY_PREFIX}/{self.namespace}/left/"
+                        f"{jax.process_index()}", "1")
+        except Exception:  # noqa: BLE001 - coordination service may be gone
+            logger.debug("could not publish leave marker", exc_info=True)
+
+    def set_joined(self, joined: bool):
+        with self._lock:
+            self.joined = joined
+            if not joined:
+                self._join_seq = None
+
+    def stats(self) -> dict:
+        return {
+            "rounds": self.rounds,
+            "fast_rounds": self.fast_rounds,
+            "full_rounds": self.full_rounds,
+            "tokens_deferred": self.tokens_deferred,
+            "cached_cycles": len(self._hash_cache),
+        }
+
+    # -- the round -----------------------------------------------------------
+    def negotiate(self, tokens: List[str],
+                  procs: Tuple[int, ...]) -> NegotiationResult:
+        """Run one negotiation round over ``tokens`` with the member
+        ``procs`` (sorted process indices of the collective's process set).
+
+        Blocking: waits (with stall-aware polling) until every member has
+        published the same round.  Returns the deterministic dispatch
+        decision — identical on every member by construction, which is
+        the property the reference's rank-0 ResponseList broadcast exists
+        to provide.
+        """
+        with self._lock:
+            me = jax.process_index()
+            if me not in procs:
+                raise HorovodInternalError(
+                    f"process {me} negotiating for a group it is not a "
+                    f"member of: {procs}")
+            gk = "g" + hashlib.sha1(
+                ",".join(map(str, procs)).encode()).hexdigest()[:12]
+            seq = self._seq.get(gk, 0)
+            self._seq[gk] = seq + 1
+            client = _client()
+            my_sorted = sorted(tokens)
+            h = hashlib.sha1("\n".join(my_sorted).encode()).hexdigest()
+
+            if self.joined and self._join_seq is None:
+                self._join_seq = seq
+
+            val: dict = {"h": h}
+            if self.joined:
+                val["j"] = True
+                val["js"] = self._join_seq
+            if (gk, h) not in self._hash_cache or self.joined:
+                val["e"] = my_sorted
+            _kv_set(client, self._key(gk, f"{seq}/a/{me}"),
+                    json.dumps(val, separators=(",", ":")))
+
+            vals: Dict[int, dict] = {me: val}
+            for q in procs:
+                if q != me:
+                    vals[q] = json.loads(
+                        self._peer_get(client, gk, seq, "a", q, procs,
+                                       tokens))
+
+            joined_ps = sorted(q for q in vals if vals[q].get("j"))
+            active = [q for q in procs if q not in joined_ps]
+            self.rounds += 1
+
+            if not active:
+                # every process has joined: resolve join() everywhere
+                last = max((vals[q].get("js", 0), q) for q in joined_ps)[1]
+                self._cleanup(client, gk, seq, me)
+                return NegotiationResult(all_joined=True, last_joiner=last)
+
+            hashes = {vals[q]["h"] for q in active}
+            if len(hashes) == 1 and not joined_ps:
+                # steady state: identical cycles on every member.  The
+                # hash was either cached (hash-only value — the bit-vector
+                # analog) or is cached now for the next occurrence.
+                self._hash_cache[(gk, h)] = my_sorted
+                fast = all("e" not in vals[q] for q in active)
+                if fast:
+                    self.fast_rounds += 1
+                else:
+                    self.full_rounds += 1
+                self._cleanup(client, gk, seq, me)
+                return NegotiationResult(counts=Counter(tokens), fast=fast)
+
+            # mismatch (or join in progress): full request lists needed.
+            self.full_rounds += 1
+            full: Dict[int, List[str]] = {}
+            if "e" not in val:
+                _kv_set(client, self._key(gk, f"{seq}/b/{me}"),
+                        json.dumps(my_sorted, separators=(",", ":")))
+            for q in procs:
+                if "e" in vals[q]:
+                    full[q] = vals[q]["e"]
+                elif q == me:
+                    full[q] = my_sorted
+                else:
+                    full[q] = json.loads(
+                        self._peer_get(client, gk, seq, "b", q, procs,
+                                       tokens))
+
+            result = self._decide(gk, full, active, joined_ps, vals, me)
+            self._cleanup(client, gk, seq, me)
+            return result
+
+    # -- decision function (identical on every member) -----------------------
+    def _decide(self, gk: str, full: Dict[int, List[str]],
+                active: List[int], joined_ps: List[int],
+                vals: Dict[int, dict], me: int) -> NegotiationResult:
+        counters = {q: Counter(full[q]) for q in full}
+        all_tokens = sorted(set().union(*[set(c) for c in counters.values()]))
+
+        # Divergence check: the same tensor name submitted with
+        # incompatible signatures *by disjoint sets of processes* is a hard
+        # error (reference: controller.cc mismatched-request status).  When
+        # some process holds several versions of a name itself (call-site
+        # auto names legitimately alias distinct tensors), it is timing
+        # skew, not divergence — the intersection/requeue path handles it.
+        by_name: Dict[Tuple[str, int], Dict[str, set]] = {}
+        for q in active:
+            for t in counters[q]:
+                fields = token_fields(t)
+                for s in fields["s"]:
+                    by_name.setdefault((s[0], s[5]), {}).setdefault(
+                        t, set()).add(q)
+        for (name, ps_id), versions in by_name.items():
+            if len(versions) < 2:
+                continue
+            holders = Counter()
+            for qs in versions.values():
+                holders.update(qs)
+            if any(c > 1 for c in holders.values()):
+                continue  # someone holds 2+ versions: aliasing, not a split
+            desc = "; ".join(
+                f"processes {sorted(qs)} submitted "
+                f"{json.dumps([s for s in token_fields(t)['s'] if s[0] == name])}"
+                for t, qs in sorted(versions.items()))
+            raise DivergenceError(
+                f"tensor '{name}' was submitted with mismatched "
+                f"signatures across processes: {desc}. All processes "
+                f"must request collectives with identical "
+                f"name/dtype/shape/op.")
+
+        counts: "Counter[str]" = Counter()
+        missing: Dict[str, List[int]] = {}
+        for t in all_tokens:
+            k = min(counters[q][t] for q in active)
+            if k > 0:
+                counts[t] = k
+            peak = max(counters[q][t] for q in active)
+            lagging = [q for q in active if counters[q][t] < peak]
+            if lagging:
+                for name in token_names(t):
+                    missing[name] = lagging
+        # deferred: instances someone submitted that did not dispatch
+        self.tokens_deferred += sum(
+            max(counters[q][t] for q in counters) - counts.get(t, 0)
+            for t in all_tokens)
+
+        if self.stall is not None:
+            for name, lagging in missing.items():
+                self.stall.record_missing(name, lagging)
+
+        # cache only fully-agreed cycles for the fast path
+        if not missing and not joined_ps:
+            my_sorted = sorted(full[me])
+            h = hashlib.sha1("\n".join(my_sorted).encode()).hexdigest()
+            self._hash_cache[(gk, h)] = my_sorted
+
+        last = -1
+        if joined_ps:
+            last = max((vals[q].get("js", 0), q) for q in joined_ps)[1]
+        return NegotiationResult(counts=counts, missing=missing,
+                                 last_joiner=last)
+
+    # -- transport -----------------------------------------------------------
+    def _peer_get(self, client, gk: str, seq: int, phase: str, q: int,
+                  procs: Tuple[int, ...], pending_tokens: List[str]) -> str:
+        """Poll for a peer's round key, surfacing diagnosis instead of a
+        silent hang (reference: stall_inspector names missing ranks)."""
+        key = self._key(gk, f"{seq}/{phase}/{q}")
+        t0 = time.monotonic()
+        warned = False
+        while True:
+            try:
+                return client.blocking_key_value_get(
+                    key, int(self._poll_s * 1000))
+            except Exception:  # noqa: BLE001 - DEADLINE_EXCEEDED poll tick
+                pass
+            # peer may have exited (crash or shutdown without join)
+            me = jax.process_index()
+            for p in procs:
+                if p == me:
+                    continue
+                try:
+                    client.blocking_key_value_get(
+                        f"{_KEY_PREFIX}/{self.namespace}/left/{p}", 1)
+                except Exception:  # noqa: BLE001 - not left
+                    continue
+                raise HorovodInternalError(
+                    f"process {p} left the job while negotiation round "
+                    f"{seq} was waiting for process {q} (peer shutdown or "
+                    f"failure)")
+            waited = time.monotonic() - t0
+            names = sorted({n for t in pending_tokens
+                            for n in token_names(t)})
+            if not warned and waited > self._peer_wait_warn_s:
+                warned = True
+                if self.stall is not None:
+                    for n in names:
+                        self.stall.record_missing(n, [q])
+                logger.warning(
+                    "Negotiation round %d has waited %.0fs for process %d "
+                    "to announce its ready tensors. Pending here: %s. One "
+                    "or more processes likely diverged (stopped submitting "
+                    "the same collectives).", seq, waited, q, names)
+            if (self._peer_wait_abort_s > 0
+                    and waited > self._peer_wait_abort_s):
+                raise StallError(
+                    f"negotiation round {seq} waited {waited:.0f}s for "
+                    f"process {q} (> HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
+                    f"{self._peer_wait_abort_s:.0f}); pending tensors here: "
+                    f"{names}; aborting")
+
+    def _cleanup(self, client, gk: str, seq: int, me: int):
+        """Best-effort deletion of this process's keys from an old round."""
+        old = seq - 4
+        if old < 0:
+            return
+        for phase in ("a", "b"):
+            try:
+                client.key_value_delete(self._key(gk, f"{old}/{phase}/{me}"))
+            except Exception:  # noqa: BLE001 - may not exist
+                pass

@@ -20,16 +20,23 @@ response-cache bit-vector optimization taken to its limit).
 from __future__ import annotations
 
 import logging
+import os
+import sys
 import threading
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
 
 from ..exceptions import HorovodInternalError
 from ..runtime import ReduceOp
 from . import collectives
+from .controller import (NegotiationResult, entry_token, token_fields)
 from .fusion import EntrySig, get_planner
 
 logger = logging.getLogger("horovod_tpu")
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 class TensorTableEntry:
@@ -130,12 +137,13 @@ class CollectiveEngine:
     """
 
     def __init__(self, cfg, mesh, timeline=None, stall_inspector=None,
-                 autotuner=None):
+                 autotuner=None, controller=None):
         self.cfg = cfg
         self.mesh = mesh
         self.timeline = timeline
         self.stall = stall_inspector
         self.autotuner = autotuner
+        self._controller = controller
         self._queue: List[TensorTableEntry] = []
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -146,6 +154,7 @@ class CollectiveEngine:
         self._group_counter = 0
         self._name_counter = 0
         self._bytes_reduced = 0
+        self._cycle_active = False
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
@@ -155,6 +164,10 @@ class CollectiveEngine:
         self._thread.start()
 
     def stop(self):
+        if self._controller is not None:
+            # tell peers mid-negotiation we are gone, so they diagnose
+            # instead of waiting out the stall timeout
+            self._controller.leave()
         with self._cv:
             self._stop = True
             self._cv.notify_all()
@@ -169,11 +182,24 @@ class CollectiveEngine:
 
     # -- submission ---------------------------------------------------------
     def auto_name(self, prefix: str) -> str:
-        """Reference: torch/mpi_ops.py auto-assigns names by submission order.
+        """Stable call-site-derived auto names (reference:
+        torch/mpi_ops.py name auto-assignment).
 
-        Submission order is assumed identical across processes (same SPMD
-        program), so the counter-derived name is globally consistent.
+        The name is derived from the first stack frame outside the package
+        (``file:line`` of the user's call), so the same call site produces
+        the same name every step — the response cache can hit in steady
+        state (reference: response_cache.cc keyed by tensor name), and the
+        name is identical on every process running the same script (the
+        property the cross-process controller negotiates on).  Distinct
+        tensors from one call site share a name; their dtype/shape still
+        distinguishes them in the cycle signature.
         """
+        f = sys._getframe(1)
+        while f is not None:
+            fn = f.f_code.co_filename
+            if not os.path.abspath(fn).startswith(_PKG_DIR):
+                return f"{prefix}.{os.path.basename(fn)}:{f.f_lineno}"
+            f = f.f_back
         with self._lock:
             self._name_counter += 1
             return f"{prefix}.noname.{self._name_counter}"
@@ -186,6 +212,11 @@ class CollectiveEngine:
     def submit(self, entry: TensorTableEntry) -> Handle:
         entry.handle = Handle(entry.name, single=len(entry.arrays) == 1)
         entry.enqueue_time = time.monotonic()
+        if self._controller is not None and self._controller.joined:
+            entry.handle._fail(HorovodInternalError(
+                "collective submitted after join(); join() must be the "
+                "last collective of the epoch"))
+            return entry.handle
         if self.timeline:
             self.timeline.negotiate_start(entry.name, entry.op_type)
         if self.stall:
@@ -231,6 +262,7 @@ class CollectiveEngine:
         """
         with self._lock:
             entries, self._queue = self._queue, []
+            self._cycle_active = bool(entries)
         if not entries:
             if self.stall:
                 self.stall.check()
@@ -240,17 +272,163 @@ class CollectiveEngine:
         except Exception as exc:  # noqa: BLE001
             # fail the drained entries' handles so synchronize() raises
             # instead of hanging (the dispatch path fails per-bucket; this
-            # guards the planning path)
+            # guards the planning/negotiation path).  Entries the
+            # negotiation requeued are back in the queue and stay live.
+            with self._lock:
+                queued = {id(q) for q in self._queue}
             for e in entries:
-                if e.handle is not None and not e.handle.poll():
+                if (id(e) not in queued and e.handle is not None
+                        and not e.handle.poll()):
                     e.handle._fail(exc)
             raise
+        finally:
+            with self._lock:
+                self._cycle_active = False
+
+    # -- cross-process negotiation (reference: ComputeResponseList) ---------
+    @staticmethod
+    def _member_procs(ps) -> Tuple[int, ...]:
+        """Processes owning the process set's devices (the round's member
+        group; reference: the set's sub-communicator)."""
+        return tuple(sorted({d.process_index
+                             for d in ps.mesh.devices.flat}))
+
+    def _negotiate(self, entries: List[TensorTableEntry]
+                   ) -> Tuple[List[TensorTableEntry], NegotiationResult]:
+        """Agree with peer processes on this cycle's dispatch set.
+
+        Entries are grouped by their process set's member processes and
+        negotiated per group (reference: per-process-set controllers), so
+        subset collectives never wait on non-members.  Returns the
+        locally-dispatchable entries (peers are ready for them too) plus
+        zero-contribution entries synthesized on joined processes; entries
+        peers are not yet ready for are requeued.
+        """
+        ctl = self._controller
+        me = jax.process_index()
+        dispatch: List[TensorTableEntry] = []
+        requeued: List[TensorTableEntry] = []
+        groups: dict = {}
+        for e in entries:
+            procs = self._member_procs(e.process_set)
+            if len(procs) <= 1:
+                dispatch.append(e)  # local-only set: nothing to negotiate
+            else:
+                groups.setdefault(procs, []).append(e)
+        last_res = NegotiationResult()
+        for procs in sorted(groups):
+            grp = groups[procs]
+            tokens = [entry_token(e) for e in grp]
+            res = ctl.negotiate(tokens, procs)
+            last_res = res
+            counts = dict(res.counts)
+            for e, t in zip(grp, tokens):
+                if counts.get(t, 0) > 0:
+                    counts[t] -= 1
+                    dispatch.append(e)
+                else:
+                    requeued.append(e)
+            if ctl.joined:
+                for t, k in counts.items():
+                    for _ in range(k):
+                        dispatch.append(self._synthesize(t))
+        if requeued:
+            with self._cv:
+                self._queue[:0] = requeued
+                if not self._stop:
+                    self._cv.notify_all()
+        return dispatch, last_res
+
+    def _synthesize(self, token: str) -> TensorTableEntry:
+        """Build a zero-contribution entry for a peer collective this joined
+        process did not submit (reference: JoinOp zero tensors)."""
+        import jax.numpy as jnp
+        from .. import runtime
+        fields = token_fields(token)
+        sigs = fields["s"]
+        op_type = sigs[0][1]
+        if any(s[6] for s in sigs):
+            raise HorovodInternalError(
+                "join(): cannot synthesize a zero contribution for a "
+                "stacked (globally-constructed) tensor; stacked arrays "
+                "require every process")
+        if op_type == "broadcast":
+            nloc = max(jax.local_device_count(), 1)
+            if fields["r"] // nloc == jax.process_index():
+                raise HorovodInternalError(
+                    "join(): this process is the broadcast root for "
+                    f"'{sigs[0][0]}' but has joined")
+        elif op_type not in ("allreduce", "barrier"):
+            raise HorovodInternalError(
+                f"join(): cannot zero-fill op '{op_type}' for tensor "
+                f"'{sigs[0][0]}' (supported with uneven inputs: allreduce, "
+                f"broadcast, barrier)")
+        table = runtime._state().process_set_table
+        ps = table.get(sigs[0][5])
+        arrays = [jnp.zeros(tuple(s[4]), dtype=s[3]) for s in sigs]
+        entry = TensorTableEntry(
+            name=sigs[0][0].rsplit(".", 1)[0] if len(sigs) > 1
+            else sigs[0][0],
+            op_type=op_type, arrays=arrays, process_set=ps,
+            reduce_op=sigs[0][2],
+            prescale=sigs[0][8], postscale=sigs[0][9],
+            root_rank=fields["r"], splits=fields["sp"], stacked=False,
+            group_id=self.next_group_id() if len(sigs) > 1 else -1)
+        entry.handle = Handle(entry.name, single=len(arrays) == 1)
+        entry.enqueue_time = time.monotonic()
+        if self.timeline:
+            self.timeline.negotiate_start(entry.name, op_type)
+        return entry
+
+    def join(self) -> int:
+        """Drive joined negotiation rounds until every process has joined
+        (reference: JoinOp loop).  Returns the last joiner's process index.
+        """
+        ctl = self._controller
+        # drain our own pending collectives first: join is ordered after
+        # every prior submission on this process
+        while True:
+            with self._lock:
+                if not self._queue and not self._cycle_active:
+                    break
+            time.sleep(0.005)
+        ctl.set_joined(True)
+        all_procs = tuple(range(jax.process_count()))
+        try:
+            while True:
+                with self._lock:
+                    if self._queue:
+                        raise HorovodInternalError(
+                            "collective submitted after join()")
+                res = ctl.negotiate([], all_procs)
+                if res.all_joined:
+                    return res.last_joiner
+                dispatch = [self._synthesize(t)
+                            for t, k in res.counts.items()
+                            for _ in range(k)]
+                if dispatch:
+                    self._execute(dispatch)
+                else:
+                    time.sleep(max(self.cfg.cycle_time_ms, 1.0) / 1000.0)
+        finally:
+            ctl.set_joined(False)
 
     def _run_cycle(self, entries: List[TensorTableEntry]):
         self._cycle_count += 1
         if self.timeline:
             self.timeline.cycle_mark(self._cycle_count)
+        if self._controller is not None and self._controller.enabled:
+            entries, _res = self._negotiate(entries)
+            if not entries:
+                if self.stall:
+                    self.stall.check()
+                # nothing common this round: pace the retry so mismatched
+                # leftovers don't spin the control plane
+                time.sleep(0.02)
+                return
+        self._execute(entries)
 
+    def _execute(self, entries: List[TensorTableEntry]):
         sigs: List[EntrySig] = []
         owner: List[int] = []   # sig index -> entry index
         base: List[int] = []    # entry index -> first sig index
@@ -259,6 +437,10 @@ class CollectiveEngine:
             for s in e.sigs():
                 sigs.append(s)
                 owner.append(idx)
+            if self.timeline:
+                # the negotiation span closes when the entry makes the
+                # cycle's agreed dispatch set (requeued entries stay open)
+                self.timeline.negotiate_end(e.name)
 
         plan = self._cache.get(sigs)
         if plan is None:
@@ -312,6 +494,13 @@ class CollectiveEngine:
 
     def _fusion_threshold(self) -> int:
         if self.autotuner is not None:
+            if self._controller is not None and self._controller.enabled:
+                # multi-process: the plan must be identical on every
+                # process, and per-process autotuners evolve different
+                # thresholds from local timings — pin to the configured
+                # value (the reference syncs tuned params from rank 0;
+                # a negotiated-parameter round is future work)
+                return self.cfg.fusion_threshold_bytes
             return self.autotuner.current_fusion_threshold()
         return self.cfg.fusion_threshold_bytes
 
@@ -363,8 +552,11 @@ class CollectiveEngine:
 
     # -- introspection ------------------------------------------------------
     def stats(self) -> dict:
-        return {
+        out = {
             "cycles": self._cycle_count,
             "bytes_reduced": self._bytes_reduced,
             "cache": self._cache.stats(),
         }
+        if self._controller is not None:
+            out["negotiation"] = self._controller.stats()
+        return out

@@ -80,6 +80,7 @@ class ProcessSet:
         self.process_set_id: Optional[int] = None
         self._mesh: Optional[jax.sharding.Mesh] = None
         self._axis: str = "workers"
+        self._spans: Optional[bool] = None
 
     # -- queries -------------------------------------------------------------
     def initialized(self) -> bool:
@@ -107,6 +108,17 @@ class ProcessSet:
     @property
     def axis(self) -> str:
         return self._axis
+
+    @property
+    def spans_processes(self) -> bool:
+        """True when the set's mesh includes other processes' devices
+        (constant per set; computed once — hot-path queried)."""
+        if self._spans is None:
+            self._check()
+            me = jax.process_index()
+            self._spans = any(d.process_index != me
+                              for d in self._mesh.devices.flat)
+        return self._spans
 
     def _check(self):
         if not self.initialized():
@@ -198,6 +210,7 @@ class _RuntimeState:
 
 
 _STATE = _RuntimeState()
+_INIT_GENERATION = 0  # survives shutdown(); processes re-init in lockstep
 
 
 def _state() -> _RuntimeState:
@@ -335,6 +348,13 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
                 except Exception:  # noqa: BLE001 - partial init
                     pass
 
+        # Invalidate compiled-kernel caches from a previous incarnation:
+        # device ids collide across re-inits but the device objects (and
+        # their runtime clients) are new, so stale jitted fns would fail
+        # with "incompatible devices".
+        from .ops.collectives import reset_kernel_caches
+        reset_kernel_caches()
+
         _STATE.devices = list(jax.devices())
         n = len(_STATE.devices)
         _STATE.global_mesh = jax.sharding.Mesh(
@@ -368,11 +388,26 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
             from .autotune import ParameterManager
             _STATE.autotuner = ParameterManager(cfg)
 
-        # The background collective engine (reference: BackgroundThreadLoop).
+        # The background collective engine (reference: BackgroundThreadLoop)
+        # with its cross-process negotiation controller (controller.cc).
+        # Controller keys are namespaced per incarnation so init→shutdown→
+        # init against a persistent coordination service never reads the
+        # previous incarnation's rounds: elastic re-forms share the
+        # driver's epoch; plain re-inits count generations in lockstep.
+        global _INIT_GENERATION
+        _INIT_GENERATION += 1
+        if cfg.elastic:
+            from .elastic import worker as elastic_worker
+            ns = f"e{max(elastic_worker._last_epoch, 0)}"
+        else:
+            ns = f"g{_INIT_GENERATION}"
+        from .ops.controller import Controller
         from .ops.engine import CollectiveEngine
         _STATE.engine = CollectiveEngine(
             cfg, _STATE.global_mesh, _STATE.timeline,
-            _STATE.stall_inspector, _STATE.autotuner)
+            _STATE.stall_inspector, _STATE.autotuner,
+            controller=Controller(cfg, _STATE.stall_inspector,
+                                  namespace=ns))
         _STATE.engine.start()
 
         _STATE.initialized = True

@@ -31,6 +31,10 @@ class StallInspector:
         self.disabled = disabled or check_time <= 0
         self._pending: Dict[str, float] = {}
         self._warned: Dict[str, float] = {}
+        # tensor name -> processes that have not submitted it, reported by
+        # the negotiation controller (reference: stall_inspector.cc's
+        # missing-rank list from ComputeResponseList)
+        self._missing: Dict[str, list] = {}
         self.warnings_issued = 0
         # Native bookkeeping (reference: stall_inspector.cc) when built.
         self._native = None
@@ -52,9 +56,20 @@ class StallInspector:
         else:
             self._pending.setdefault(name, t)
 
+    def record_missing(self, name: str, processes):
+        """Record which processes have not announced ``name`` (from the
+        cross-process controller's negotiation round)."""
+        if self.disabled:
+            return
+        self._missing[name] = sorted(set(int(p) for p in processes))
+
+    def missing_processes(self, name: str):
+        return list(self._missing.get(name, []))
+
     def record_complete(self, name: str):
         if self.disabled:
             return
+        self._missing.pop(name, None)
         if self._native is not None:
             self._native.record_complete(name)
         else:
@@ -75,7 +90,7 @@ class StallInspector:
             if shutdown is not None:
                 name, age = shutdown
                 raise StallError(
-                    f"tensor {name} stalled for {age:.0f}s, past "
+                    f"tensor {self._describe(name, age)} stalled past "
                     f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
                     f"{self.shutdown_time:.0f}; aborting")
             self._warn(stalled)
@@ -88,19 +103,25 @@ class StallInspector:
                 self._warned[name] = now
             if self.shutdown_time > 0 and age > self.shutdown_time:
                 raise StallError(
-                    f"tensor {name} stalled for {age:.0f}s, past "
+                    f"tensor {self._describe(name, age)} stalled past "
                     f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
                     f"{self.shutdown_time:.0f}; aborting")
         self._warn(stalled)
+
+    def _describe(self, name: str, age: float) -> str:
+        missing = self._missing.get(name)
+        if missing:
+            return f"{name} ({age:.0f}s; missing on processes {missing})"
+        return f"{name} ({age:.0f}s)"
 
     def _warn(self, stalled):
         if not stalled:
             return
         self.warnings_issued += 1
-        names = ", ".join(f"{n} ({a:.0f}s)" for n, a in stalled)
+        names = ", ".join(self._describe(n, a) for n, a in stalled)
         logger.warning(
             "One or more tensors were submitted to be reduced/gathered "
-            "but were not dispatched for over %.0f seconds: [%s]. This "
-            "usually means a participating process has stopped feeding "
-            "the same program (the SPMD analog of missing ranks).",
+            "but were not dispatched for over %.0f seconds: [%s]. "
+            "Processes listed as missing have not announced the tensor in "
+            "negotiation (reference: stall_inspector missing ranks).",
             self.check_time, names)

@@ -109,15 +109,26 @@ class Timeline:
             return tid
 
     def negotiate_start(self, name: str, op_type: str):
+        """Open the NEGOTIATE span at submission; it stays open until the
+        entry makes a cycle's agreed dispatch set (``negotiate_end``) —
+        real spans, covering queue wait plus any cross-process negotiation
+        rounds the entry had to sit through (reference: NEGOTIATE_* phase
+        between EnqueueTensorAllreduce and the ResponseList)."""
         if not self.enabled:
             return
         tid = self._tid(name)
         self._emit({"name": f"NEGOTIATE_{op_type.upper()}", "ph": "B",
                     "pid": 0, "tid": tid, "ts": self._ts_us()})
-        self._emit({"name": f"NEGOTIATE_{op_type.upper()}", "ph": "E",
-                    "pid": 0, "tid": tid, "ts": self._ts_us()})
+
+    def negotiate_end(self, name: str):
+        """Close the NEGOTIATE span and open QUEUED (dispatch imminent)."""
+        if not self.enabled:
+            return
+        tid = self._tid(name)
+        ts = self._ts_us()
+        self._emit({"name": "", "ph": "E", "pid": 0, "tid": tid, "ts": ts})
         self._emit({"name": "QUEUED", "ph": "B", "pid": 0, "tid": tid,
-                    "ts": self._ts_us()})
+                    "ts": ts})
 
     def activity_start(self, names: List[str], activity: str):
         if not self.enabled:
@@ -148,7 +159,11 @@ class Timeline:
                         "tid": self._tid(name), "ts": self._ts_us()})
 
     def end(self, name: str):
-        pass  # lifecycle closed by activity_end; kept for API parity
+        """Mark the tensor's lifecycle complete (reference: DONE state)."""
+        if not self.enabled:
+            return
+        self._emit({"name": "DONE", "ph": "i", "pid": 0,
+                    "tid": self._tid(name), "ts": self._ts_us(), "s": "t"})
 
     def cycle_mark(self, cycle: int):
         if not self.enabled or not self._mark_cycles:

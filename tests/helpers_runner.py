@@ -34,3 +34,207 @@ def cross_process_sum_fn():
 
 def failing_fn():
     raise RuntimeError("worker deliberately fails")
+
+
+# --- cross-process controller / negotiation (engine eager path) -------------
+
+
+def eager_allreduce_fn():
+    """Each process contributes rank-dependent values through the EAGER
+    hvd.allreduce API; the controller negotiates and the engine lifts the
+    local arrays onto the global mesh for a real cross-process reduction."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    out1 = hvd.allreduce(np.full((4,), float(r + 1), np.float32),
+                         name="grad_a", op=hvd.Sum)
+    out2 = hvd.allreduce(np.full((2,), float(10 * (r + 1)), np.float32),
+                         name="grad_b")  # average
+    stats = hvd.runtime._state().engine.stats()
+    return {"rank": r, "sum": np.asarray(out1).tolist(),
+            "avg": np.asarray(out2).tolist(),
+            "rounds": stats["negotiation"]["rounds"]}
+
+
+def steady_state_fast_path_fn():
+    """Same allreduce every step: after the first full round the controller
+    should take the hash-only fast path (response-cache bit-vector analog)."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    for i in range(6):
+        hvd.allreduce(np.ones((8,), np.float32) * i, name="grad")
+    stats = hvd.runtime._state().engine.stats()
+    return {"rank": hvd.cross_rank(),
+            "fast": stats["negotiation"]["fast_rounds"],
+            "full": stats["negotiation"]["full_rounds"]}
+
+
+def late_tensor_fn():
+    """One process submits 1.5s late: the peer's entry must wait in the
+    queue (requeued by negotiation) and then dispatch — no hang, no error."""
+    import time
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    if r == 1:
+        time.sleep(1.5)
+    out = hvd.allreduce(np.full((3,), float(r), np.float32), name="late",
+                        op=hvd.Sum)
+    return {"rank": r, "sum": np.asarray(out).tolist()}
+
+
+def divergent_tensor_fn():
+    """Each process submits one SHARED tensor and one tensor the peer never
+    submits.  The shared tensor must dispatch; the divergent ones must be
+    DIAGNOSED (StallError naming tensor + missing process), not hang."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    common = hvd.allreduce_async(np.ones((2,), np.float32), name="common",
+                                 op=hvd.Sum)
+    only_mine = hvd.allreduce_async(np.ones((2,), np.float32),
+                                    name=f"only{r}", op=hvd.Sum)
+    common_val = np.asarray(common.synchronize()).tolist()
+    try:
+        only_mine.synchronize()
+        error = None
+    except Exception as e:  # noqa: BLE001
+        error = str(e)
+    return {"rank": r, "common": common_val, "error": error}
+
+
+def shape_mismatch_fn():
+    """Same tensor name, different shapes across processes → immediate
+    divergence error naming the tensor (reference: controller.cc status)."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    shape = (2,) if r == 0 else (3,)
+    try:
+        hvd.allreduce(np.ones(shape, np.float32), name="bad_tensor")
+        return {"rank": r, "error": None}
+    except Exception as e:  # noqa: BLE001
+        return {"rank": r, "error": str(e)}
+
+
+def torch_training_fn():
+    """2-process torch DP training (reference: test_torch.py optimizer
+    tests): same model on both, per-rank data shards, DistributedOptimizer
+    averaging gradients across processes.  Returns the loss trajectory and
+    final params; the test compares them to a single-process full-batch
+    run (data-parallel SGD on equal shards == full-batch SGD)."""
+    import numpy as np
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    r = hvd.cross_rank()
+    torch.manual_seed(42)
+    model = torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.Tanh(), torch.nn.Linear(8, 1))
+    # fixed synthetic regression data, sharded by process
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 4).astype(np.float32)
+    y = (X @ rng.randn(4, 1)).astype(np.float32)
+    Xs = torch.from_numpy(X[r * 4:(r + 1) * 4])
+    ys = torch.from_numpy(y[r * 4:(r + 1) * 4])
+
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    losses = []
+    for _ in range(3):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(Xs), ys)
+        loss.backward()
+        opt.step()
+        # loss averaged across processes for the trajectory
+        losses.append(float(hvd.allreduce(loss.detach(), name="loss")))
+    params = [p.detach().numpy().tolist() for p in model.parameters()]
+    return {"rank": r, "losses": losses, "params": params}
+
+
+def subset_process_set_fn():
+    """A collective on a single-process subset set must not wait on idle
+    non-member processes (review regression: per-group negotiation)."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    ps0 = hvd.add_process_set([0])  # both processes register it
+    if r == 0:
+        out = hvd.allreduce(np.ones((2,), np.float32), name="sub",
+                            op=hvd.Sum, process_set=ps0)
+        val = np.asarray(out).tolist()
+    else:
+        val = None  # process 1 never participates and never blocks
+    done = hvd.allreduce(np.float32(1.0), name="done", op=hvd.Sum)
+    return {"rank": r, "sub": val, "done": float(np.asarray(done))}
+
+
+def reinit_cycle_fn():
+    """shutdown() + init() in one process pair: the second incarnation's
+    negotiation must not read the first's keys or leave markers."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    vals = []
+    for _ in range(2):
+        hvd.init()
+        r = hvd.cross_rank()
+        out = hvd.allreduce(np.full((2,), float(r + 1), np.float32),
+                            name="t", op=hvd.Sum)
+        vals.append(np.asarray(out).tolist())
+        hvd.shutdown()
+    return {"vals": vals}
+
+
+def tf_training_fn():
+    """2-process TF DP training via DistributedGradientTape (reference:
+    test_tensorflow.py): per-rank shards, averaged gradients; the test
+    compares the final weights to a single-process full-batch run."""
+    import numpy as np
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+    r = hvd.cross_rank()
+    X = np.random.RandomState(3).randn(8, 2).astype("f4")
+    y = (X @ np.array([[1.0], [-0.5]], dtype="f4")).astype("f4")
+    Xs = tf.constant(X[r * 4:(r + 1) * 4])
+    ys = tf.constant(y[r * 4:(r + 1) * 4])
+    w = tf.Variable([[0.2], [0.1]])
+    hvd.broadcast_variables([w], root_rank=0)
+    for _ in range(3):
+        tape = hvd.DistributedGradientTape(tf.GradientTape())
+        with tape:
+            loss = tf.reduce_mean((tf.matmul(Xs, w) - ys) ** 2)
+        g = tape.gradient(loss, [w])
+        w.assign_sub(0.5 * g[0])
+    return {"rank": r, "w": w.numpy().tolist()}
+
+
+def join_uneven_fn():
+    """Uneven batch counts (reference: hvd.join / JoinOp).  Process 0 runs
+    3 batches, process 1 runs 2; joined processes co-execute the peer's
+    extra allreduce with zero contributions."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    n_batches = 3 if r == 0 else 2
+    sums = []
+    for i in range(n_batches):
+        out = hvd.allreduce(
+            np.full((4,), float((r + 1) * (i + 1)), np.float32),
+            name="grad", op=hvd.Sum)
+        sums.append(float(np.asarray(out)[0]))
+    last = hvd.join()
+    return {"rank": r, "sums": sums, "last_joiner": last}
