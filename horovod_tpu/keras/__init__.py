@@ -1,0 +1,25 @@
+"""Keras adapter: optimizer wrapper + training callbacks.
+
+Reference parity: ``horovod/keras/`` + ``horovod/_keras/callbacks.py``
+(SURVEY.md §2.2) — ``DistributedOptimizer`` plus the three canonical
+callbacks (``BroadcastGlobalVariablesCallback``, ``MetricAverageCallback``,
+``LearningRateWarmupCallback``), built on the TF adapter's collectives.
+"""
+
+from __future__ import annotations
+
+from ..tensorflow import (DistributedOptimizer, allreduce, broadcast,  # noqa: F401,E501
+                          broadcast_variables, init, is_initialized, join,
+                          rank, size, local_rank, local_size, cross_rank,
+                          cross_size, shutdown, Average, Sum, Adasum,
+                          Compression)
+from .callbacks import (BroadcastGlobalVariablesCallback,  # noqa: F401
+                        LearningRateWarmupCallback, MetricAverageCallback)
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "Average", "Sum", "Adasum",
+    "DistributedOptimizer", "allreduce", "broadcast", "broadcast_variables",
+    "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+    "LearningRateWarmupCallback", "Compression",
+]

@@ -1,0 +1,114 @@
+"""Keras training callbacks.
+
+Reference parity: ``horovod/_keras/callbacks.py`` (SURVEY.md §2.2) —
+``BroadcastGlobalVariablesCallback`` (weight sync at train start),
+``MetricAverageCallback`` (allreduce-averaged epoch metrics) and
+``LearningRateWarmupCallback`` (linear LR ramp over the first epochs,
+scaling to ``size()`` workers, per the large-batch training recipe the
+reference ships).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import tensorflow as tf
+
+keras = tf.keras
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast initial model + optimizer state from ``root_rank`` so all
+    workers start identical (reference: BroadcastGlobalVariablesCallbackImpl).
+    """
+
+    def __init__(self, root_rank: int = 0, process_set=None):
+        super().__init__()
+        self.root_rank = root_rank
+        self.process_set = process_set
+        self.broadcast_done = False
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        from ..tensorflow import broadcast_variables
+        broadcast_variables(self.model.weights, self.root_rank,
+                            process_set=self.process_set)
+        opt = getattr(self.model, "optimizer", None)
+        if opt is not None and getattr(opt, "variables", None):
+            vars_ = opt.variables if not callable(opt.variables) \
+                else opt.variables()
+            broadcast_variables([v for v in vars_], self.root_rank,
+                                process_set=self.process_set)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch metrics over workers before other callbacks see them
+    (reference: MetricAverageCallbackImpl, used so checkpoint/early-stop
+    decisions agree across workers)."""
+
+    def __init__(self, process_set=None):
+        super().__init__()
+        self.process_set = process_set
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs:
+            return
+        from .. import api
+        for k in sorted(logs):
+            v = logs[k]
+            if isinstance(v, (int, float, np.floating, np.integer)):
+                logs[k] = float(np.asarray(api.allreduce(
+                    np.float32(v), name=f"metric.{k}",
+                    process_set=self.process_set)))
+
+
+class LearningRateWarmupCallback(keras.callbacks.Callback):
+    """Linearly ramp LR from the single-worker rate to ``initial_lr`` over
+    ``warmup_epochs`` (reference: LearningRateWarmupCallbackImpl;
+    Goyal et al.'s gradual warmup for large-batch DP training)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 momentum_correction: bool = True, steps_per_epoch=None,
+                 verbose: int = 0):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        self._steps = 0
+
+    def _set_lr(self, lr: float):
+        opt = self.model.optimizer
+        lr_attr = getattr(opt, "learning_rate", None)
+        if lr_attr is None:
+            return
+        if hasattr(lr_attr, "assign"):
+            lr_attr.assign(lr)
+        else:
+            opt.learning_rate = lr
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if self.current_epoch >= self.warmup_epochs:
+            return
+        self._steps += 1
+        if self.steps_per_epoch:
+            progress = self._steps / (self.steps_per_epoch
+                                      * self.warmup_epochs)
+        else:
+            progress = (self.current_epoch + 1) / self.warmup_epochs
+        progress = min(progress, 1.0)
+        from ..runtime import size
+        base = self.initial_lr / size()
+        self._set_lr(base + (self.initial_lr - base) * progress)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch == self.warmup_epochs - 1:
+            self._set_lr(self.initial_lr)
+            if self.verbose:
+                print(f"Epoch {epoch + 1}: finished gradual learning rate "
+                      f"warmup to {self.initial_lr}.")

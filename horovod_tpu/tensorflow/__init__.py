@@ -1,0 +1,264 @@
+"""TensorFlow framework adapter (L2/L3 binding).
+
+Reference parity: ``horovod/tensorflow/__init__.py`` (SURVEY.md §2.2,
+§3.3 TF analog) — ``DistributedGradientTape``, ``DistributedOptimizer``
+(legacy-style wrapper), ``broadcast_variables``, tensor collectives, and
+the aggregation knobs (``backward_passes_per_step`` via local
+accumulation).
+
+TPU-native redesign: TF tensors are converted at the binding boundary
+and fed to the same eager engine as every other frontend; collectives
+execute as XLA programs over the TPU mesh.  Inside ``tf.function`` the
+collective is reached through ``tf.py_function`` — the graph-compatible
+escape hatch to the engine (the reference reached its C++ core through
+registered custom ops; SURVEY §2.1 ``HorovodAllreduceOp``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import tensorflow as tf
+
+from .. import api as _api
+from ..compression import Compression
+from ..exceptions import HorovodInternalError  # noqa: F401
+from ..runtime import (Adasum, Average, ReduceOp, Sum,  # noqa: F401
+                       init, is_initialized, shutdown, rank, size,
+                       local_rank, local_size, cross_rank, cross_size,
+                       mpi_threads_supported, mpi_built, mpi_enabled,
+                       gloo_built, gloo_enabled, nccl_built, cuda_built,
+                       rocm_built, xla_built, tpu_built,
+                       ProcessSet, add_process_set, remove_process_set)
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "Average", "Sum", "Adasum",
+    "allreduce", "grouped_allreduce", "allgather", "broadcast",
+    "broadcast_variables", "broadcast_object", "alltoall", "join",
+    "barrier", "DistributedGradientTape", "DistributedOptimizer",
+    "Compression", "ProcessSet", "add_process_set", "remove_process_set",
+]
+
+
+def _eager_allreduce_np(x: np.ndarray, name: str, op: str,
+                        prescale: float, postscale: float,
+                        process_set=None) -> np.ndarray:
+    out = _api.allreduce(x, name=name or None, op=op,
+                         prescale_factor=prescale,
+                         postscale_factor=postscale,
+                         process_set=process_set)
+    return np.asarray(out)
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              compression=Compression.none, prescale_factor=1.0,
+              postscale_factor=1.0, process_set=None):
+    """Allreduce a tf.Tensor (works eagerly and inside ``tf.function``)."""
+    if average is not None and op is not None:
+        raise ValueError("The average and op arguments cannot both be set")
+    rop = op if op is not None else (
+        Average if (average is None or average) else Sum)
+    nm = name or f"tfallreduce.{tensor.shape.rank}d"
+    wire_dtype = tensor.dtype
+    if compression is not Compression.none and tensor.dtype in (
+            tf.float32, tf.float64):
+        wire = tf.cast(tensor, tf.bfloat16
+                       if compression is Compression.bf16 else tf.float16)
+        reduced = allreduce(wire, op=rop, name=nm,
+                            prescale_factor=prescale_factor,
+                            postscale_factor=postscale_factor,
+                            process_set=process_set)
+        return tf.cast(reduced, wire_dtype)
+
+    def _np_op(x):
+        return _eager_allreduce_np(x.numpy(), nm, rop, prescale_factor,
+                                   postscale_factor, process_set)
+
+    out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype)
+    out.set_shape(tensor.shape)
+    return out
+
+
+def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
+                      process_set=None) -> List:
+    if average is not None and op is not None:
+        raise ValueError("The average and op arguments cannot both be set")
+    rop = op if op is not None else (
+        Average if (average is None or average) else Sum)
+    nm = name or "tfgrouped"
+
+    def _np_op(*xs):
+        outs = _api.grouped_allreduce([x.numpy() for x in xs],
+                                      name=nm, op=rop,
+                                      process_set=process_set)
+        return [np.asarray(o) for o in outs]
+
+    outs = tf.py_function(_np_op, list(tensors),
+                          Tout=[t.dtype for t in tensors])
+    for o, t in zip(outs, tensors):
+        o.set_shape(t.shape)
+    return list(outs)
+
+
+def allgather(tensor, name=None, process_set=None):
+    nm = name or "tfallgather"
+
+    def _np_op(x):
+        return np.asarray(_api.allgather(x.numpy(), name=nm,
+                                         process_set=process_set))
+
+    out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype)
+    shape = tensor.shape.as_list()
+    if shape:
+        shape[0] = None
+    out.set_shape(shape)
+    return out
+
+
+def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):
+    nm = name or "tfbroadcast"
+
+    def _np_op(x):
+        return np.asarray(_api.broadcast(x.numpy(), root_rank, name=nm,
+                                         process_set=process_set))
+
+    out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype)
+    out.set_shape(tensor.shape)
+    return out
+
+
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    nm = name or "tfalltoall"
+
+    def _np_op(x):
+        res = _api.alltoall(x.numpy(), splits=splits, name=nm,
+                            process_set=process_set)
+        if isinstance(res, list):
+            from .. import runtime
+            res = res[runtime.rank()]
+        return np.asarray(res)
+
+    out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype)
+    return out
+
+
+def join(device: int = -1) -> int:
+    return _api.join(device)
+
+
+def barrier(process_set=None):
+    return _api.barrier(process_set)
+
+
+def broadcast_object(obj, root_rank: int = 0, name=None, process_set=None):
+    return _api.broadcast_object(obj, root_rank, name, process_set)
+
+
+def broadcast_variables(variables, root_rank: int = 0, process_set=None):
+    """Assign every variable its value on ``root_rank`` (reference:
+    hvd.broadcast_variables — used at train start so all workers agree)."""
+    for i, v in enumerate(variables):
+        name = f"bv.{getattr(v, 'name', i)}"
+        v.assign(broadcast(tf.convert_to_tensor(v), root_rank, name=name,
+                           process_set=process_set))
+
+
+class DistributedGradientTape:
+    """Gradient tape wrapper whose ``gradient()`` allreduces each gradient.
+
+    Reference: ``hvd.DistributedGradientTape(tape)`` (SURVEY §3.3 TF
+    analog) — wraps an existing ``tf.GradientTape``; every other method
+    delegates to it.  ``backward_passes_per_step > 1`` accumulates
+    locally and reduces every N-th call (gradients summed over passes,
+    averaged over workers).
+    """
+
+    def __init__(self, tape: Optional[tf.GradientTape] = None,
+                 compression=Compression.none, op=Average,
+                 gradient_predivide_factor: float = 1.0,
+                 backward_passes_per_step: int = 1,
+                 persistent: bool = False, process_set=None):
+        self._wrapped = tape if tape is not None else tf.GradientTape(
+            persistent=persistent)
+        self._compression = compression
+        self._op = op
+        if gradient_predivide_factor != 1.0 and op != Average:
+            raise ValueError(
+                "gradient_predivide_factor requires op == Average")
+        self._prescale = (1.0 / gradient_predivide_factor
+                          if gradient_predivide_factor != 1.0 else 1.0)
+        self._postscale = gradient_predivide_factor
+        self._bpps = int(backward_passes_per_step)
+        self._pass = 0
+        self._acc: Optional[List] = None
+        self._process_set = process_set
+
+    def __getattr__(self, name):
+        return getattr(self._wrapped, name)
+
+    def __enter__(self):
+        self._wrapped.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._wrapped.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._wrapped.gradient(target, sources, output_gradients)
+        self._pass += 1
+        if self._bpps > 1:
+            if self._acc is None:
+                self._acc = [tf.zeros_like(g) if g is not None else None
+                             for g in grads]
+            self._acc = [a + g if g is not None else a
+                         for a, g in zip(self._acc, grads)]
+            if self._pass % self._bpps != 0:
+                return [None if g is None else tf.zeros_like(g)
+                        for g in grads]
+            grads, self._acc = self._acc, None
+        out = []
+        for i, g in enumerate(grads):
+            if g is None:
+                out.append(None)
+                continue
+            if isinstance(g, tf.IndexedSlices):
+                g = tf.convert_to_tensor(g)  # sparse-as-dense (reference)
+            out.append(allreduce(
+                g, op=self._op, name=f"tape.grad{i}",
+                compression=self._compression,
+                prescale_factor=self._prescale,
+                postscale_factor=self._postscale,
+                process_set=self._process_set))
+        return out
+
+
+def DistributedOptimizer(optimizer, name=None,
+                         compression=Compression.none, op=Average,
+                         backward_passes_per_step: int = 1,
+                         process_set=None):
+    """Wrap a ``keras.optimizers.Optimizer``: gradients are allreduced
+    before being applied (reference: hvd.DistributedOptimizer for TF2 —
+    an ``apply_gradients`` interceptor)."""
+    base = optimizer.__class__
+
+    class _Dist(base):  # noqa: D401 - dynamic wrapper
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            reduced = []
+            for i, (g, v) in enumerate(gv):
+                if g is None:
+                    reduced.append((g, v))
+                    continue
+                if isinstance(g, tf.IndexedSlices):
+                    g = tf.convert_to_tensor(g)
+                g = allreduce(g, op=op, name=f"opt.grad{i}",
+                              compression=compression,
+                              process_set=process_set)
+                reduced.append((g, v))
+            return base.apply_gradients(self, reduced, *args, **kwargs)
+
+    _Dist.__name__ = base.__name__
+    optimizer.__class__ = _Dist
+    return optimizer

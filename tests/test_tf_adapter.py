@@ -1,0 +1,206 @@
+"""TensorFlow + Keras adapter tests.
+
+Reference parity: ``test/parallel/test_tensorflow.py`` +
+``test_tensorflow2_keras.py`` (SURVEY.md §4) — tape/optimizer wrappers,
+broadcast_variables, callbacks — on the 8-device virtual mesh.  The
+equivalence bar (VERDICT #3): a ``tf.function`` training loop through
+``DistributedGradientTape`` matches the single-process loop exactly
+(averaging identical replicated gradients is the identity).
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import helpers_runner  # noqa: E402
+from horovod_tpu.runner import run  # noqa: E402
+import os  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tfhvd(hvd):
+    import horovod_tpu.tensorflow as tfhvd
+    return tfhvd
+
+
+def test_allreduce_eager(tfhvd, n_workers):
+    t = tf.constant([1.0, 2.0, 3.0])
+    out = tfhvd.allreduce(t, op=tfhvd.Sum, name="tf_sum")
+    np.testing.assert_allclose(out.numpy(), t.numpy() * n_workers)
+    out = tfhvd.allreduce(t, name="tf_avg")
+    np.testing.assert_allclose(out.numpy(), t.numpy())
+
+
+def test_allreduce_inside_tf_function(tfhvd, n_workers):
+    @tf.function
+    def fn(x):
+        return tfhvd.allreduce(x, op=tfhvd.Sum, name="tf_fn_sum")
+
+    out = fn(tf.ones((2, 2)))
+    np.testing.assert_allclose(out.numpy(), np.full((2, 2), n_workers))
+
+
+def test_grouped_allreduce(tfhvd, n_workers):
+    ts = [tf.ones(2) * (i + 1) for i in range(3)]
+    outs = tfhvd.grouped_allreduce(ts, op=tfhvd.Sum, name="tf_grp")
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o.numpy(),
+                                   np.full(2, (i + 1) * n_workers))
+
+
+def test_allgather_broadcast(tfhvd, n_workers):
+    t = tf.range(3, dtype=tf.float32)
+    g = tfhvd.allgather(t, name="tf_ag")
+    assert g.shape[0] == 3 * n_workers
+    b = tfhvd.broadcast(t, root_rank=0, name="tf_bc")
+    np.testing.assert_allclose(b.numpy(), t.numpy())
+
+
+def test_broadcast_variables(tfhvd):
+    v1 = tf.Variable([1.0, 2.0])
+    v2 = tf.Variable([[3.0]])
+    before = [v1.numpy().copy(), v2.numpy().copy()]
+    tfhvd.broadcast_variables([v1, v2], root_rank=0)
+    np.testing.assert_allclose(v1.numpy(), before[0])
+    np.testing.assert_allclose(v2.numpy(), before[1])
+
+
+def test_distributed_gradient_tape_matches_plain(tfhvd):
+    """VERDICT #3 done-criterion: tf.function training matches the
+    single-process loop (replicated inputs → averaged grads identical)."""
+    w_ref = tf.Variable([[1.0], [2.0]])
+    w_dist = tf.Variable([[1.0], [2.0]])
+    X = tf.constant(np.random.RandomState(0).randn(8, 2).astype("f4"))
+    y = tf.matmul(X, tf.constant([[0.5], [-1.0]]))
+
+    def step_plain():
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean((tf.matmul(X, w_ref) - y) ** 2)
+        g = tape.gradient(loss, [w_ref])
+        w_ref.assign_sub(0.1 * g[0])
+        return loss
+
+    @tf.function
+    def step_dist():
+        tape = tfhvd.DistributedGradientTape(tf.GradientTape())
+        with tape:
+            loss = tf.reduce_mean((tf.matmul(X, w_dist) - y) ** 2)
+        g = tape.gradient(loss, [w_dist])
+        w_dist.assign_sub(0.1 * g[0])
+        return loss
+
+    for _ in range(5):
+        lp = step_plain()
+        ld = step_dist()
+        np.testing.assert_allclose(ld.numpy(), lp.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(w_dist.numpy(), w_ref.numpy(), rtol=1e-5)
+
+
+def test_tape_backward_passes_per_step(tfhvd):
+    w = tf.Variable(2.0)
+    tape_w = tfhvd.DistributedGradientTape(backward_passes_per_step=2)
+    with tape_w:
+        loss = w * 3.0
+    g1 = tape_w.gradient(loss, [w])
+    assert float(g1[0]) == 0.0  # pass 1: accumulated, nothing reduced
+    tape2 = tf.GradientTape()
+    tape_w._wrapped = tape2
+    with tape_w:
+        loss = w * 3.0
+    g2 = tape_w.gradient(loss, [w])
+    assert float(g2[0]) == 6.0  # sum over the two passes, averaged over
+    # identical workers
+
+
+def test_distributed_optimizer_apply_gradients(tfhvd):
+    opt = tf.keras.optimizers.SGD(learning_rate=1.0)
+    opt = tfhvd.DistributedOptimizer(opt)
+    v = tf.Variable([1.0, 1.0])
+    opt.apply_gradients([(tf.constant([0.5, 0.5]), v)])
+    np.testing.assert_allclose(v.numpy(), [0.5, 0.5])
+
+
+# --- Keras callbacks --------------------------------------------------------
+
+def _tiny_keras_model():
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(4,)),
+        tf.keras.layers.Dense(3, activation="relu"),
+        tf.keras.layers.Dense(1),
+    ])
+    m.compile(optimizer=tf.keras.optimizers.SGD(learning_rate=0.08),
+              loss="mse")
+    return m
+
+
+def test_keras_fit_with_callbacks(tfhvd):
+    import horovod_tpu.keras as khvd
+    X = np.random.RandomState(1).randn(32, 4).astype("f4")
+    y = X @ np.array([[1.0], [0.5], [-0.5], [0.2]], dtype="f4")
+    model = _tiny_keras_model()
+    bc = khvd.BroadcastGlobalVariablesCallback(root_rank=0)
+    ma = khvd.MetricAverageCallback()
+    wu = khvd.LearningRateWarmupCallback(initial_lr=0.08, warmup_epochs=2)
+    hist = model.fit(X, y, epochs=3, batch_size=8, verbose=0,
+                     callbacks=[bc, ma, wu])
+    assert bc.broadcast_done
+    losses = hist.history["loss"]
+    assert losses[-1] < losses[0]
+    lr = float(np.asarray(model.optimizer.learning_rate))
+    assert lr == pytest.approx(0.08, rel=1e-5)
+
+
+def test_lr_warmup_ramps_from_scaled_down(tfhvd, n_workers):
+    import horovod_tpu.keras as khvd
+    model = _tiny_keras_model()
+    wu = khvd.LearningRateWarmupCallback(initial_lr=0.8, warmup_epochs=4)
+    wu.set_model(model)
+    wu.on_epoch_begin(0)
+    wu.on_train_batch_begin(0)
+    lr = float(np.asarray(model.optimizer.learning_rate))
+    assert lr < 0.8  # still ramping
+    assert lr >= 0.8 / n_workers
+    wu.on_epoch_begin(3)
+    wu.on_train_batch_begin(0)
+    wu.on_epoch_end(3)
+    lr = float(np.asarray(model.optimizer.learning_rate))
+    assert lr == pytest.approx(0.8, rel=1e-6)
+
+
+def test_metric_average_callback_passthrough(tfhvd):
+    import horovod_tpu.keras as khvd
+    ma = khvd.MetricAverageCallback()
+    logs = {"loss": 0.5, "acc": 0.75}
+    ma.on_epoch_end(0, logs)
+    # single-controller: metrics replicated → average is the identity
+    assert logs["loss"] == pytest.approx(0.5)
+    assert logs["acc"] == pytest.approx(0.75)
+
+
+# --- real 2-process TF training equivalence ---------------------------------
+
+def test_tf_two_process_tape_training_matches_single():
+    env = {
+        "HOROVOD_TPU_FORCE_PLATFORM": "cpu",
+        "PYTHONPATH": REPO + ":" + os.path.join(REPO, "tests"),
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "HOROVOD_CYCLE_TIME": "0.2",
+    }
+    results = run(helpers_runner.tf_training_fn, np=2, env=env, port=29539)
+    by_rank = {r["rank"]: r for r in results}
+    np.testing.assert_allclose(by_rank[0]["w"], by_rank[1]["w"], atol=1e-6)
+    # single-process full-batch reference
+    X = np.random.RandomState(3).randn(8, 2).astype("f4")
+    y = (X @ np.array([[1.0], [-0.5]], dtype="f4")).astype("f4")
+    w = tf.Variable([[0.2], [0.1]])
+    for _ in range(3):
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean(
+                (tf.matmul(tf.constant(X), w) - tf.constant(y)) ** 2)
+        g = tape.gradient(loss, [w])
+        w.assign_sub(0.5 * g[0])
+    np.testing.assert_allclose(by_rank[0]["w"], w.numpy().tolist(),
+                               atol=1e-5)
