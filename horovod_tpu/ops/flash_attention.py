@@ -250,16 +250,21 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0, 0] = dv_acc.astype(dv_ref.dtype)
 
 
-def _flash_bwd_bhtd(q, k, v, out, lse, do, causal, scale):
+def _flash_bwd_bhtd(q, k, v, out, lse, do, causal, scale, dlse=None):
     B, H, T, D = q.shape
     Hkv, Tk = k.shape[1], k.shape[2]
     g = H // Hkv
     bq, bk = _block_sizes(T, Tk)
     nq, nkv = T // bq, Tk // bk
 
-    # delta_i = rowsum(dO * O) — cheap elementwise, stays in XLA
+    # delta_i = rowsum(dO * O) — cheap elementwise, stays in XLA.
+    # When the caller differentiates through the exposed lse (ring-step
+    # merging), its cotangent folds in exactly here: dlse/ds = p, so
+    # ds = p·(dp − delta) + p·dlse = p·(dp − (delta − dlse)).
     delta = jnp.einsum("bhtd,bhtd->bht", do.astype(jnp.float32),
                        out.astype(jnp.float32)).reshape(B, H, nq, bq)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, bk=bk,
@@ -307,24 +312,28 @@ def _flash_bwd_bhtd(q, k, v, out, lse, do, causal, scale):
 # The GQA group reshape in _dkv_kernel's q block assumes query heads of
 # one kv group are contiguous (head h ↔ kv head h // g), matching
 # jnp.repeat(k, g, axis=head) semantics used across the framework.
+# One custom_vjp serves both entry points: the plain path is the lse path
+# with a zero lse cotangent (folded into delta as a cheap subtract).
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention(q, k, v, causal, scale):
-    out, _ = _flash_fwd_bhtd(q, k, v, causal, scale)
-    return out
+def _flash_attention_lse(q, k, v, causal, scale):
+    return _flash_fwd_bhtd(q, k, v, causal, scale)
 
 
-def _flash_attention_fwd(q, k, v, causal, scale):
+def _flash_attention_lse_fwd(q, k, v, causal, scale):
     out, lse = _flash_fwd_bhtd(q, k, v, causal, scale)
-    return out, (q, k, v, out, lse)
+    return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_attention_bwd(causal, scale, res, do):
+def _flash_attention_lse_bwd(causal, scale, res, cotangents):
+    do, dlse = cotangents
     q, k, v, out, lse = res
-    return _flash_bwd_bhtd(q, k, v, out, lse, do, causal, scale)
+    return _flash_bwd_bhtd(q, k, v, out, lse, do, causal, scale,
+                           dlse=dlse)
 
 
-_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+_flash_attention_lse.defvjp(_flash_attention_lse_fwd,
+                            _flash_attention_lse_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = True,
@@ -335,5 +344,25 @@ def flash_attention(q, k, v, causal: bool = True,
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash_attention(qt, kt, vt, bool(causal), scale)
+    out, _ = _flash_attention_lse(qt, kt, vt, bool(causal), scale)
     return out.transpose(0, 2, 1, 3)
+
+
+def flash_attention_lse(q, k, v, causal: bool = True,
+                        sm_scale: Optional[float] = None):
+    """Fused attention returning ``(out, lse)`` for tile merging.
+
+    ``out [B,T,H,D]``, ``lse [B,H,T]`` (logsumexp of the masked scores per
+    query row).  The ring-attention path merges per-step tiles computed by
+    this kernel into its online-softmax accumulator; gradients flow
+    through both outputs (the lse cotangent folds into the backward
+    kernels' delta term).
+    """
+    scale = float(sm_scale if sm_scale is not None
+                  else q.shape[-1] ** -0.5)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out, lse = _flash_attention_lse(qt, kt, vt, bool(causal), scale)
+    B, H, T, _ = qt.shape
+    return out.transpose(0, 2, 1, 3), lse.reshape(B, H, T)

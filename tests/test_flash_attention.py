@@ -106,3 +106,110 @@ def test_blockwise_local_attention_grad():
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b), atol=5e-3, rtol=5e-3)
+
+
+# --- lse-exposing entry point (ring-step tile merging) ----------------------
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_lse_interpret(causal, monkeypatch):
+    monkeypatch.setattr(fa, "_INTERPRET", True)
+    q, k, v = make_qkv(1, 256, 2, 2, 64)
+    out, lse = fa.flash_attention_lse(q, k, v, causal=causal)
+    ref = dense_reference(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # lse must equal the dense logsumexp of the (masked) scaled scores
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (q.shape[-1] ** -0.5)
+    if causal:
+        T = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], s, -1e30)
+    ref_lse = jax.scipy.special.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               atol=2e-5)
+
+
+def test_flash_attention_lse_grads_interpret(monkeypatch):
+    """Gradients flow through BOTH outputs (the lse cotangent folds into
+    the backward kernels' delta term)."""
+    monkeypatch.setattr(fa, "_INTERPRET", True)
+    q, k, v = make_qkv(1, 128, 2, 1, 64, seed=3)
+
+    def loss_kernel(q, k, v):
+        out, lse = fa.flash_attention_lse(q, k, v, causal=True)
+        return (out ** 2).sum() + 0.3 * (lse ** 2).sum()
+
+    def loss_dense(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       jnp.repeat(k, 2, 2).astype(jnp.float32)
+                       ) * (q.shape[-1] ** -0.5)
+        T = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None],
+                      s, -1e30)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        p = jnp.exp(s - lse[..., None])
+        out = jnp.einsum("bhqk,bkhd->bqhd", p,
+                         jnp.repeat(v, 2, 2).astype(jnp.float32))
+        return (out ** 2).sum() + 0.3 * (lse ** 2).sum()
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-4, rtol=1e-3)
+
+
+# --- flash kernel inside the ring (VERDICT r2 #7) ---------------------------
+
+def _ring_sharded(mesh, fn):
+    from jax.sharding import PartitionSpec as P
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+        out_specs=P(None, "sp"), check_vma=False))
+
+
+@pytest.mark.parametrize("causal,Hkv", [(True, 2), (False, 2), (True, 1)])
+def test_ring_attention_kernel_path_interpret(causal, Hkv, monkeypatch,
+                                              hvd):
+    """The ring path routes each per-step tile through the Pallas kernel
+    when shapes fit (O(Tl·blk) per step instead of a [B,H,Tl,Tl] tile);
+    Hkv=1 exercises the GQA grouped tiles through the merge."""
+    monkeypatch.setattr(fa, "_INTERPRET", True)
+    from horovod_tpu.parallel.ring_attention import ring_attention
+    mesh = jax.make_mesh((2,), ("sp",))
+    q, k, v = make_qkv(1, 256, 2, Hkv, 64, seed=5)  # 128 per shard
+
+    # confirm the kernel path is taken per shard (supported in interpret)
+    assert fa.supported(q[:, :128], k[:, :128], v[:, :128], causal)
+
+    out = _ring_sharded(mesh, lambda q, k, v: ring_attention(
+        q, k, v, axis_name="sp", causal=causal))(q, k, v)
+    ref = dense_reference(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5)
+
+
+def test_ring_attention_kernel_path_grads_interpret(monkeypatch, hvd):
+    monkeypatch.setattr(fa, "_INTERPRET", True)
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.parallel.ring_attention import ring_attention
+    mesh = jax.make_mesh((2,), ("sp",))
+    q, k, v = make_qkv(1, 256, 2, 2, 64, seed=7)
+
+    def ring_loss(q, k, v):
+        # local loss per shard: the reverse ring delivers every shard's
+        # cotangents to each k/v block (see test_parallel.py rationale)
+        o = ring_attention(q, k, v, "sp", causal=True)
+        return (o ** 2).sum()
+
+    gr = jax.jit(jax.shard_map(
+        jax.grad(ring_loss, argnums=(0, 1, 2)), mesh=mesh,
+        in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
+        check_vma=False))(q, k, v)
+
+    def loss_dense(q, k, v):
+        return (dense_reference(q, k, v, True) ** 2).sum()
+
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-4, rtol=1e-3)
