@@ -37,12 +37,63 @@ def detect_peak() -> float:
     return PEAK_TFLOPS.get(gen, PEAK_TFLOPS["v5e"])
 
 
+def bench_bert():
+    """Secondary bench entry (HOROVOD_BENCH_MODEL=bert): BERT fine-tune
+    throughput, BASELINE config 3.  The default metric stays llama_1b so
+    round-over-round numbers remain comparable."""
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.models import bert
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    cfg = bert.bert_base(num_labels=4) if not on_cpu else bert.tiny()
+    batch, seq, steps = (32, 128, 20) if not on_cpu else (4, 32, 3)
+    cfg = dataclasses.replace(cfg, max_seq_len=max(cfg.max_seq_len, seq))
+    n_chips = jax.local_device_count()
+    mesh = jax.make_mesh((n_chips,), ("dp",))
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    opt = optax.adamw(5e-5)
+    opt_state = jax.jit(opt.init)(params)
+    step = bert.make_dp_finetune_step(cfg, mesh, "dp", opt,
+                                      reduce_grads=True)
+
+    rng = np.random.RandomState(0)
+    sh = NamedSharding(mesh, P("dp"))
+    toks = jax.device_put(jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (batch * n_chips, seq)), jnp.int32),
+        sh)
+    labs = jax.device_put(jnp.asarray(
+        rng.randint(0, cfg.num_labels, (batch * n_chips,)), jnp.int32), sh)
+    params, opt_state, loss = step(params, opt_state, toks, labs)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, toks, labs)
+    float(loss)
+    dt = time.perf_counter() - t0
+    seq_per_sec_chip = batch * steps / dt
+    mfu = (seq_per_sec_chip * seq * 6 * bert.count_params(cfg)
+           ) / (detect_peak() * 1e12)
+    print(json.dumps({
+        "metric": "bert_base_finetune_sequences_per_sec_per_chip",
+        "value": round(seq_per_sec_chip, 1),
+        "unit": "sequences/s/chip",
+        "vs_baseline": round(mfu / 0.40, 3),
+    }))
+
+
 def main():
+    import os
+
     import optax
 
     from horovod_tpu import training
     from horovod_tpu.models import llama
     from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
+
+    if os.environ.get("HOROVOD_BENCH_MODEL") == "bert":
+        return bench_bert()
 
     on_cpu = jax.devices()[0].platform == "cpu"
     # ~1B-param geometry: head_dim 128 keeps the flash kernel's score

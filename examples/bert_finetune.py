@@ -1,0 +1,97 @@
+"""BERT fine-tune, data-parallel — benchmark config 3.
+
+The TPU-native analog of the reference's BERT fine-tuning example
+(SURVEY.md §2.3; upstream drives a transformers BERT through Horovod DP):
+init → broadcast parameters → DistributedOptimizer → shard the batch over
+the worker mesh → fine-tune a classification head.  Synthetic
+sentence-classification data keeps the script hermetic: class-dependent
+token distributions the encoder must separate.
+
+Run (single process, all local chips):  python examples/bert_finetune.py
+Multi-process:                hvdrun -np 2 python examples/bert_finetune.py
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import bert
+
+
+def make_dataset(n, seq_len, vocab, num_labels, seed=0):
+    """Synthetic classification set: each label biases a disjoint token
+    range, so a fine-tuned head is learnable and loss must drop."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_labels, n).astype(np.int32)
+    span = (vocab - 10) // num_labels
+    base = rng.randint(0, vocab - 1, (n, seq_len))
+    biased = 10 + labels[:, None] * span + rng.randint(0, span, (n, seq_len))
+    use_bias = rng.rand(n, seq_len) < 0.3
+    tokens = np.where(use_bias, biased, base).astype(np.int32)
+    tokens[:, 0] = 1  # [CLS]
+    return tokens, labels
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--batch-size", type=int, default=8,
+                   help="per-worker batch size")
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--lr", type=float, default=5e-4)
+    p.add_argument("--num-labels", type=int, default=4)
+    p.add_argument("--model", choices=["tiny", "base", "large"],
+                   default="tiny")
+    args = p.parse_args()
+
+    hvd.init()
+    mesh = hvd.mesh()
+    axis = hvd.worker_axis()
+    n_shards = hvd.size()
+    if hvd.rank() == 0:
+        print(f"workers={n_shards} local chips={jax.local_device_count()}")
+
+    import dataclasses
+    cfg = {"tiny": bert.tiny(num_labels=args.num_labels),
+           "base": bert.bert_base(args.num_labels),
+           "large": bert.bert_large(args.num_labels)}[args.model]
+    cfg = dataclasses.replace(
+        cfg, max_seq_len=max(cfg.max_seq_len, args.seq_len))
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    if hvd.rank() == 0:
+        print(f"params: {bert.count_params(cfg) / 1e6:.1f}M")
+
+    opt = hvd.DistributedOptimizer(optax.adamw(args.lr), axis_name=axis)
+    opt_state = jax.jit(opt.init)(params)
+    train_step = bert.make_dp_finetune_step(cfg, mesh, axis, opt)
+
+    global_bs = args.batch_size * n_shards
+    tokens, labels = make_dataset(global_bs * 16, args.seq_len,
+                                  cfg.vocab_size, args.num_labels)
+    data_sh = NamedSharding(mesh, P(axis))
+    t0, first_loss = time.time(), None
+    for i in range(args.steps):
+        lo = (i * global_bs) % (len(tokens) - global_bs + 1)
+        x = jax.device_put(jnp.asarray(tokens[lo:lo + global_bs]), data_sh)
+        y = jax.device_put(jnp.asarray(labels[lo:lo + global_bs]), data_sh)
+        params, opt_state, loss = train_step(params, opt_state, x, y)
+        if first_loss is None:
+            first_loss = float(loss)
+    loss = float(loss)
+    dt = time.time() - t0
+    if hvd.rank() == 0:
+        print(f"loss {first_loss:.4f} -> {loss:.4f} over {args.steps} "
+              f"steps; {args.steps * global_bs * args.seq_len / dt:.0f} "
+              f"tokens/s")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
