@@ -310,5 +310,6 @@ allgather_p = collectives.allgather_p
 broadcast_p = collectives.broadcast_p
 alltoall_p = collectives.alltoall_p
 reducescatter_p = collectives.reducescatter_p
+hierarchical_allreduce_p = collectives.hierarchical_allreduce_p
 stack_on_workers = collectives.stack_on_workers
 worker_values = collectives.worker_values

@@ -210,6 +210,79 @@ def _replicated_allreduce_fn(mesh_key, op, n, nshapes,
 
 
 @functools.lru_cache(maxsize=1024)
+def _hier_allreduce_fn(mesh_key, axis, op, n, shapes, n_groups, group,
+                       has_prescale, has_postscale):
+    """Two-stage hierarchical allreduce (reference:
+    NCCLHierarchicalAllreduce, SURVEY §5.8): reduce-scatter within the
+    group (ICI), allreduce the 1/group-size chunk across groups (DCN),
+    all-gather within the group — DCN bytes drop by the group size.
+
+    The worker mesh is viewed as 2-D (groups × group); the stacked dim
+    shards over both axes, process-major.
+    """
+    mesh1d = _MESHES[mesh_key]
+    devs = np.asarray(mesh1d.devices).reshape(n_groups, group)
+    mesh = jax.sharding.Mesh(devs, ("hvd_cross", "hvd_local"))
+
+    def shard_fn(prescale, postscale, *xs):
+        locals_ = [x[0] for x in xs]  # [1, ...] shard → drop worker dim
+        if has_prescale:
+            locals_ = [x * prescale.astype(x.dtype) for x in locals_]
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        flat = (jnp.concatenate([x.reshape(-1) for x in locals_])
+                if len(locals_) > 1 else locals_[0].reshape(-1))
+        total = flat.shape[0]
+        pad = (-total) % group
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad,), flat.dtype)])
+        # stage 1 (ICI): each chip keeps 1/group of the intra-group sum
+        chunk = lax.psum_scatter(flat, "hvd_local", scatter_dimension=0,
+                                 tiled=True)
+        # stage 2 (DCN): allreduce the chunk across groups
+        chunk = lax.psum(chunk, "hvd_cross")
+        # stage 3 (ICI): regather the full vector within the group
+        red = lax.all_gather(chunk, "hvd_local", tiled=True)
+        if pad:
+            red = red[:total]
+        if op == ReduceOp.AVERAGE:
+            red = red / n
+        outs, offset = [], 0
+        for s, sz in zip(shapes, sizes):
+            outs.append(red[offset:offset + sz].reshape(s))
+            offset += sz
+        if has_postscale:
+            outs = [x * postscale.astype(x.dtype) for x in outs]
+        return tuple(outs)
+
+    in_specs = (P(), P()) + tuple(
+        P(("hvd_cross", "hvd_local")) for _ in shapes)
+    out_specs = tuple(P() for _ in shapes)
+    f = jax.shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False)
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=1024)
+def _hier_allgather_fn(mesh_key, axis, n_groups, group):
+    """Two-stage allgather: gather within the group (ICI) then across
+    groups (DCN) — the HOROVOD_HIERARCHICAL_ALLGATHER analog."""
+    mesh1d = _MESHES[mesh_key]
+    devs = np.asarray(mesh1d.devices).reshape(n_groups, group)
+    mesh = jax.sharding.Mesh(devs, ("hvd_cross", "hvd_local"))
+
+    def shard_fn(x):
+        g = lax.all_gather(x[0], "hvd_local", tiled=False)
+        g = g.reshape((-1,) + g.shape[2:])
+        gg = lax.all_gather(g, "hvd_cross", tiled=False)
+        return gg.reshape((-1,) + gg.shape[2:])
+
+    return jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=P(("hvd_cross", "hvd_local")),
+        out_specs=P(), check_vma=False))
+
+
+@functools.lru_cache(maxsize=1024)
 def _stacked_allgather_fn(mesh_key, axis):
     """Allgather: concatenate per-worker contributions along dim 0.
 
@@ -356,21 +429,41 @@ def allreduce_arrays(arrays: List, ps, op: str = ReduceOp.AVERAGE,
         shapes = tuple(tuple(a.shape[1:]) for a in arrays)
         dtypes = tuple(str(a.dtype) for a in arrays)
         fuse = len(set(dtypes)) == 1
-        fn = _stacked_allreduce_fn(
-            mesh_key(ps), ps.axis, op, n, shapes, dtypes, has_pre, has_post,
-            fuse)
+        hier = None
+        if op in _SUMMABLE and fuse:
+            from .. import runtime
+            cfg = runtime._state().config
+            if cfg is not None and cfg.hierarchical_allreduce:
+                hier = ps.hier_shape()
+        if hier is not None:
+            fn = _hier_allreduce_fn(
+                mesh_key(ps), ps.axis, op, n, shapes, hier[0], hier[1],
+                has_pre, has_post)
+        else:
+            fn = _stacked_allreduce_fn(
+                mesh_key(ps), ps.axis, op, n, shapes, dtypes, has_pre,
+                has_post, fuse)
     else:
         fn = _replicated_allreduce_fn(
             mesh_key(ps), op, n, len(arrays), has_pre, has_post)
     return list(fn(pre, post, *arrays))
 
 
+def _allgather_fn_for(ps):
+    from .. import runtime
+    cfg = runtime._state().config
+    if cfg is not None and cfg.hierarchical_allgather:
+        hier = ps.hier_shape()
+        if hier is not None:
+            return _hier_allgather_fn(mesh_key(ps), ps.axis, *hier)
+    return _stacked_allgather_fn(mesh_key(ps), ps.axis)
+
+
 def allgather_array(x, ps):
     if is_stacked(x, ps):
-        return _stacked_allgather_fn(mesh_key(ps), ps.axis)(x)
+        return _allgather_fn_for(ps)(x)
     if spans_processes(ps):
-        return _stacked_allgather_fn(mesh_key(ps), ps.axis)(
-            lift_to_workers(x, ps))
+        return _allgather_fn_for(ps)(lift_to_workers(x, ps))
     # replicated: every worker contributes the same tensor → tile
     n = ps.size()
     return jnp.concatenate([x] * n, axis=0)
@@ -492,3 +585,27 @@ def reducescatter_p(x, axis_name: str, op: str = ReduceOp.AVERAGE):
     if op == ReduceOp.AVERAGE:
         out = out / lax.axis_size(axis_name)
     return out
+
+
+def hierarchical_allreduce_p(x, cross_axis: str, local_axis: str,
+                             op: str = ReduceOp.AVERAGE):
+    """Traceable two-stage allreduce over a (cross, local) mesh factoring
+    (reference: NCCLHierarchicalAllreduce; SURVEY §5.8 ICI/DCN analog):
+    reduce-scatter over ``local_axis`` (ICI), psum the chunk over
+    ``cross_axis`` (DCN), all-gather over ``local_axis`` — cross-axis
+    bytes drop by the local axis size."""
+    group = lax.axis_size(local_axis)
+    shape = x.shape
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % group
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    chunk = lax.psum_scatter(flat, local_axis, scatter_dimension=0,
+                             tiled=True)
+    chunk = lax.psum(chunk, cross_axis)
+    red = lax.all_gather(chunk, local_axis, tiled=True)
+    if pad:
+        red = red[:flat.shape[0] - pad]
+    if op == ReduceOp.AVERAGE:
+        red = red / (group * lax.axis_size(cross_axis))
+    return red.reshape(shape)

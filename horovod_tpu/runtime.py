@@ -120,6 +120,38 @@ class ProcessSet:
                               for d in self._mesh.devices.flat)
         return self._spans
 
+    def hier_shape(self) -> Optional[tuple]:
+        """(n_groups, group_size) for hierarchical collectives, or None.
+
+        Reference: NCCLHierarchicalAllreduce's intra-node/inter-node split
+        (SURVEY §2.1/§5.8) — on TPU the analog is ICI within a host's
+        chips vs DCN across hosts.  Valid when the set's workers group by
+        process contiguously with uniform size (TPU slices are).  Cached
+        (hot-path queried per dispatch); tests may force a factorization
+        by assigning ``_hier_shape``.
+        """
+        if getattr(self, "_hier_shape", None) is not None:
+            return self._hier_shape
+        cached = getattr(self, "_hier_cached", False)
+        if cached is not False:
+            return cached
+        self._check()
+        self._hier_cached = self._compute_hier_shape()
+        return self._hier_cached
+
+    def _compute_hier_shape(self) -> Optional[tuple]:
+        procs = [d.process_index for d in self._mesh.devices.flat]
+        n = len(procs)
+        n_groups = len(set(procs))
+        if n_groups <= 1 or n % n_groups:
+            return None
+        group = n // n_groups
+        # contiguous process-major grouping required for the 2-D reshape
+        for g in range(n_groups):
+            if len({procs[g * group + i] for i in range(group)}) != 1:
+                return None
+        return (n_groups, group)
+
     def _check(self):
         if not self.initialized():
             raise NotInitializedError("ProcessSet")
@@ -494,6 +526,26 @@ def start_timeline(file_path: str, mark_cycles: bool = False):
 def stop_timeline():
     st = _require_init()
     st.timeline.close()
+
+
+def start_profiler(logdir: str):
+    """Start a device (XLA/libtpu) trace via ``jax.profiler``.
+
+    The NVTX-integration analog (reference: nvtx_op_range.cc + Nsight):
+    while active, the engine's per-dispatch TraceAnnotation ranges land
+    in the same Perfetto trace as XLA's collective/kernel spans, giving
+    the merged framework+device view SURVEY §5.1 prescribes.  View with
+    ``tensorboard --logdir`` or Perfetto.
+    """
+    _require_init()
+    import jax.profiler
+    jax.profiler.start_trace(logdir)
+
+
+def stop_profiler():
+    _require_init()
+    import jax.profiler
+    jax.profiler.stop_trace()
 
 
 # --- topology accessors (reference: horovod/common/basics.py) ---------------

@@ -1,0 +1,108 @@
+"""Hierarchical (two-stage ICI/DCN) collective tests.
+
+Reference parity: ``NCCLHierarchicalAllreduce`` — NCCL intra-node +
+MPI inter-node (SURVEY.md §2.1/§5.8); the TPU analog is
+reduce-scatter/all-gather within a host's chips over ICI with the
+cross-host reduce over DCN.  On the virtual 8-device mesh the (2, 4)
+factorization is forced via the test hook; numerics must equal the flat
+path exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu import runtime
+from horovod_tpu.ops import collectives
+
+
+@pytest.fixture
+def hier_ps(hvd):
+    """Global process set with a forced (2, 4) hierarchy + flag, restored
+    after the test (config flags snapshot at init, so tests mutate)."""
+    ps = runtime._get_global_process_set()
+    cfg = runtime._state().config
+    ps._hier_shape = (2, 4)
+    cfg.hierarchical_allreduce = True
+    cfg.hierarchical_allgather = True
+    yield ps
+    ps._hier_shape = None
+    cfg.hierarchical_allreduce = False
+    cfg.hierarchical_allgather = False
+
+
+def test_hier_shape_detection_single_process(hvd):
+    # one process: no hierarchy (grouping requires >1 process)
+    ps = runtime._get_global_process_set()
+    assert ps.hier_shape() is None
+
+
+def test_hierarchical_allreduce_matches_flat(hvd, hier_ps, n_workers):
+    vals = [np.full((3, 5), float(r + 1), np.float32)
+            for r in range(n_workers)]
+    x = collectives.stack_on_workers(vals, hier_ps)
+    out = hvd.allreduce(x, op=hvd.Sum, name="hier_sum")
+    want = sum(vals)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+    out = hvd.allreduce(x, name="hier_avg")
+    np.testing.assert_allclose(np.asarray(out), want / n_workers,
+                               rtol=1e-6)
+
+
+def test_hierarchical_allreduce_pad_path(hvd, hier_ps, n_workers):
+    """Element count not divisible by the group size exercises padding."""
+    vals = [np.arange(7, dtype=np.float32) * (r + 1)
+            for r in range(n_workers)]
+    x = collectives.stack_on_workers(vals, hier_ps)
+    out = hvd.allreduce(x, op=hvd.Sum, name="hier_pad")
+    np.testing.assert_allclose(np.asarray(out), sum(vals), rtol=1e-6)
+
+
+def test_hierarchical_fused_bucket(hvd, hier_ps, n_workers):
+    """Grouped (fused) allreduce through the hierarchical kernel."""
+    a = collectives.worker_values(
+        lambda r: np.full((4,), float(r), np.float32), hier_ps)
+    b = collectives.worker_values(
+        lambda r: np.full((2, 3), 2.0 * r, np.float32), hier_ps)
+    outs = hvd.grouped_allreduce([a, b], op=hvd.Sum, name="hier_grp")
+    s = sum(range(n_workers))
+    np.testing.assert_allclose(np.asarray(outs[0]), np.full((4,), s),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(outs[1]),
+                               np.full((2, 3), 2.0 * s), rtol=1e-6)
+
+
+def test_hierarchical_allgather_matches_flat(hvd, hier_ps, n_workers):
+    vals = [np.full((2,), float(r), np.float32) for r in range(n_workers)]
+    x = collectives.stack_on_workers(vals, hier_ps)
+    out = hvd.allgather(x, name="hier_ag")
+    np.testing.assert_allclose(np.asarray(out), np.concatenate(vals))
+
+
+def test_hierarchical_allreduce_p_in_jit(hvd):
+    """In-jit two-stage form over an explicit (cross, local) mesh equals
+    a plain psum over both axes."""
+    mesh = jax.make_mesh((2, 4), ("cross", "local"))
+    x = jnp.arange(8 * 6, dtype=jnp.float32).reshape(8, 6)
+
+    def f(x):
+        from horovod_tpu.api import hierarchical_allreduce_p
+        return hierarchical_allreduce_p(x, "cross", "local", op="sum")
+
+    out = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=P(("cross", "local")),
+        out_specs=P(), check_vma=False))(x)
+    # every shard is [1, 6]; the sum over all 8 shards
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(x).sum(0, keepdims=True),
+                               rtol=1e-6)
+
+
+def test_flags_parsed_from_env(monkeypatch):
+    from horovod_tpu.config import Config
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLGATHER", "true")
+    c = Config.from_env()
+    assert c.hierarchical_allreduce and c.hierarchical_allgather
