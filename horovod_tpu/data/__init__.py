@@ -1,0 +1,5 @@
+"""Data-loading utilities (reference: ``horovod/data/``)."""
+
+from .data_loader import AsyncDataLoaderMixin, BaseDataLoader, ShardedLoader  # noqa: F401,E501
+
+__all__ = ["BaseDataLoader", "AsyncDataLoaderMixin", "ShardedLoader"]

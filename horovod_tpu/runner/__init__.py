@@ -8,4 +8,5 @@ the TPU-native replacement for the reference's Gloo HTTP KV store / mpirun.
 """
 
 from .api import run  # noqa: F401
+from .executor import TpuExecutor  # noqa: F401
 from .launch import main, parse_args  # noqa: F401

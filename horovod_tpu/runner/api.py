@@ -14,6 +14,12 @@ import sys
 import tempfile
 from typing import Any, Callable, List, Optional
 
+try:  # serialize __main__-defined functions by value (reference: horovod
+    # uses cloudpickle for run(fn) the same way)
+    import cloudpickle as _fn_pickle
+except ImportError:  # pragma: no cover
+    _fn_pickle = pickle
+
 from . import spawn
 from .hosts import assign_slots, effective_hosts
 from .launch import DEFAULT_PORT, _coordinator_addr
@@ -33,7 +39,7 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     with tempfile.TemporaryDirectory(prefix="hvdrun_") as tmp:
         payload = os.path.join(tmp, "payload.pkl")
         with open(payload, "wb") as f:
-            pickle.dump((fn, args, kwargs), f)
+            _fn_pickle.dump((fn, args, kwargs), f)
         results_dir = os.path.join(tmp, "results")
         os.makedirs(results_dir)
         command = [sys.executable, "-m", "horovod_tpu.runner.run_task",
