@@ -1,0 +1,69 @@
+"""TF2/Keras MNIST data-parallel training (reference:
+``examples/tensorflow2/tensorflow2_keras_mnist.py``) through the TF
+adapter: DistributedOptimizer + the three canonical callbacks.
+
+Run:             python examples/tensorflow2_keras_mnist.py
+Multi-process:   hvdrun -np 2 python examples/tensorflow2_keras_mnist.py
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import tensorflow as tf
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mnist import load_mnist  # noqa: E402
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+import horovod_tpu.keras as khvd  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--n-train", type=int, default=2048)
+    p.add_argument("--data-dir", default=None)
+    args = p.parse_args()
+
+    hvd.init()
+    rank, nproc = hvd.cross_rank(), hvd.cross_size()
+
+    images, labels = load_mnist(args.data_dir, args.n_train)
+    X = images[rank::nproc]
+    y = labels[rank::nproc]
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(28, 28, 1)),
+        tf.keras.layers.Conv2D(8, 3, strides=2, activation="relu"),
+        tf.keras.layers.Conv2D(16, 3, strides=2, activation="relu"),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(10),
+    ])
+    # scale LR by world size; the warmup callback ramps into it
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.Adam(args.lr * nproc))
+    model.compile(
+        optimizer=opt,
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(
+            from_logits=True),
+        metrics=["accuracy"])
+
+    callbacks = [
+        khvd.BroadcastGlobalVariablesCallback(root_rank=0),
+        khvd.MetricAverageCallback(),
+        khvd.LearningRateWarmupCallback(initial_lr=args.lr * nproc,
+                                        warmup_epochs=2),
+    ]
+    hist = model.fit(X, y, batch_size=args.batch_size, epochs=args.epochs,
+                     callbacks=callbacks, verbose=2 if rank == 0 else 0)
+    if rank == 0:
+        print("final loss:", hist.history["loss"][-1])
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

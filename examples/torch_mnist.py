@@ -1,0 +1,82 @@
+"""Torch MNIST data-parallel training (reference:
+``examples/pytorch/pytorch_mnist.py``, BASELINE config 1) through the
+torch adapter: init → broadcast parameters + optimizer state →
+DistributedOptimizer with per-parameter gradient hooks → train.
+
+Synthetic MNIST-style data keeps the script hermetic (same generator as
+examples/mnist.py).
+
+Run:             python examples/torch_mnist.py
+Multi-process:   hvdrun -np 2 python examples/torch_mnist.py
+"""
+
+import argparse
+import sys
+import os
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mnist import load_mnist  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(1, 8, 3, stride=2)
+        self.conv2 = torch.nn.Conv2d(8, 16, 3, stride=2)
+        self.fc = torch.nn.Linear(16 * 6 * 6, 10)
+
+    def forward(self, x):
+        x = F.relu(self.conv1(x))
+        x = F.relu(self.conv2(x))
+        return self.fc(x.flatten(1))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--n-train", type=int, default=2048)
+    p.add_argument("--data-dir", default=None)
+    args = p.parse_args()
+
+    hvd.init()
+    rank, nproc = hvd.cross_rank(), hvd.cross_size()
+    if rank == 0:
+        print(f"processes={nproc} workers={hvd.size()}")
+
+    torch.manual_seed(42)
+    model = Net()
+    opt = torch.optim.Adam(model.parameters(), lr=args.lr * nproc)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+    images, labels = load_mnist(args.data_dir, args.n_train)
+    # shard the dataset by process (reference: DistributedSampler)
+    X = torch.from_numpy(images[rank::nproc]).permute(0, 3, 1, 2)
+    y = torch.from_numpy(labels[rank::nproc]).long()
+
+    for epoch in range(args.epochs):
+        perm = torch.randperm(len(X))
+        for i in range(0, len(X) - args.batch_size + 1, args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            opt.zero_grad()
+            loss = F.cross_entropy(model(X[idx]), y[idx])
+            loss.backward()
+            opt.step()
+        avg = hvd.allreduce(loss.detach(), name="loss")
+        if rank == 0:
+            print(f"epoch {epoch}: loss={float(avg):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

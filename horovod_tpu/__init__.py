@@ -28,7 +28,7 @@ from .runtime import (  # noqa: F401
     mpi_threads_supported, mpi_enabled, mpi_built, gloo_enabled, gloo_built,
     nccl_built, ddl_built, ccl_built, cuda_built, rocm_built, xla_built,
     tpu_built,
-    start_timeline, stop_timeline,
+    start_timeline, stop_timeline, start_profiler, stop_profiler,
     ProcessSet, add_process_set, remove_process_set,
     get_process_set_ids_and_ranks,
     ReduceOp, Average, Sum, Adasum, Min, Max, Product,

@@ -2,14 +2,16 @@
 
 Reference parity: ``horovod/common/parameter_manager.cc`` (SURVEY.md §2.1) —
 the reference runs Bayesian optimization (Gaussian-process surrogate) over
-fusion-threshold and cycle-time, scoring candidates by observed throughput,
+fusion-threshold AND cycle-time, scoring candidates by observed throughput,
 with warmup → sampling → tuned phases, logging to ``HOROVOD_AUTOTUNE_LOG``.
 
-TPU redesign: the parameters that matter here are the fusion threshold
-(bucket size of the flatten-concat-psum) and the cycle time.  The search is
-a Gaussian-process expected-improvement loop over log2(threshold), same
-phases and logging as the reference, implemented with numpy (the reference
-vendored Eigen+LBFGS for the same job).
+TPU redesign: the same two parameters matter — the fusion threshold
+(bucket size of the flatten-concat-psum) and the background cycle time
+(batching window for eager submissions).  The search is a 2-D
+Gaussian-process expected-improvement loop over (log2 threshold,
+cycle-time index), same phases and logging as the reference, implemented
+with numpy (the reference vendored Eigen+LBFGS for the same job).  A
+sample budget bounds the search (the full grid need not be visited).
 """
 
 from __future__ import annotations
@@ -24,30 +26,36 @@ import numpy as np
 logger = logging.getLogger("horovod_tpu")
 
 _MIB = 1024 * 1024
-# candidate grid: log2 bucket bytes from 1 MiB to 512 MiB
-_GRID = [float(e) for e in range(20, 30)]
+# candidate grids: log2 bucket bytes 1 MiB..512 MiB × cycle time ms
+_THRESH_GRID = [float(e) for e in range(20, 30)]
+_CYCLE_GRID_MS = [0.0, 0.5, 1.0, 2.0, 5.0, 10.0]
+# 2-D candidate points in normalized coordinates (threshold exponent,
+# cycle index) — the cycle dim uses its INDEX so the RBF sees uniform
+# spacing despite the geometric ms grid
+_GRID_2D = [(t, float(ci)) for t in _THRESH_GRID
+            for ci in range(len(_CYCLE_GRID_MS))]
 
 
 class _GP:
-    """Tiny Gaussian process (RBF kernel) for 1-D expected improvement."""
+    """Tiny Gaussian process (RBF kernel) for N-D expected improvement."""
 
-    def __init__(self, length_scale: float = 1.5, noise: float = 1e-2):
-        self.ls = length_scale
+    def __init__(self, length_scales=(1.5, 1.0), noise: float = 1e-2):
+        self.ls = np.asarray(length_scales)
         self.noise = noise
-        self.xs: List[float] = []
+        self.xs: List[Tuple[float, ...]] = []
         self.ys: List[float] = []
 
-    def add(self, x: float, y: float):
-        self.xs.append(x)
+    def add(self, x: Tuple[float, ...], y: float):
+        self.xs.append(tuple(x))
         self.ys.append(y)
 
     def _k(self, a, b):
-        a = np.asarray(a)[:, None]
-        b = np.asarray(b)[None, :]
-        return np.exp(-0.5 * ((a - b) / self.ls) ** 2)
+        a = np.asarray(a, float)[:, None, :] / self.ls
+        b = np.asarray(b, float)[None, :, :] / self.ls
+        return np.exp(-0.5 * np.sum((a - b) ** 2, axis=-1))
 
     def posterior(self, xq) -> Tuple[np.ndarray, np.ndarray]:
-        X = np.asarray(self.xs)
+        X = np.asarray(self.xs, float)
         y = np.asarray(self.ys)
         mu0 = y.mean() if len(y) else 0.0
         K = self._k(X, X) + self.noise * np.eye(len(X))
@@ -57,14 +65,17 @@ class _GP:
         v = 1.0 + self.noise - np.sum(Ks * np.linalg.solve(K, Ks.T).T, axis=1)
         return mu, np.sqrt(np.maximum(v, 1e-12))
 
-    def suggest(self) -> float:
+    def suggest(self) -> Tuple[float, float]:
+        unseen = [p for p in _GRID_2D if p not in set(self.xs)]
+        if not unseen:
+            return _GRID_2D[0]
         if not self.xs:
-            return _GRID[len(_GRID) // 2]
-        mu, sd = self.posterior(_GRID)
+            return unseen[len(unseen) // 2]
+        mu, sd = self.posterior(unseen)
         best = max(self.ys)
         z = (mu - best) / sd
         ei = sd * (z * _ndtr(z) + _npdf(z))
-        return _GRID[int(np.argmax(ei))]
+        return unseen[int(np.argmax(ei))]
 
 
 def _ndtr(z):
@@ -75,28 +86,42 @@ def _npdf(z):
     return np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
 
 
+def _nearest_cycle_index(ms: float) -> int:
+    return int(np.argmin([abs(c - ms) for c in _CYCLE_GRID_MS]))
+
+
 class ParameterManager:
-    """Warmup → sample → tuned lifecycle, scoring by bytes/sec throughput."""
+    """Warmup → sample → tuned lifecycle, scoring by bytes/sec throughput.
+
+    Tunes (fusion threshold, cycle time) jointly — reference:
+    ParameterManager's joint tunable set.
+    """
 
     def __init__(self, cfg):
         self.cfg = cfg
         self.warmup_remaining = cfg.autotune_warmup_samples
         self.steps_per_sample = cfg.autotune_steps_per_sample
+        self.max_samples = getattr(cfg, "autotune_max_samples", 20)
         self._gp = _GP()
-        self._current_exp = math.log2(cfg.fusion_threshold_bytes)
+        self._current = (math.log2(cfg.fusion_threshold_bytes),
+                         float(_nearest_cycle_index(cfg.cycle_time_ms)))
         self._sample_bytes = 0
         self._sample_time = 0.0
         self._sample_steps = 0
         self._tuned = False
-        self._best: Optional[Tuple[float, float]] = None
+        self._best: Optional[Tuple[Tuple[float, float], float]] = None
         self._log_file = open(cfg.autotune_log, "w") if cfg.autotune_log \
             else None
         if self._log_file:
             self._log_file.write(
-                "timestamp,fusion_threshold_bytes,score_bytes_per_sec,phase\n")
+                "timestamp,fusion_threshold_bytes,cycle_time_ms,"
+                "score_bytes_per_sec,phase\n")
 
     def current_fusion_threshold(self) -> int:
-        return int(2 ** self._current_exp)
+        return int(2 ** self._current[0])
+
+    def current_cycle_time_ms(self) -> float:
+        return _CYCLE_GRID_MS[int(self._current[1])]
 
     @property
     def tuned(self) -> bool:
@@ -112,28 +137,34 @@ class ParameterManager:
             return
         score = self._sample_bytes / max(self._sample_time, 1e-9)
         phase = "warmup" if self.warmup_remaining > 0 else "sample"
+        # log row pairs the score with the parameters it was MEASURED at
+        # (self._current moves to the next suggestion below)
+        measured_thr = self.current_fusion_threshold()
+        measured_cyc = self.current_cycle_time_ms()
         if self.warmup_remaining > 0:
             self.warmup_remaining -= 1
         else:
-            self._gp.add(self._current_exp, score)
+            self._gp.add(self._current, score)
             if self._best is None or score > self._best[1]:
-                self._best = (self._current_exp, score)
-            if len(self._gp.xs) >= len(_GRID):
+                self._best = (self._current, score)
+            if (len(self._gp.xs) >= self.max_samples
+                    or len(self._gp.xs) >= len(_GRID_2D)):
                 # converge: lock in the best observed point
-                self._current_exp = self._best[0]
+                self._current = self._best[0]
                 self._tuned = True
                 phase = "tuned"
                 logger.info(
                     "autotune converged: fusion_threshold=%d bytes "
-                    "(%.1f MiB), score=%.3g B/s",
+                    "(%.1f MiB), cycle_time=%.1f ms, score=%.3g B/s",
                     self.current_fusion_threshold(),
-                    self.current_fusion_threshold() / _MIB, self._best[1])
+                    self.current_fusion_threshold() / _MIB,
+                    self.current_cycle_time_ms(), self._best[1])
             else:
-                self._current_exp = self._gp.suggest()
+                self._current = self._gp.suggest()
         if self._log_file:
             self._log_file.write(
-                f"{time.time():.3f},{self.current_fusion_threshold()},"
-                f"{score:.6g},{phase}\n")
+                f"{time.time():.3f},{measured_thr},"
+                f"{measured_cyc:g},{score:.6g},{phase}\n")
             self._log_file.flush()
         self._sample_bytes = 0
         self._sample_time = 0.0

@@ -71,6 +71,7 @@ class Config:
     autotune_log: Optional[str] = None
     autotune_warmup_samples: int = 3
     autotune_steps_per_sample: int = 10
+    autotune_max_samples: int = 20
     # --- logging ---
     log_level: str = "warning"
     log_timestamp: bool = False
@@ -126,6 +127,8 @@ class Config:
             "HOROVOD_AUTOTUNE_WARMUP_SAMPLES", c.autotune_warmup_samples)
         c.autotune_steps_per_sample = _env_int(
             "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", c.autotune_steps_per_sample)
+        c.autotune_max_samples = _env_int(
+            "HOROVOD_AUTOTUNE_MAX_SAMPLES", c.autotune_max_samples)
         c.log_level = _env_str("HOROVOD_LOG_LEVEL", c.log_level) or "warning"
         c.log_timestamp = _env_bool("HOROVOD_LOG_TIMESTAMP", c.log_timestamp)
         c.elastic = _env_bool("HOROVOD_ELASTIC", c.elastic)

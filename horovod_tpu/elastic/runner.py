@@ -37,18 +37,31 @@ _RECOVERABLE_MARKERS = (
 # triggering a global re-form loop.
 try:
     from jax.errors import JaxRuntimeError as _JaxRuntimeError
-    _RECOVERABLE_TYPES = (HorovodInternalError, _JaxRuntimeError, ValueError)
 except ImportError:  # pragma: no cover - older jax
-    _RECOVERABLE_TYPES = (HorovodInternalError, ValueError)
+    _JaxRuntimeError = ()
+
+# XLA's CPU-mesh collectives raise bare ValueError, but always with an
+# absl status-code prefix ("UNKNOWN: Gloo allreduce failed...") or an
+# explicit transport name; a user's ValueError ("connection string
+# invalid") carries neither, so it surfaces instead of looping re-forms.
+_XLA_STATUS_PREFIXES = (
+    "unknown:", "internal:", "unavailable:", "aborted:", "cancelled:",
+    "deadline_exceeded", "failed_precondition:")
+_XLA_TRANSPORT_NAMES = ("gloo", "xla", "pjrt", "coordination service")
 
 
 def _is_recoverable(exc) -> bool:
     if isinstance(exc, HorovodInternalError):
         return True
-    if not isinstance(exc, _RECOVERABLE_TYPES):
-        return False
     msg = str(exc).lower()
-    return any(m in msg for m in _RECOVERABLE_MARKERS)
+    if isinstance(exc, _JaxRuntimeError):
+        return any(m in msg for m in _RECOVERABLE_MARKERS)
+    if isinstance(exc, ValueError):
+        if not (msg.startswith(_XLA_STATUS_PREFIXES)
+                or any(t in msg for t in _XLA_TRANSPORT_NAMES)):
+            return False  # ordinary user ValueError
+        return any(m in msg for m in _RECOVERABLE_MARKERS)
+    return False
 
 
 def run(func=None, *, reset_limit: int = None):

@@ -144,7 +144,7 @@ class ArrayState(State):
         raise AttributeError(name)
 
     def __setattr__(self, name, value):
-        if name.startswith("_") or name in ("model",):
+        if name.startswith("_"):
             object.__setattr__(self, name, value)
             return
         if "_trees" in self.__dict__ and name in self._trees:

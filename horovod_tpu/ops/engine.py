@@ -231,15 +231,24 @@ class CollectiveEngine:
         return entry.handle
 
     # -- the loop -----------------------------------------------------------
+    def _cycle_time_s(self) -> float:
+        if self.autotuner is not None and not (
+                self._controller is not None and self._controller.enabled):
+            # single-process: the autotuner may be exploring cycle time
+            # (multi-process pins to config, like the fusion threshold)
+            return self.autotuner.current_cycle_time_ms() / 1000.0
+        return max(self.cfg.cycle_time_ms, 0.0) / 1000.0
+
     def _loop(self):
-        cycle_s = max(self.cfg.cycle_time_ms, 0.0) / 1000.0
         while True:
             with self._cv:
                 while not self._queue and not self._stop:
                     self._cv.wait(timeout=0.1)
                 if self._stop:
                     return
-            # let the cycle window fill (reference: HOROVOD_CYCLE_TIME)
+            # let the cycle window fill (reference: HOROVOD_CYCLE_TIME);
+            # re-read each cycle — the autotuner may move it
+            cycle_s = self._cycle_time_s()
             if cycle_s > 0:
                 time.sleep(cycle_s)
             try:
@@ -508,6 +517,17 @@ class CollectiveEngine:
     def _dispatch_bucket(self, entries, sigs, owner, base, bucket, results):
         first = sigs[bucket[0]]
         op_type = first.op_type
+        # profiler range per fused dispatch (reference: nvtx_op_range.cc —
+        # the NVTX analog; lands inside any active jax.profiler trace so
+        # framework spans merge with the XLA device trace, SURVEY §5.1)
+        with jax.profiler.TraceAnnotation(
+                f"hvd.{op_type}[{len(bucket)}]"):
+            self._dispatch_bucket_inner(entries, sigs, owner, base, bucket,
+                                        results, op_type)
+
+    def _dispatch_bucket_inner(self, entries, sigs, owner, base, bucket,
+                               results, op_type):
+        first = sigs[bucket[0]]
         if self.timeline:
             names = [sigs[si].name for si in bucket]
             self.timeline.activity_start(names, "MEMCPY_IN_FUSION_BUFFER")

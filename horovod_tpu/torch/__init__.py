@@ -413,3 +413,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                         backward_passes_per_step, op,
                         gradient_predivide_factor, process_set)
     return optimizer
+
+
+from .sync_batch_norm import SyncBatchNorm  # noqa: E402,F401
+
+__all__.append("SyncBatchNorm")

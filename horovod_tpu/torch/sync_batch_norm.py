@@ -1,0 +1,136 @@
+"""Synchronized batch normalization for the torch frontend.
+
+Reference parity: ``horovod/torch/sync_batch_norm.py`` (SURVEY.md §2.2)
+— a ``_BatchNorm`` drop-in whose batch statistics are computed across
+every worker: per-rank sums and counts are combined with one engine
+allreduce in the forward pass, and the hand-written backward reduces the
+input-gradient terms the same way, so training with small per-worker
+batches matches large-batch single-worker numerics.
+
+TPU redesign: the cross-worker reduction is the shared engine's
+(negotiated, fused, XLA-executed) allreduce rather than a torch
+process-group op; the module itself stays a regular torch autograd
+Function on CPU tensors.  Supports the full ``_BatchNorm`` surface:
+``affine=False``, ``track_running_stats=False``, ``momentum=None``
+(cumulative moving average).
+"""
+
+from __future__ import annotations
+
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from . import Sum, allreduce
+
+
+def _allreduce_sum(t: torch.Tensor, name: str) -> torch.Tensor:
+    return allreduce(t, op=Sum, name=name)
+
+
+def _affine(y, weight, bias):
+    if weight is not None:
+        y = y * weight[None, :, None]
+    if bias is not None:
+        y = y + bias[None, :, None]
+    return y
+
+
+class _SyncBatchNormFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, x, weight, bias, running_mean, running_var,
+                eps, momentum, training):
+        use_batch_stats = training or running_mean is None
+        if not use_batch_stats:
+            mean, var = running_mean, running_var
+            inv = torch.rsqrt(var + eps)
+        else:
+            C = x.shape[1]
+            red = x.transpose(0, 1).reshape(C, -1)      # [C, B*spatial]
+            local = torch.stack([red.sum(1), (red * red).sum(1),
+                                 torch.full((C,), float(red.shape[1]))])
+            tot = _allreduce_sum(local, "sbn.stats")
+            count = tot[2]
+            mean = tot[0] / count
+            var = tot[1] / count - mean * mean           # biased
+            inv = torch.rsqrt(var + eps)
+            if training and running_mean is not None:
+                n = count[0]
+                unbiased = var * n / (n - 1) if n > 1 else var
+                running_mean.mul_(1 - momentum).add_(momentum * mean)
+                running_var.mul_(1 - momentum).add_(momentum * unbiased)
+
+        ctx.save_for_backward(
+            x, weight if weight is not None else torch.ones(0),
+            mean, inv,
+            count if use_batch_stats else torch.tensor(0.0))
+        # gradients flow through the statistics whenever batch stats were
+        # used (training, or eval without running stats)
+        ctx.use_batch_stats = use_batch_stats
+        ctx.has_weight = weight is not None
+        y = (x - mean[None, :, None]) * inv[None, :, None]
+        return _affine(y, weight, bias)
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        x, weight, mean, inv, count = ctx.saved_tensors
+        C = x.shape[1]
+        xhat = (x - mean[None, :, None]) * inv[None, :, None]
+        g = grad_out
+        scale = inv[None, :, None]
+        if ctx.has_weight:
+            scale = scale * weight[None, :, None]
+        grad_weight = ((g * xhat).transpose(0, 1).reshape(C, -1).sum(1)
+                       if ctx.has_weight else None)
+        grad_bias = g.transpose(0, 1).reshape(C, -1).sum(1)
+        if not ctx.use_batch_stats:
+            return (g * scale, grad_weight, grad_bias, None, None, None,
+                    None, None)
+        # local reductions over batch+spatial, then one cross-worker sum
+        local = torch.stack([
+            g.transpose(0, 1).reshape(C, -1).sum(1),            # Σg
+            (g * xhat).transpose(0, 1).reshape(C, -1).sum(1),   # Σg·x̂
+        ])
+        tot = _allreduce_sum(local, "sbn.grads")
+        sum_g = tot[0] / count
+        sum_gx = tot[1] / count
+        gx = scale * (g - sum_g[None, :, None]
+                      - xhat * sum_gx[None, :, None])
+        return gx, grad_weight, grad_bias, None, None, None, None, None
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in ``nn.BatchNorm*`` with cross-worker statistics
+    (reference: hvd.SyncBatchNorm)."""
+
+    def _check_input_dim(self, x):
+        if x.dim() < 2:
+            raise ValueError(f"expected at least 2D input, got {x.dim()}D")
+
+    def forward(self, x):
+        self._check_input_dim(x)
+        orig_shape = x.shape
+        if x.dim() == 2:
+            x = x[:, :, None]
+        elif x.dim() > 3:
+            x = x.reshape(x.shape[0], x.shape[1], -1)
+
+        # momentum=None: cumulative moving average (torch semantics)
+        momentum = self.momentum
+        if self.training and self.track_running_stats:
+            self.num_batches_tracked.add_(1)
+            if momentum is None:
+                momentum = 1.0 / float(self.num_batches_tracked)
+        elif momentum is None:
+            momentum = 0.0
+
+        from .. import runtime
+        if runtime.size() == 1 and self.training:
+            # one worker: plain batch norm is identical and cheaper
+            out = torch.nn.functional.batch_norm(
+                x, self.running_mean, self.running_var, self.weight,
+                self.bias, True, momentum, self.eps)
+            return out.reshape(orig_shape)
+        out = _SyncBatchNormFn.apply(
+            x, self.weight, self.bias, self.running_mean,
+            self.running_var, self.eps, momentum, self.training)
+        return out.reshape(orig_shape)

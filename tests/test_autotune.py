@@ -1,0 +1,112 @@
+"""Autotuner (parameter_manager.cc analog) + profiler-range tests.
+
+Reference parity: the reference tunes fusion threshold AND cycle time
+with a GP/EI loop through warmup → sample → tuned phases, logging to
+HOROVOD_AUTOTUNE_LOG (SURVEY.md §2.1).  These tests drive the 2-D
+manager directly and through a live engine.
+"""
+
+import glob
+import math
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu.autotune import (_CYCLE_GRID_MS, _GRID_2D,
+                                  ParameterManager)
+from horovod_tpu.config import Config
+
+
+def _cfg(**kw):
+    c = Config()
+    c.autotune = True
+    c.autotune_warmup_samples = kw.pop("warmup", 1)
+    c.autotune_steps_per_sample = kw.pop("steps", 2)
+    c.autotune_max_samples = kw.pop("max_samples", 6)
+    for k, v in kw.items():
+        setattr(c, k, v)
+    return c
+
+
+def _feed(pm, score_fn, n_cycles=400):
+    """Drive record_cycle with a synthetic throughput model until tuned."""
+    for _ in range(n_cycles):
+        if pm.tuned:
+            break
+        thr = pm.current_fusion_threshold()
+        cyc = pm.current_cycle_time_ms()
+        bps = score_fn(thr, cyc)
+        pm.record_cycle(nbytes=int(bps), elapsed_s=1.0)
+    return pm
+
+
+def test_tunes_both_dimensions_and_converges():
+    pm = ParameterManager(_cfg())
+    # synthetic optimum: 64 MiB threshold, 1.0 ms cycle
+    def score(thr, cyc):
+        t = -abs(math.log2(thr) - 26)
+        c = -abs(cyc - 1.0)
+        return 1e9 * math.exp(t + c)
+    _feed(pm, score)
+    assert pm.tuned
+    # converged point must be one of the sampled grid points, and both
+    # dims must have been explored
+    xs = pm._gp.xs
+    assert len({x[0] for x in xs}) > 1 or len({x[1] for x in xs}) > 1
+    assert pm.current_cycle_time_ms() in _CYCLE_GRID_MS
+    assert (math.log2(pm.current_fusion_threshold()),
+            float(_CYCLE_GRID_MS.index(pm.current_cycle_time_ms()))
+            ) in set(xs)
+
+
+def test_converges_at_sample_budget():
+    pm = ParameterManager(_cfg(max_samples=4))
+    _feed(pm, lambda thr, cyc: 1.0)
+    assert pm.tuned
+    assert len(pm._gp.xs) == 4
+
+
+def test_autotune_log_schema(tmp_path):
+    log = str(tmp_path / "autotune.csv")
+    pm = ParameterManager(_cfg(autotune_log=log, max_samples=3))
+    _feed(pm, lambda thr, cyc: thr)
+    pm._log_file.flush()
+    lines = open(log).read().strip().splitlines()
+    assert lines[0] == ("timestamp,fusion_threshold_bytes,cycle_time_ms,"
+                        "score_bytes_per_sec,phase")
+    assert any(line.endswith("tuned") for line in lines[1:])
+    # every row carries a cycle time from the grid
+    for line in lines[1:]:
+        cyc = float(line.split(",")[2])
+        assert cyc in _CYCLE_GRID_MS
+
+
+def test_engine_reads_tuned_cycle_time(hvd):
+    """A live engine re-reads the autotuner's cycle time every loop."""
+    from horovod_tpu import runtime
+    eng = runtime._state().engine
+    pm = ParameterManager(_cfg())
+    old = eng.autotuner
+    eng.autotuner = pm
+    try:
+        pm._current = (pm._current[0], float(_CYCLE_GRID_MS.index(5.0)))
+        assert eng._cycle_time_s() == pytest.approx(0.005)
+        pm._current = (pm._current[0], 0.0)
+        assert eng._cycle_time_s() == 0.0
+    finally:
+        eng.autotuner = old
+
+
+def test_profiler_ranges_capture_dispatch(hvd, tmp_path):
+    """start_profiler/stop_profiler wrap jax.profiler; engine dispatches
+    inside TraceAnnotation ranges land in the trace (NVTX analog)."""
+    logdir = str(tmp_path / "prof")
+    hvd.start_profiler(logdir)
+    hvd.allreduce(np.ones((4,), np.float32), name="prof_t")
+    hvd.stop_profiler()
+    traces = glob.glob(os.path.join(logdir, "**", "*.pb"), recursive=True) \
+        + glob.glob(os.path.join(logdir, "**", "*.json.gz"), recursive=True) \
+        + glob.glob(os.path.join(logdir, "**", "*.trace.json*"),
+                    recursive=True)
+    assert traces, f"no trace files under {logdir}"

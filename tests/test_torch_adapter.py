@@ -211,3 +211,48 @@ def test_torch_two_process_training_matches_single():
         opt.step()
         losses.append(float(loss))
     np.testing.assert_allclose(by_rank[0]["losses"], losses, atol=1e-4)
+
+
+# --- SyncBatchNorm (reference: horovod/torch/sync_batch_norm.py) ------------
+
+def test_sync_batch_norm_matches_global_batch_bn(thvd, n_workers):
+    """Sync BN over the mesh must equal plain BatchNorm over the GLOBAL
+    batch (every virtual chip contributes a replica of the local batch —
+    the reference's small-local/large-global equivalence)."""
+    torch.manual_seed(0)
+    x = torch.randn(6, 4, 5, 5)
+    plain = torch.nn.BatchNorm2d(4, momentum=0.1)
+    sync = thvd.SyncBatchNorm(4, momentum=0.1)
+    sync.load_state_dict(plain.state_dict())
+    sync.train(); plain.train()
+    y_plain = plain(torch.cat([x] * n_workers))[:6]
+    y_sync = sync(x)
+    assert torch.allclose(y_sync, y_plain, atol=1e-5)
+    assert torch.allclose(sync.running_mean, plain.running_mean, atol=1e-5)
+    assert torch.allclose(sync.running_var, plain.running_var, atol=1e-5)
+
+
+def test_sync_batch_norm_grads_match(thvd):
+    torch.manual_seed(1)
+    x1 = torch.randn(4, 3, 6, requires_grad=True)
+    x2 = x1.detach().clone().requires_grad_(True)
+    plain = torch.nn.BatchNorm1d(3)
+    sync = thvd.SyncBatchNorm(3)
+    sync.load_state_dict(plain.state_dict())
+    plain.train(); sync.train()
+    (plain(x1) ** 2).sum().backward()
+    (sync(x2) ** 2).sum().backward()
+    assert torch.allclose(x2.grad, x1.grad, atol=1e-4)
+    assert torch.allclose(sync.weight.grad, plain.weight.grad, atol=1e-4)
+    assert torch.allclose(sync.bias.grad, plain.bias.grad, atol=1e-4)
+
+
+def test_sync_batch_norm_eval_mode(thvd):
+    sync = thvd.SyncBatchNorm(2)
+    sync.running_mean.fill_(1.0)
+    sync.running_var.fill_(4.0)
+    sync.eval()
+    x = torch.ones(2, 2, 3)
+    y = sync(x)
+    want = (1.0 - 1.0) / np.sqrt(4.0 + sync.eps)
+    assert torch.allclose(y, torch.full_like(y, want), atol=1e-6)
