@@ -1,0 +1,17 @@
+"""Estimator tier: sklearn-style distributed fit/predict (L5).
+
+Reference parity: ``horovod/spark/`` estimators (SURVEY.md §2.2, the
+reference's largest Python integration) — the capability class is
+"hand an unfitted model + data to an estimator, get a fitted model
+back, with the distributed training orchestrated for you and artifacts
+in a Store".  Spark itself (DataFrames, Petastorm) is intentionally
+absent: TPU pipelines feed arrays/tf.data, and the launcher tier plays
+the role of Spark's barrier-mode tasks.
+"""
+
+from .keras_estimator import KerasEstimator, KerasModel  # noqa: F401
+from .store import FilesystemStore, LocalStore, Store  # noqa: F401
+from .torch_estimator import TorchEstimator, TorchModel  # noqa: F401
+
+__all__ = ["Store", "LocalStore", "FilesystemStore", "TorchEstimator",
+           "TorchModel", "KerasEstimator", "KerasModel"]

@@ -1,0 +1,111 @@
+"""KerasEstimator: fit/predict orchestration for tf.keras models.
+
+Reference parity: ``horovod/spark/keras/estimator.py`` (SURVEY.md §2.2)
+— sklearn-style fit over ``np`` workers with the Keras callbacks
+(broadcast, metric averaging) installed, checkpointing through the
+Store, returning a fitted wrapper with ``predict``.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from .store import Store
+
+
+def _train_on_worker(model_bytes, compile_kwargs, X, y, epochs,
+                     batch_size, seed):
+    """Runs on every launched worker (cloudpickled)."""
+    import numpy as np
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+    import horovod_tpu.keras as khvd
+
+    rank, nproc = hvd.cross_rank(), hvd.cross_size()
+    tf.keras.utils.set_random_seed(seed + rank)
+    model = tf.keras.models.model_from_json(model_bytes["json"])
+    model.set_weights(model_bytes["weights"])
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.get(dict(compile_kwargs["optimizer"])))
+    model.compile(optimizer=opt, loss=compile_kwargs["loss"],
+                  metrics=compile_kwargs.get("metrics"))
+    hist = model.fit(
+        X[rank::nproc], y[rank::nproc], epochs=epochs,
+        batch_size=batch_size, verbose=0,
+        callbacks=[khvd.BroadcastGlobalVariablesCallback(0),
+                   khvd.MetricAverageCallback()])
+    return {"weights": model.get_weights() if rank == 0 else None,
+            "history": {k: [float(v) for v in vs]
+                        for k, vs in hist.history.items()}}
+
+
+class KerasModel:
+    """Fitted model wrapper (reference: KerasModel transformer)."""
+
+    def __init__(self, model, history, run_id: str):
+        self.model = model
+        self.history = history
+        self.run_id = run_id
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(self.model.predict(X, verbose=0))
+
+    def getModel(self):  # reference naming
+        return self.model
+
+
+class KerasEstimator:
+    """Distributed-training estimator for tf.keras models.
+
+    ``model`` must be json-serializable (Sequential/functional);
+    ``optimizer`` is a keras identifier dict/config (workers rebuild it);
+    ``loss``/``metrics`` as in ``model.compile``.
+    """
+
+    def __init__(self, model, optimizer, loss, metrics=None,
+                 epochs: int = 1, batch_size: int = 32, np: int = 1,
+                 store: Optional[Store] = None,
+                 run_id: Optional[str] = None, seed: int = 0,
+                 env: Optional[dict] = None, port: int = 29610,
+                 verbose: int = 0):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.metrics = metrics
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.num_proc = np
+        self.store = store
+        self.run_id = run_id or f"keras-{uuid.uuid4().hex[:8]}"
+        self.seed = seed
+        self.env = env
+        self.port = port
+        self.verbose = verbose
+
+    def fit(self, X: np.ndarray, y: np.ndarray) -> KerasModel:
+        import tensorflow as tf
+        from ..runner import run
+
+        opt_cfg = tf.keras.optimizers.serialize(
+            tf.keras.optimizers.get(self.optimizer))
+        payload = {"json": self.model.to_json(),
+                   "weights": self.model.get_weights()}
+        results = run(
+            _train_on_worker,
+            args=(payload, {"optimizer": opt_cfg, "loss": self.loss,
+                            "metrics": self.metrics},
+                  np.asarray(X), np.asarray(y), self.epochs,
+                  self.batch_size, self.seed),
+            np=self.num_proc, env=self.env, port=self.port,
+            verbose=bool(self.verbose))
+        fitted = tf.keras.models.model_from_json(payload["json"])
+        fitted.set_weights(results[0]["weights"])
+        history = results[0]["history"]
+        if self.store is not None:
+            self.store.save_checkpoint(
+                self.run_id, {"weights": results[0]["weights"],
+                              "history": history})
+        return KerasModel(fitted, history, self.run_id)

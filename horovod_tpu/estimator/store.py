@@ -1,0 +1,86 @@
+"""Artifact store for estimator training runs.
+
+Reference parity: ``horovod/spark/common/store.py`` (SURVEY.md §2.2) —
+the reference's ``Store`` abstracts where intermediate training data,
+checkpoints, and logs live (HDFS/S3/local) for its Spark estimators.
+The TPU-native tier keeps the same surface with a filesystem backend
+(cloud buckets mount as filesystems on TPU VMs via gcsfuse, so one
+backend covers the reference's remote cases too).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Optional
+
+
+class Store:
+    """Where estimator runs keep checkpoints and logs."""
+
+    def checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def logs_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def save_checkpoint(self, run_id: str, obj: Any):
+        raise NotImplementedError
+
+    def load_checkpoint(self, run_id: str) -> Any:
+        raise NotImplementedError
+
+    def exists(self, run_id: str) -> bool:
+        raise NotImplementedError
+
+    @staticmethod
+    def create(prefix_path: Optional[str] = None) -> "Store":
+        """Reference: Store.create dispatches on the path scheme; every
+        TPU-VM-reachable path is a filesystem path here."""
+        return FilesystemStore(prefix_path)
+
+
+class FilesystemStore(Store):
+    """Filesystem-backed store (reference: LocalStore/FilesystemStore)."""
+
+    def __init__(self, prefix_path: Optional[str] = None):
+        self._own = prefix_path is None
+        self.prefix_path = (prefix_path if prefix_path is not None
+                            else tempfile.mkdtemp(prefix="hvd_store_"))
+        os.makedirs(self.prefix_path, exist_ok=True)
+
+    def _run_dir(self, run_id: str) -> str:
+        d = os.path.join(self.prefix_path, run_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self._run_dir(run_id), "checkpoint.pkl")
+
+    def logs_path(self, run_id: str) -> str:
+        d = os.path.join(self._run_dir(run_id), "logs")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def save_checkpoint(self, run_id: str, obj: Any):
+        path = self.checkpoint_path(run_id)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(obj, f)
+        os.replace(tmp, path)
+
+    def load_checkpoint(self, run_id: str) -> Any:
+        with open(self.checkpoint_path(run_id), "rb") as f:
+            return pickle.load(f)
+
+    def exists(self, run_id: str) -> bool:
+        return os.path.exists(self.checkpoint_path(run_id))
+
+    def cleanup(self):
+        if self._own:
+            shutil.rmtree(self.prefix_path, ignore_errors=True)
+
+
+LocalStore = FilesystemStore  # reference alias

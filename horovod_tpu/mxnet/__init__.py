@@ -1,0 +1,19 @@
+"""MXNet adapter stub (reference: ``horovod/mxnet/``, SURVEY.md §2.2).
+
+MXNet is end-of-life (retired by Apache in 2023) and is not installed in
+TPU images; the reference listed it as its lowest-priority binding.  The
+module exists so ``import horovod_tpu.mxnet`` fails with an actionable
+message rather than a bare ModuleNotFoundError, matching the reference's
+graceful extension probing (``check_extension`` in horovod/mxnet's
+__init__).  The torch and tensorflow adapters cover the same capability
+surface (see their modules).
+"""
+
+try:
+    import mxnet  # noqa: F401
+except ImportError as e:  # pragma: no cover - mxnet never present on TPU
+    raise ImportError(
+        "horovod_tpu.mxnet requires the mxnet package, which is not "
+        "installed (MXNet is retired and unavailable on TPU images). "
+        "Use horovod_tpu.torch or horovod_tpu.tensorflow instead — both "
+        "cover the full binding surface.") from e
