@@ -64,10 +64,14 @@ def main():
     X = torch.from_numpy(images[rank::nproc]).permute(0, 3, 1, 2)
     y = torch.from_numpy(labels[rank::nproc]).long()
 
+    # a shard smaller than the batch size still trains on what it has
+    # (and every process must reach the loss allreduce below)
+    batch = max(1, min(args.batch_size, len(X)))
     for epoch in range(args.epochs):
         perm = torch.randperm(len(X))
-        for i in range(0, len(X) - args.batch_size + 1, args.batch_size):
-            idx = perm[i:i + args.batch_size]
+        loss = torch.tensor(0.0)
+        for i in range(0, len(X) - batch + 1, batch):
+            idx = perm[i:i + batch]
             opt.zero_grad()
             loss = F.cross_entropy(model(X[idx]), y[idx])
             loss.backward()

@@ -65,10 +65,11 @@ class _GP:
         v = 1.0 + self.noise - np.sum(Ks * np.linalg.solve(K, Ks.T).T, axis=1)
         return mu, np.sqrt(np.maximum(v, 1e-12))
 
-    def suggest(self) -> Tuple[float, float]:
-        unseen = [p for p in _GRID_2D if p not in set(self.xs)]
+    def suggest(self, grid=None) -> Tuple[float, float]:
+        grid = grid if grid is not None else _GRID_2D
+        unseen = [p for p in grid if p not in set(self.xs)]
         if not unseen:
-            return _GRID_2D[0]
+            return grid[0]
         if not self.xs:
             return unseen[len(unseen) // 2]
         mu, sd = self.posterior(unseen)
@@ -86,15 +87,14 @@ def _npdf(z):
     return np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
 
 
-def _nearest_cycle_index(ms: float) -> int:
-    return int(np.argmin([abs(c - ms) for c in _CYCLE_GRID_MS]))
-
-
 class ParameterManager:
     """Warmup → sample → tuned lifecycle, scoring by bytes/sec throughput.
 
     Tunes (fusion threshold, cycle time) jointly — reference:
-    ParameterManager's joint tunable set.
+    ParameterManager's joint tunable set.  The configured cycle time is
+    added to the candidate grid and is the starting point, so enabling
+    autotune never silently changes the user's setting before the tuner
+    actually moves it.
     """
 
     def __init__(self, cfg):
@@ -103,8 +103,13 @@ class ParameterManager:
         self.steps_per_sample = cfg.autotune_steps_per_sample
         self.max_samples = getattr(cfg, "autotune_max_samples", 20)
         self._gp = _GP()
+        self._cycle_grid = sorted(set(_CYCLE_GRID_MS)
+                                  | {float(cfg.cycle_time_ms)})
+        self._grid_2d = [(t, float(ci)) for t in _THRESH_GRID
+                         for ci in range(len(self._cycle_grid))]
         self._current = (math.log2(cfg.fusion_threshold_bytes),
-                         float(_nearest_cycle_index(cfg.cycle_time_ms)))
+                         float(self._cycle_grid.index(
+                             float(cfg.cycle_time_ms))))
         self._sample_bytes = 0
         self._sample_time = 0.0
         self._sample_steps = 0
@@ -121,7 +126,7 @@ class ParameterManager:
         return int(2 ** self._current[0])
 
     def current_cycle_time_ms(self) -> float:
-        return _CYCLE_GRID_MS[int(self._current[1])]
+        return self._cycle_grid[int(self._current[1])]
 
     @property
     def tuned(self) -> bool:
@@ -148,7 +153,7 @@ class ParameterManager:
             if self._best is None or score > self._best[1]:
                 self._best = (self._current, score)
             if (len(self._gp.xs) >= self.max_samples
-                    or len(self._gp.xs) >= len(_GRID_2D)):
+                    or len(self._gp.xs) >= len(self._grid_2d)):
                 # converge: lock in the best observed point
                 self._current = self._best[0]
                 self._tuned = True
@@ -160,7 +165,7 @@ class ParameterManager:
                     self.current_fusion_threshold() / _MIB,
                     self.current_cycle_time_ms(), self._best[1])
             else:
-                self._current = self._gp.suggest()
+                self._current = self._gp.suggest(self._grid_2d)
         if self._log_file:
             self._log_file.write(
                 f"{time.time():.3f},{measured_thr},"

@@ -155,6 +155,7 @@ class CollectiveEngine:
         self._name_counter = 0
         self._bytes_reduced = 0
         self._cycle_active = False
+        self._cycle_started: Optional[float] = None
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
@@ -247,7 +248,10 @@ class CollectiveEngine:
                 if self._stop:
                     return
             # let the cycle window fill (reference: HOROVOD_CYCLE_TIME);
-            # re-read each cycle — the autotuner may move it
+            # re-read each cycle — the autotuner may move it.  The cycle
+            # clock starts BEFORE the window so the autotuner's bytes/sec
+            # score pays for the sleep it is tuning.
+            self._cycle_started = time.monotonic()
             cycle_s = self._cycle_time_s()
             if cycle_s > 0:
                 time.sleep(cycle_s)
@@ -457,7 +461,11 @@ class CollectiveEngine:
             plan = self._plan_fn(sigs, threshold)
             self._cache.put(sigs, plan)
 
-        t0 = time.monotonic()
+        # autotune scoring clock: from cycle start (includes the batching
+        # window being tuned) when the background loop set it
+        t0, self._cycle_started = (
+            self._cycle_started if self._cycle_started is not None
+            else time.monotonic()), None
         results: dict = {}
         failed: Optional[BaseException] = None
         for bucket in plan:

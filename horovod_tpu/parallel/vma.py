@@ -26,7 +26,7 @@ def as_varying(tree, axis_name, like=None):
         return tree
     if like is not None:
         try:
-            if axis_name not in jax.core.get_aval(like).vma:
+            if axis_name not in jax.typeof(like).vma:
                 return tree  # VMA tracking off in this context
         except AttributeError:  # pragma: no cover - aval without .vma
             return tree
@@ -34,7 +34,7 @@ def as_varying(tree, axis_name, like=None):
 
     def cast(x):
         try:
-            if axis_name in jax.core.get_aval(x).vma:
+            if axis_name in jax.typeof(x).vma:
                 return x  # already varying over this axis
         except AttributeError:
             pass

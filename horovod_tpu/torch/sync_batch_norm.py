@@ -45,9 +45,12 @@ class _SyncBatchNormFn(torch.autograd.Function):
             inv = torch.rsqrt(var + eps)
         else:
             C = x.shape[1]
-            red = x.transpose(0, 1).reshape(C, -1)      # [C, B*spatial]
+            # fp32 statistics regardless of activation dtype, and the
+            # count row inherits red's dtype/device (new_full)
+            red = x.transpose(0, 1).reshape(C, -1).to(torch.float32)
             local = torch.stack([red.sum(1), (red * red).sum(1),
-                                 torch.full((C,), float(red.shape[1]))])
+                                 red.new_full((C,),
+                                              float(red.shape[1]))])
             tot = _allreduce_sum(local, "sbn.stats")
             count = tot[2]
             mean = tot[0] / count
@@ -67,8 +70,9 @@ class _SyncBatchNormFn(torch.autograd.Function):
         # used (training, or eval without running stats)
         ctx.use_batch_stats = use_batch_stats
         ctx.has_weight = weight is not None
+        ctx.has_bias = bias is not None
         y = (x - mean[None, :, None]) * inv[None, :, None]
-        return _affine(y, weight, bias)
+        return _affine(y, weight, bias).to(x.dtype)
 
     @staticmethod
     def backward(ctx, grad_out):
@@ -80,11 +84,12 @@ class _SyncBatchNormFn(torch.autograd.Function):
         if ctx.has_weight:
             scale = scale * weight[None, :, None]
         grad_weight = ((g * xhat).transpose(0, 1).reshape(C, -1).sum(1)
-                       if ctx.has_weight else None)
-        grad_bias = g.transpose(0, 1).reshape(C, -1).sum(1)
+                       .to(weight.dtype) if ctx.has_weight else None)
+        grad_bias = (g.transpose(0, 1).reshape(C, -1).sum(1)
+                     .to(weight.dtype) if ctx.has_bias else None)
         if not ctx.use_batch_stats:
-            return (g * scale, grad_weight, grad_bias, None, None, None,
-                    None, None)
+            return ((g * scale).to(x.dtype), grad_weight, grad_bias, None,
+                    None, None, None, None)
         # local reductions over batch+spatial, then one cross-worker sum
         local = torch.stack([
             g.transpose(0, 1).reshape(C, -1).sum(1),            # Σg
@@ -95,7 +100,8 @@ class _SyncBatchNormFn(torch.autograd.Function):
         sum_gx = tot[1] / count
         gx = scale * (g - sum_g[None, :, None]
                       - xhat * sum_gx[None, :, None])
-        return gx, grad_weight, grad_bias, None, None, None, None, None
+        return (gx.to(x.dtype), grad_weight, grad_bias, None, None, None,
+                None, None)
 
 
 class SyncBatchNorm(_BatchNorm):

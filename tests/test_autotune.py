@@ -110,3 +110,11 @@ def test_profiler_ranges_capture_dispatch(hvd, tmp_path):
         + glob.glob(os.path.join(logdir, "**", "*.trace.json*"),
                     recursive=True)
     assert traces, f"no trace files under {logdir}"
+
+
+def test_configured_cycle_time_honored_before_tuning():
+    """Enabling autotune must not snap the configured cycle time to the
+    default grid (review regression): 0.2 ms stays 0.2 ms at start."""
+    pm = ParameterManager(_cfg(cycle_time_ms=0.2))
+    assert pm.current_cycle_time_ms() == pytest.approx(0.2)
+    assert 0.2 in pm._cycle_grid

@@ -256,3 +256,16 @@ def test_sync_batch_norm_eval_mode(thvd):
     y = sync(x)
     want = (1.0 - 1.0) / np.sqrt(4.0 + sync.eps)
     assert torch.allclose(y, torch.full_like(y, want), atol=1e-6)
+
+
+def test_sync_batch_norm_affine_false_and_fp16(thvd):
+    sbn = thvd.SyncBatchNorm(3, affine=False)
+    sbn.train()
+    x = torch.randn(4, 3, 5, requires_grad=True)
+    y = sbn(x)
+    y.sum().backward()
+    assert x.grad is not None
+    # fp16 input keeps its dtype through the drop-in contract
+    sbn16 = thvd.SyncBatchNorm(2)
+    x16 = torch.randn(4, 2, 3).half()
+    assert sbn16(x16).dtype == torch.float16
