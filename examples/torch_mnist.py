@@ -64,13 +64,17 @@ def main():
     X = torch.from_numpy(images[rank::nproc]).permute(0, 3, 1, 2)
     y = torch.from_numpy(labels[rank::nproc]).long()
 
-    # a shard smaller than the batch size still trains on what it has
-    # (and every process must reach the loss allreduce below)
+    # every process must run the SAME number of optimizer steps (each
+    # fires gradient allreduces): agree on the minimum across shards
     batch = max(1, min(args.batch_size, len(X)))
+    local_steps = max(len(X) // batch, 1)
+    steps = int(hvd.allreduce(torch.tensor(float(local_steps)),
+                              op=hvd.Min, name="steps"))
     for epoch in range(args.epochs):
         perm = torch.randperm(len(X))
         loss = torch.tensor(0.0)
-        for i in range(0, len(X) - batch + 1, batch):
+        for s in range(steps):
+            i = (s * batch) % max(len(X) - batch + 1, 1)
             idx = perm[i:i + batch]
             opt.zero_grad()
             loss = F.cross_entropy(model(X[idx]), y[idx])

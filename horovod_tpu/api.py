@@ -270,14 +270,33 @@ def wait(handle: Handle, timeout: Optional[float] = None) -> bool:
 def barrier(process_set=None):
     """Block until every participant reaches the barrier.
 
-    Reference: hvd.barrier (BarrierOp).  Within a process collectives are
-    ordered by the engine; across processes the coordination-service
-    barrier is used.
+    Reference: hvd.barrier (BarrierOp).  Scoped to the process set: a
+    barrier entry goes through the engine, and the negotiation round
+    only completes when every member process has announced it — the
+    member-scoped rendezvous (reference: per-process-set BarrierOp).
+    Without the controller (single process, or disabled) the set is
+    process-local / the coordination-service barrier covers the world.
     """
-    _require_init()
-    if runtime.cross_size() > 1:
-        from .utils import multihost_barrier
-        multihost_barrier("hvd_barrier")
+    st = _require_init()
+    ps = _ps(process_set)
+    if not collectives.spans_processes(ps):
+        return  # all members in-process: engine ordering is the barrier
+    eng = st.engine
+    if eng is not None and eng._controller is not None \
+            and eng._controller.enabled:
+        entry = TensorTableEntry(
+            name=eng.auto_name("barrier"), op_type="barrier",
+            arrays=[jnp.zeros((1,), jnp.float32)], process_set=ps)
+        eng.submit(entry).synchronize()
+        return
+    if ps is not runtime._get_global_process_set():
+        # the coordination-service barrier is world-scoped; a subset
+        # barrier without the controller would strand the members
+        raise ValueError(
+            "barrier over a subset process set requires the cross-process "
+            "controller (HOROVOD_TPU_CONTROLLER=1)")
+    from .utils import multihost_barrier
+    multihost_barrier("hvd_barrier")
 
 
 def join(device: int = -1) -> int:

@@ -1,0 +1,56 @@
+"""Durable checkpointing helpers (orbax-backed).
+
+Reference parity: the reference owns NO checkpoint format — its
+convention is "rank 0 writes framework-native checkpoints" plus the
+elastic in-memory ``State`` (SURVEY.md §5.4).  This module keeps that
+posture: a thin rank-0-gated wrapper over orbax for pytrees, so user
+scripts keep the familiar ``if hvd.rank() == 0: save`` idiom without
+hand-rolling the orbax incantations, and the elastic ``State`` stays the
+recovery path (restore-from-memory, not disk).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from . import runtime
+
+
+def save(path: str, tree: Any, step: Optional[int] = None,
+         force: bool = False):
+    """Write ``tree`` durably at ``path`` (rank 0 only; other workers
+    no-op and return immediately, matching the reference idiom)."""
+    if runtime.rank() != 0:
+        return
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    if step is not None:
+        path = os.path.join(path, str(step))
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, tree, force=force)
+    ckptr.wait_until_finished()
+
+
+def restore(path: str, like: Any, step: Optional[int] = None) -> Any:
+    """Load the tree saved at ``path``; every worker restores (reads are
+    parallel-safe).  ``like`` is an abstract/concrete exemplar pytree."""
+    import jax
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    if step is not None:
+        path = os.path.join(path, str(step))
+    ckptr = ocp.StandardCheckpointer()
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+        if hasattr(x, "shape") else x, like)
+    return ckptr.restore(path, abstract)
+
+
+def latest_step(path: str) -> Optional[int]:
+    """Largest integer subdirectory of ``path`` (step-numbered saves)."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        return None
+    steps = [int(d) for d in os.listdir(path) if d.isdigit()]
+    return max(steps) if steps else None

@@ -29,7 +29,7 @@ import torch
 from .. import api as _api
 from .. import runtime as _runtime
 from ..compression import Compression
-from ..runtime import (Adasum, Average, ReduceOp, Sum,  # noqa: F401
+from ..runtime import (Adasum, Average, Max, Min, ReduceOp, Sum,  # noqa: F401,E501
                        init, is_initialized, shutdown, rank, size,
                        local_rank, local_size, cross_rank, cross_size,
                        mpi_threads_supported, mpi_built, mpi_enabled,
@@ -41,9 +41,11 @@ from ..exceptions import HorovodInternalError  # noqa: F401
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "Average", "Sum", "Adasum",
+    "Min", "Max",
     "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
     "grouped_allreduce", "grouped_allreduce_async", "allgather",
-    "allgather_async", "broadcast", "broadcast_async", "broadcast_",
+    "allgather_async", "grouped_allgather", "reducescatter",
+    "broadcast", "broadcast_async", "broadcast_",
     "broadcast_async_", "alltoall", "alltoall_async", "synchronize",
     "poll", "join", "barrier", "broadcast_object", "broadcast_parameters",
     "broadcast_optimizer_state", "DistributedOptimizer", "Compression",
@@ -147,6 +149,33 @@ def grouped_allreduce(tensors: Sequence[torch.Tensor], average=None,
     return grouped_allreduce_async(
         tensors, average, name, op, prescale_factor, postscale_factor,
         process_set).synchronize()
+
+
+def grouped_allgather(tensors: Sequence[torch.Tensor], name=None,
+                      process_set=None):
+    """Reference: hvd.grouped_allgather — one fused atomic dispatch."""
+    outs = _api.grouped_allgather([_to_np(t) for t in tensors],
+                                  name=name, process_set=process_set)
+    return [torch.from_numpy(np.array(np.asarray(o), copy=True))
+            .to(t.dtype) for o, t in zip(outs, tensors)]
+
+
+def reducescatter(tensor: torch.Tensor, op=None, name=None,
+                  process_set=None) -> torch.Tensor:
+    """Reference: hvd.reducescatter — reduce then keep this worker's
+    slice of dim 0."""
+    ps = _api._ps(process_set)
+    res = _api.reducescatter(_to_np(tensor), op=op, name=name,
+                             process_set=process_set)
+    a = np.asarray(res)
+    if a.ndim == tensor.dim() + 1:  # stacked per-worker result
+        idx = ps.rank()  # this worker's index WITHIN the set
+        if idx < 0:
+            raise ValueError(
+                "reducescatter called from a worker outside the process "
+                "set")
+        a = a[idx]
+    return torch.from_numpy(np.array(a, copy=True)).to(tensor.dtype)
 
 
 def allgather_async(tensor: torch.Tensor, name=None,

@@ -59,8 +59,12 @@ class _SyncBatchNormFn(torch.autograd.Function):
             if training and running_mean is not None:
                 n = count[0]
                 unbiased = var * n / (n - 1) if n > 1 else var
-                running_mean.mul_(1 - momentum).add_(momentum * mean)
-                running_var.mul_(1 - momentum).add_(momentum * unbiased)
+                # stats are fp32; running buffers keep their own dtype
+                # (half() modules have fp16 buffers)
+                running_mean.mul_(1 - momentum).add_(
+                    (momentum * mean).to(running_mean.dtype))
+                running_var.mul_(1 - momentum).add_(
+                    (momentum * unbiased).to(running_var.dtype))
 
         ctx.save_for_backward(
             x, weight if weight is not None else torch.ones(0),

@@ -221,6 +221,23 @@ def tf_training_fn():
     return {"rank": r, "w": w.numpy().tolist()}
 
 
+def barrier_fn():
+    """Cross-process barrier through the engine (negotiated rendezvous):
+    a late process must hold the early one at the barrier."""
+    import time
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    if r == 1:
+        time.sleep(1.0)
+    t0 = time.monotonic()
+    hvd.barrier()
+    waited = time.monotonic() - t0
+    out = hvd.allreduce(np.float32(r), op=hvd.Sum, name="post_barrier")
+    return {"rank": r, "waited": waited, "sum": float(np.asarray(out))}
+
+
 def join_uneven_fn():
     """Uneven batch counts (reference: hvd.join / JoinOp).  Process 0 runs
     3 batches, process 1 runs 2; joined processes co-execute the peer's

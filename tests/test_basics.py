@@ -78,3 +78,18 @@ def test_not_initialized_error():
 def test_worker_values_shape(hvd):
     x = hvd.worker_values(lambda r: np.full((3,), float(r)))
     assert x.shape == (8, 3)
+
+
+def test_checkpoint_save_restore_roundtrip(hvd, tmp_path):
+    """Durable orbax checkpoint helper (SURVEY 5.4 posture: rank-0 write,
+    parallel restore, elastic State stays the in-memory recovery path)."""
+    import jax.numpy as jnp
+    from horovod_tpu import checkpoint
+    tree = {"w": jnp.arange(6.0).reshape(2, 3), "step": jnp.int32(7)}
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, tree, step=100)
+    assert checkpoint.latest_step(path) == 100
+    restored = checkpoint.restore(path, tree, step=100)
+    np.testing.assert_allclose(np.asarray(restored["w"]),
+                               np.asarray(tree["w"]))
+    assert int(restored["step"]) == 7

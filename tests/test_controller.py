@@ -155,3 +155,13 @@ def test_response_cache_hits_on_auto_named_tensors(hvd):
 
 def test_single_process_join_returns_size_minus_one(hvd):
     assert hvd.join() == hvd.size() - 1
+
+
+def test_barrier_holds_early_process():
+    """The engine barrier is a real member rendezvous: the on-time process
+    waits ~the straggler's delay before proceeding."""
+    results = run(helpers_runner.barrier_fn, np=2, env=_env(), port=29541)
+    by_rank = {r["rank"]: r for r in results}
+    assert by_rank[0]["waited"] > 0.5   # held for the late process
+    assert by_rank[1]["waited"] < 0.5   # straggler passes straight through
+    assert all(r["sum"] == 1.0 for r in results)

@@ -213,3 +213,28 @@ def test_dedicated_ep_axis():
     pm2 = ParallelMesh(MeshConfig(dp=4, tp=2))
     assert pm2.ep_axis == "dp" and "ep" not in pm2.mesh.axis_names
     assert pm2.axis_size("ep") == 4
+
+
+def test_ring_attention_memory_scales_linearly(sp_mesh):
+    """VERDICT r2 #7 done-criterion: per-step ring tiles are blockwise,
+    so compiled temp memory grows ~linearly in sequence length (the old
+    monolithic [B,H,Tl,Tl] tile grew quadratically once Tl exceeded the
+    block size)."""
+    def f(q, k, v):
+        return ring_attention(q, k, v, "sp", causal=True)
+
+    def temp_bytes(T):
+        q = jnp.zeros((1, T, 4, 32), jnp.float32)
+        c = jax.jit(jax.shard_map(
+            f, mesh=sp_mesh, in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"), check_vma=False)
+        ).lower(q, q, q).compile()
+        ma = c.memory_analysis()
+        if ma is None:  # backend without memory analysis: nothing to check
+            pytest.skip("no memory analysis on this backend")
+        return ma.temp_size_in_bytes
+
+    # 4x the sequence (per-shard 512 -> 2048, both past the 512 block
+    # cap) must cost ~4x temp memory, not ~16x
+    ratio = temp_bytes(16384) / temp_bytes(4096)
+    assert ratio < 6.0, ratio

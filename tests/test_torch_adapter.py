@@ -269,3 +269,16 @@ def test_sync_batch_norm_affine_false_and_fp16(thvd):
     sbn16 = thvd.SyncBatchNorm(2)
     x16 = torch.randn(4, 2, 3).half()
     assert sbn16(x16).dtype == torch.float16
+
+
+def test_grouped_allgather_and_reducescatter(thvd, n_workers):
+    ts = [torch.ones(2) * (i + 1) for i in range(2)]
+    outs = thvd.grouped_allgather(ts, name="gag")
+    for i, o in enumerate(outs):
+        assert o.shape == (2 * n_workers,)
+        assert torch.allclose(o, torch.ones(2 * n_workers) * (i + 1))
+    t = torch.arange(float(n_workers * 2))
+    out = thvd.reducescatter(t, op=thvd.Sum, name="rs")
+    # replicated input: reduction is x * n, this worker keeps slice 0
+    assert out.shape == (2,)
+    assert torch.allclose(out, t[:2] * n_workers)

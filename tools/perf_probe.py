@@ -1,0 +1,110 @@
+"""Perf probe: step timing + device trace for the flagship bench config.
+
+Usage (real chip; keep /root/.axon_site on PYTHONPATH):
+
+    python tools/perf_probe.py [--trace /tmp/hvd_trace] [--steps 10]
+        [--flash-block 512] [--no-flash]
+
+Runs the same ~1B llama training step as bench.py, prints per-step wall
+time and MFU, and (with --trace) captures a Perfetto trace through
+``hvd.start_profiler`` for kernel-level attribution (view in
+ui.perfetto.dev or tensorboard).
+"""
+
+import argparse
+import dataclasses
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--trace", default=None)
+    p.add_argument("--flash-block", type=int, default=None,
+                   help="override flash kernel block size (bq=bk)")
+    p.add_argument("--no-flash", action="store_true")
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--remat", default="full", choices=["full", "dots"])
+    args = p.parse_args()
+
+    if args.no_flash:
+        os.environ["HOROVOD_FLASH_ATTENTION"] = "0"
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu import training
+    from horovod_tpu.models import llama
+    from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
+    from bench import PEAK_TFLOPS, detect_peak
+
+    if args.flash_block:
+        from horovod_tpu.ops import flash_attention as fa
+        blk = args.flash_block
+
+        def _block_sizes(t_q, t_kv, _b=blk):
+            return min(_b, t_q), min(_b, t_kv)
+        fa._block_sizes = _block_sizes
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32768, d_model=2048, n_layers=16, n_heads=16,
+        n_kv_heads=8, d_ff=8192, max_seq_len=args.seq, remat=True,
+        remat_policy=args.remat)
+    n_chips = jax.local_device_count()
+    pmesh = ParallelMesh(MeshConfig(dp=n_chips, pp=1, sp=1, tp=1))
+    opt = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
+    ts = training.make_llama_train_step(cfg, pmesh, optimizer=opt)
+    params, opt_state = ts.init_fn(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    sh = training.make_data_sharding(ts)
+    toks = jax.device_put(jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (args.batch * n_chips, args.seq)),
+        jnp.int32), sh)
+    tgts = jax.device_put(jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (args.batch * n_chips, args.seq)),
+        jnp.int32), sh)
+
+    t0 = time.perf_counter()
+    params, opt_state, loss = ts.step_fn(params, opt_state, toks, tgts)
+    float(loss)
+    print(f"compile+first step: {time.perf_counter() - t0:.1f}s")
+
+    if args.trace:
+        import horovod_tpu as hvd
+        hvd.init()
+        hvd.start_profiler(args.trace)
+
+    times = []
+    for _ in range(args.steps):
+        t0 = time.perf_counter()
+        params, opt_state, loss = ts.step_fn(params, opt_state, toks,
+                                             tgts)
+        float(loss)
+        times.append(time.perf_counter() - t0)
+
+    if args.trace:
+        import horovod_tpu as hvd
+        hvd.stop_profiler()
+        print(f"trace written to {args.trace}")
+
+    times = np.asarray(times)
+    tok = args.batch * n_chips * args.seq
+    tps = tok / times.mean() / n_chips
+    mfu = tps * 6 * llama.count_params(cfg) / (detect_peak() * 1e12)
+    print(f"step: mean {times.mean()*1e3:.1f} ms  "
+          f"min {times.min()*1e3:.1f} ms  "
+          f"p90 {np.percentile(times, 90)*1e3:.1f} ms")
+    print(f"{tps:.0f} tokens/s/chip  MFU {mfu:.3f}  "
+          f"vs_baseline {mfu/0.40:.3f}")
+
+
+if __name__ == "__main__":
+    main()
