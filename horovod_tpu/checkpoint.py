@@ -19,17 +19,22 @@ from . import runtime
 
 def save(path: str, tree: Any, step: Optional[int] = None,
          force: bool = False):
-    """Write ``tree`` durably at ``path`` (rank 0 only; other workers
-    no-op and return immediately, matching the reference idiom)."""
-    if runtime.rank() != 0:
-        return
-    import orbax.checkpoint as ocp
-    path = os.path.abspath(path)
-    if step is not None:
-        path = os.path.join(path, str(step))
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, tree, force=force)
-    ckptr.wait_until_finished()
+    """Write ``tree`` durably at ``path``.
+
+    Rank 0 writes (the reference idiom); every rank then meets at a
+    barrier so the save-then-restore / save-then-latest_step sequence on
+    other workers never races rank 0's in-flight write.
+    """
+    if runtime.rank() == 0:
+        import orbax.checkpoint as ocp
+        abs_path = os.path.abspath(path)
+        if step is not None:
+            abs_path = os.path.join(abs_path, str(step))
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(abs_path, tree, force=force)
+        ckptr.wait_until_finished()
+    from . import api
+    api.barrier()
 
 
 def restore(path: str, like: Any, step: Optional[int] = None) -> Any:

@@ -271,6 +271,127 @@ PyObject *py_plan_fusion_sigs(PyObject *, PyObject *args) {
 }
 
 // ---------------------------------------------------------------------------
+// Negotiation decision (horovod/common/controller.cc ComputeResponseList's
+// readiness intersection, on canonical token strings).  Divergence analysis
+// and caching stay in the Python controller; this is the per-round
+// O(procs x tokens) multiset arithmetic.
+// ---------------------------------------------------------------------------
+
+// negotiate_decide(full: dict[int, list[str]], active: list[int])
+//   -> (counts: dict[str, int], lagging: dict[str, list[int]],
+//       deferred: int)
+PyObject *py_negotiate_decide(PyObject *, PyObject *args) {
+  PyObject *full_obj, *active_obj;
+  if (!PyArg_ParseTuple(args, "OO", &full_obj, &active_obj)) return nullptr;
+  if (!PyDict_Check(full_obj)) {
+    PyErr_SetString(PyExc_TypeError, "full must be a dict");
+    return nullptr;
+  }
+  std::vector<long long> active;
+  {
+    PyObject *seq = PySequence_Fast(active_obj, "active must be a sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      active.push_back(
+          PyLong_AsLongLong(PySequence_Fast_GET_ITEM(seq, i)));
+    }
+    Py_DECREF(seq);
+    if (PyErr_Occurred()) return nullptr;
+  }
+  // per-proc multiset counts over ALL procs in `full` (deferred counts
+  // span every announcer, dispatch counts span only the active)
+  std::unordered_map<long long,
+                     std::unordered_map<std::string, long long>>
+      counters;
+  std::vector<std::string> order;  // first-seen order; sorted later
+  std::unordered_map<std::string, bool> seen;
+  PyObject *key, *val;
+  Py_ssize_t pos = 0;
+  while (PyDict_Next(full_obj, &pos, &key, &val)) {
+    long long proc = PyLong_AsLongLong(key);
+    if (PyErr_Occurred()) return nullptr;
+    PyObject *seq = PySequence_Fast(val, "token lists must be sequences");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    auto &cnt = counters[proc];
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *t = PySequence_Fast_GET_ITEM(seq, i);
+      Py_ssize_t len = 0;
+      const char *s = PyUnicode_AsUTF8AndSize(t, &len);
+      if (!s) {
+        Py_DECREF(seq);
+        return nullptr;
+      }
+      std::string tok(s, static_cast<size_t>(len));
+      cnt[tok] += 1;
+      if (!seen[tok]) {
+        seen[tok] = true;
+        order.push_back(tok);
+      }
+    }
+    Py_DECREF(seq);
+  }
+  std::sort(order.begin(), order.end());
+
+  PyObject *counts = PyDict_New();
+  PyObject *lagging = PyDict_New();
+  long long deferred = 0;
+  if (!counts || !lagging) {
+    Py_XDECREF(counts);
+    Py_XDECREF(lagging);
+    return nullptr;
+  }
+  for (const std::string &tok : order) {
+    long long k = -1, peak = 0, announce_peak = 0;
+    for (long long p : active) {
+      auto it = counters.find(p);
+      long long c = 0;
+      if (it != counters.end()) {
+        auto jt = it->second.find(tok);
+        if (jt != it->second.end()) c = jt->second;
+      }
+      k = (k < 0) ? c : std::min(k, c);
+      peak = std::max(peak, c);
+    }
+    for (auto &pc : counters) {
+      auto jt = pc.second.find(tok);
+      if (jt != pc.second.end())
+        announce_peak = std::max(announce_peak, jt->second);
+    }
+    if (k < 0) k = 0;
+    deferred += announce_peak - k;
+    PyObject *tk = PyUnicode_FromStringAndSize(
+        tok.data(), static_cast<Py_ssize_t>(tok.size()));
+    if (k > 0) {
+      PyObject *kv = PyLong_FromLongLong(k);
+      PyDict_SetItem(counts, tk, kv);
+      Py_DECREF(kv);
+    }
+    if (peak > k) {
+      PyObject *lag = PyList_New(0);
+      for (long long p : active) {
+        long long c = 0;
+        auto it = counters.find(p);
+        if (it != counters.end()) {
+          auto jt = it->second.find(tok);
+          if (jt != it->second.end()) c = jt->second;
+        }
+        if (c < peak) {
+          PyObject *pv = PyLong_FromLongLong(p);
+          PyList_Append(lag, pv);
+          Py_DECREF(pv);
+        }
+      }
+      PyDict_SetItem(lagging, tk, lag);
+      Py_DECREF(lag);
+    }
+    Py_DECREF(tk);
+  }
+  return Py_BuildValue("(NNL)", counts, lagging, deferred);
+}
+
+// ---------------------------------------------------------------------------
 // Response cache (LRU of fusion plans keyed by the cycle's signatures)
 // ---------------------------------------------------------------------------
 
@@ -718,6 +839,11 @@ PyMethodDef module_methods[] = {
      "plan_fusion_sigs(sigs, threshold_bytes) -> list[list[int]]\n"
      "Deterministic fused-bucket planner (parity with "
      "horovod_tpu.ops.fusion.plan_fusion)."},
+    {"negotiate_decide", py_negotiate_decide, METH_VARARGS,
+     "negotiate_decide(full, active) -> (counts, lagging, deferred)\n"
+     "Readiness-intersection decision over announced token multisets "
+     "(parity with ops.controller.Controller._decide's count loop; "
+     "reference: controller.cc ComputeResponseList)."},
     {nullptr, nullptr, 0, nullptr}};
 
 struct PyModuleDef moduledef = {

@@ -68,6 +68,26 @@ def _client():
     return client
 
 
+_NATIVE = False  # False = unprobed, None = unavailable
+
+
+def _native_core():
+    """The C++ core module when built and enabled, else None (pure-Python
+    decision path).  The HOROVOD_TPU_NATIVE_CORE kill switch lives in
+    loader.load() — single source of truth."""
+    global _NATIVE
+    if _NATIVE is False:
+        _NATIVE = None
+        try:
+            from ..native import loader
+            core = loader.load()
+            if core is not None and hasattr(core, "negotiate_decide"):
+                _NATIVE = core
+        except Exception:  # noqa: BLE001 - build unavailable
+            _NATIVE = None
+    return _NATIVE
+
+
 def _kv_set(client, key: str, value: str):
     try:
         client.key_value_set(key, value, allow_overwrite=True)
@@ -317,21 +337,9 @@ class Controller:
                 f"must request collectives with identical "
                 f"name/dtype/shape/op.")
 
-        counts: "Counter[str]" = Counter()
-        missing: Dict[str, List[int]] = {}
-        for t in all_tokens:
-            k = min(counters[q][t] for q in active)
-            if k > 0:
-                counts[t] = k
-            peak = max(counters[q][t] for q in active)
-            lagging = [q for q in active if counters[q][t] < peak]
-            if lagging:
-                for name in token_names(t):
-                    missing[name] = lagging
-        # deferred: instances someone submitted that did not dispatch
-        self.tokens_deferred += sum(
-            max(counters[q][t] for q in counters) - counts.get(t, 0)
-            for t in all_tokens)
+        counts, missing, deferred = self._decide_counts(
+            full, active, counters, all_tokens)
+        self.tokens_deferred += deferred
 
         if self.stall is not None:
             for name, lagging in missing.items():
@@ -348,6 +356,37 @@ class Controller:
             last = max((vals[q].get("js", 0), q) for q in joined_ps)[1]
         return NegotiationResult(counts=counts, missing=missing,
                                  last_joiner=last)
+
+    def _decide_counts(self, full, active, counters, all_tokens):
+        """Readiness-intersection arithmetic: token dispatch counts (min
+        over active members), per-NAME lagging processes, and the
+        deferred total.  Native C++ when built (the controller is C++
+        upstream; reference: controller.cc ComputeResponseList); pure
+        Python parity fallback — both covered by test_native_core.py."""
+        native = _native_core()
+        if native is not None:
+            counts_d, lagging, deferred = native.negotiate_decide(
+                full, list(active))
+            counts: "Counter[str]" = Counter(counts_d)
+            missing: Dict[str, List[int]] = {}
+            for t, procs in lagging.items():
+                for name in token_names(t):
+                    missing[name] = procs
+            return counts, missing, deferred
+        counts = Counter()
+        missing = {}
+        deferred = 0
+        for t in all_tokens:
+            k = min(counters[q][t] for q in active)
+            if k > 0:
+                counts[t] = k
+            peak = max(counters[q][t] for q in active)
+            lagging = [q for q in active if counters[q][t] < peak]
+            if lagging:
+                for name in token_names(t):
+                    missing[name] = lagging
+            deferred += max(counters[q][t] for q in counters) - k
+        return counts, missing, deferred
 
     # -- transport -----------------------------------------------------------
     def _peer_get(self, client, gk: str, seq: int, phase: str, q: int,

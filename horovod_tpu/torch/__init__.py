@@ -167,14 +167,28 @@ def reducescatter(tensor: torch.Tensor, op=None, name=None,
     ps = _api._ps(process_set)
     res = _api.reducescatter(_to_np(tensor), op=op, name=name,
                              process_set=process_set)
-    a = np.asarray(res)
-    if a.ndim == tensor.dim() + 1:  # stacked per-worker result
+    if getattr(res, "ndim", 0) == tensor.dim() + 1:
+        # stacked per-worker result: take this worker's row from its own
+        # addressable shard (the full array spans other hosts)
         idx = ps.rank()  # this worker's index WITHIN the set
         if idx < 0:
             raise ValueError(
                 "reducescatter called from a worker outside the process "
                 "set")
-        a = a[idx]
+        if hasattr(res, "addressable_shards"):
+            for shard in res.addressable_shards:
+                rows = shard.index[0] if shard.index else slice(None)
+                start = rows.start or 0
+                data = np.asarray(shard.data)
+                if start <= idx < start + data.shape[0]:
+                    a = data[idx - start]
+                    break
+            else:  # pragma: no cover - defensive
+                raise RuntimeError("own reducescatter shard not found")
+        else:
+            a = np.asarray(res)[idx]
+    else:
+        a = np.asarray(res)
     return torch.from_numpy(np.array(a, copy=True)).to(tensor.dtype)
 
 
@@ -445,5 +459,6 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
 
 
 from .sync_batch_norm import SyncBatchNorm  # noqa: E402,F401
+from . import elastic  # noqa: E402,F401
 
-__all__.append("SyncBatchNorm")
+__all__ += ["SyncBatchNorm", "elastic"]

@@ -1,0 +1,101 @@
+"""Elastic state for torch models (reference:
+``horovod/torch/elastic/state.py`` ``TorchState`` — SURVEY.md §2.2).
+
+``TorchState(model=..., optimizer=..., **scalars)`` snapshots the model
+and optimizer state_dicts in memory on ``commit()``, rolls back on
+``restore()`` after a collective failure, and ``sync()``s everything
+from the coordinator after membership changes — the torch face of the
+same elastic machinery :class:`horovod_tpu.elastic.ArrayState` gives
+JAX pytrees.  Use with ``@hvd.elastic.run`` exactly as upstream:
+
+    state = hvd.elastic.TorchState(model=model, optimizer=opt, epoch=0)
+
+    @hvd.elastic.run
+    def train(state): ...
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+import torch
+
+from ..elastic.state import State
+
+
+class TorchState(State):
+    """Elastic snapshot/sync for torch modules + optimizers + scalars."""
+
+    def __init__(self, model: torch.nn.Module = None, optimizer=None,
+                 **kwargs):
+        self._model = model
+        self._optimizer = optimizer
+        self._scalars: Dict[str, Any] = dict(kwargs)
+        self._saved: Dict[str, Any] = {}
+        super().__init__()
+        self.save()
+
+    # attribute surface: model/optimizer/scalars read naturally ----------
+    def __getattr__(self, name):
+        scalars = object.__getattribute__(self, "_scalars")
+        if name in scalars:
+            return scalars[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        elif "_scalars" in self.__dict__ and name in self._scalars:
+            self._scalars[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    @property
+    def model(self):
+        return self._model
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    # State interface ----------------------------------------------------
+    def save(self):
+        self._saved = {
+            "model": (copy.deepcopy(self._model.state_dict())
+                      if self._model is not None else None),
+            "optimizer": (copy.deepcopy(self._optimizer.state_dict())
+                          if self._optimizer is not None else None),
+            "scalars": copy.deepcopy(self._scalars),
+        }
+
+    def restore(self):
+        if self._saved.get("model") is not None:
+            self._model.load_state_dict(
+                copy.deepcopy(self._saved["model"]))
+        if self._saved.get("optimizer") is not None:
+            self._optimizer.load_state_dict(
+                copy.deepcopy(self._saved["optimizer"]))
+        self._scalars = copy.deepcopy(self._saved.get("scalars", {}))
+
+    def sync(self):
+        """Broadcast live model/optimizer/scalars from the coordinator
+        (after a membership change the new worker set must agree)."""
+        from . import (broadcast_object, broadcast_optimizer_state,
+                       broadcast_parameters)
+        if self._model is not None:
+            broadcast_parameters(self._model.state_dict(), root_rank=0)
+        if self._optimizer is not None:
+            broadcast_optimizer_state(self._optimizer, root_rank=0)
+        self._scalars = broadcast_object(self._scalars, root_rank=0)
+        self.save()
+
+    # torch state lives on host; nothing to evacuate before re-init
+    def evacuate(self):
+        pass
+
+
+# the torch elastic namespace mirrors upstream hvd.elastic: the run
+# wrapper, sampler, and object state come from the shared machinery
+from ..elastic import ElasticSampler, run  # noqa: E402,F401
+from ..elastic.state import ObjectState, State  # noqa: E402,F401

@@ -238,6 +238,19 @@ def barrier_fn():
     return {"rank": r, "waited": waited, "sum": float(np.asarray(out))}
 
 
+def torch_reducescatter_fn():
+    """2-process torch reducescatter: each worker keeps its own slice of
+    the cross-process reduction (exercises the addressable-shard path)."""
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    r = hvd.cross_rank()
+    t = torch.arange(4.0) * (r + 1)  # rank0: 0..3, rank1: 0..6 step2
+    out = hvd.reducescatter(t, op=hvd.Sum, name="rs2p")
+    return {"rank": r, "out": out.tolist()}
+
+
 def join_uneven_fn():
     """Uneven batch counts (reference: hvd.join / JoinOp).  Process 0 runs
     3 batches, process 1 runs 2; joined processes co-execute the peer's

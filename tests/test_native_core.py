@@ -206,3 +206,41 @@ def test_kill_switch_env(monkeypatch):
         tl.close()
     monkeypatch.delenv("HOROVOD_TPU_NATIVE_CORE")
     assert loader.load() is not None
+
+
+def test_negotiate_decide_parity():
+    """Native negotiate_decide matches the Python decision loop on random
+    announcement multisets (reference: controller.cc ComputeResponseList
+    intersection)."""
+    core = pytest.importorskip("horovod_tpu.native.loader").load()
+    if core is None or not hasattr(core, "negotiate_decide"):
+        pytest.skip("native core unavailable")
+    import random
+    from collections import Counter
+    rng = random.Random(7)
+    tokens = [f"tok{i}" for i in range(6)]
+    for _ in range(25):
+        nprocs = rng.randint(2, 5)
+        full = {p: [rng.choice(tokens)
+                    for _ in range(rng.randint(0, 8))]
+                for p in range(nprocs)}
+        active = sorted(rng.sample(range(nprocs),
+                                   rng.randint(1, nprocs)))
+        counters = {p: Counter(full[p]) for p in full}
+        all_tokens = sorted(set().union(*[set(c)
+                                          for c in counters.values()]))
+        # python reference
+        want_counts, want_lag, want_def = Counter(), {}, 0
+        for t in all_tokens:
+            k = min(counters[q][t] for q in active)
+            if k > 0:
+                want_counts[t] = k
+            peak = max(counters[q][t] for q in active)
+            lag = [q for q in active if counters[q][t] < peak]
+            if lag:
+                want_lag[t] = lag
+            want_def += max(counters[q][t] for q in counters) - k
+        counts, lagging, deferred = core.negotiate_decide(full, active)
+        assert Counter(counts) == want_counts
+        assert {k: sorted(v) for k, v in lagging.items()} == want_lag
+        assert deferred == want_def

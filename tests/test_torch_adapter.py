@@ -282,3 +282,50 @@ def test_grouped_allgather_and_reducescatter(thvd, n_workers):
     # replicated input: reduction is x * n, this worker keeps slice 0
     assert out.shape == (2,)
     assert torch.allclose(out, t[:2] * n_workers)
+
+
+# --- TorchState (reference: horovod/torch/elastic/state.py) -----------------
+
+def test_torch_state_commit_restore(thvd):
+    model = torch.nn.Linear(2, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    state = thvd.elastic.TorchState(model=model, optimizer=opt, epoch=3)
+    w0 = model.weight.detach().clone()
+    state.commit()
+    # mutate everything, then roll back
+    with torch.no_grad():
+        model.weight.add_(1.0)
+    (model(torch.ones(1, 2)).sum()).backward()
+    opt.step()
+    state.epoch = 9
+    state.restore()
+    assert torch.allclose(model.weight, w0)
+    assert state.epoch == 3
+
+
+def test_torch_state_sync_noop_single_process(thvd):
+    model = torch.nn.Linear(2, 2)
+    state = thvd.elastic.TorchState(model=model, step=5)
+    state.sync()  # broadcast from self: values unchanged
+    assert state.step == 5
+
+
+def test_torch_state_run_wrapper_available(thvd):
+    assert callable(thvd.elastic.run)
+    assert thvd.elastic.ElasticSampler is not None
+
+
+def test_torch_reducescatter_two_process():
+    env = {
+        "HOROVOD_TPU_FORCE_PLATFORM": "cpu",
+        "PYTHONPATH": REPO + ":" + os.path.join(REPO, "tests"),
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "HOROVOD_CYCLE_TIME": "0.2",
+    }
+    results = run(helpers_runner.torch_reducescatter_fn, np=2, env=env,
+                  port=29543)
+    by_rank = {r["rank"]: r for r in results}
+    # reduction: arange(4) * (1 + 2) = [0, 3, 6, 9]; rank0 keeps [0, 3],
+    # rank1 keeps [6, 9]
+    assert by_rank[0]["out"] == [0.0, 3.0]
+    assert by_rank[1]["out"] == [6.0, 9.0]

@@ -44,7 +44,7 @@ def main():
     from horovod_tpu import training
     from horovod_tpu.models import llama
     from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
-    from bench import PEAK_TFLOPS, detect_peak
+    from bench import detect_peak
 
     if args.flash_block:
         from horovod_tpu.ops import flash_attention as fa
