@@ -1,0 +1,84 @@
+"""Torch elastic training (reference: ``examples/elastic/pytorch/``,
+BASELINE config 5 through the torch adapter).
+
+``TorchState`` snapshots the model + optimizer in memory every
+``--commit-every`` steps; on a collective failure the run wrapper
+restores the last commit and re-rendezvouses, and on membership change
+it syncs from the new coordinator — training continues through worker
+churn without touching disk.
+
+Run under the elastic driver:
+    python -m horovod_tpu.elastic.driver --discovery "echo localhost:2" \
+        --min-np 1 -- python examples/elastic_torch_mnist.py
+or plainly (single incarnation):
+    python examples/elastic_torch_mnist.py
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mnist import load_mnist  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--commit-every", type=int, default=10)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+    model = torch.nn.Sequential(
+        torch.nn.Flatten(), torch.nn.Linear(784, 128), torch.nn.ReLU(),
+        torch.nn.Linear(128, 10))
+    opt = torch.optim.Adam(model.parameters(), lr=args.lr)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+
+    state = hvd.elastic.TorchState(model=model, optimizer=opt, epoch=0,
+                                   step=0)
+
+    images, labels = load_mnist(None, 2048)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.epoch < args.epochs:
+            rank, nproc = hvd.cross_rank(), hvd.cross_size()
+            X = torch.from_numpy(images[rank::nproc]).reshape(-1, 784)
+            y = torch.from_numpy(labels[rank::nproc]).long()
+            steps = int(hvd.allreduce(
+                torch.tensor(float(len(X) // args.batch_size)),
+                op=hvd.Min, name="steps"))
+            while state.step < steps:
+                i = state.step * args.batch_size
+                opt.zero_grad()
+                loss = F.cross_entropy(model(X[i:i + args.batch_size]),
+                                       y[i:i + args.batch_size])
+                loss.backward()
+                opt.step()
+                state.step += 1
+                if state.step % args.commit_every == 0:
+                    state.commit()
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch}: loss={float(loss):.4f} "
+                      f"(np={nproc})")
+            state.epoch += 1
+            state.step = 0
+            state.commit()
+
+    train(state)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

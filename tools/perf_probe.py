@@ -58,6 +58,10 @@ def main():
         vocab_size=32768, d_model=2048, n_layers=16, n_heads=16,
         n_kv_heads=8, d_ff=8192, max_seq_len=args.seq, remat=True,
         remat_policy=args.remat)
+    if jax.devices()[0].platform == "cpu":  # smoke-test shrink
+        cfg = dataclasses.replace(
+            cfg, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4,
+            d_ff=1024, vocab_size=4096)
     n_chips = jax.local_device_count()
     pmesh = ParallelMesh(MeshConfig(dp=n_chips, pp=1, sp=1, tp=1))
     opt = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
