@@ -59,6 +59,8 @@ def main():
             steps = int(hvd.allreduce(
                 torch.tensor(float(len(X) // args.batch_size)),
                 op=hvd.Min, name="steps"))
+            loss = torch.tensor(float("nan"))  # no steps ran (e.g. a
+            # restore landed past this epoch's min step count)
             while state.step < steps:
                 i = state.step * args.batch_size
                 opt.zero_grad()

@@ -54,6 +54,11 @@ class LlamaConfig:
     expert_top_k: int = 2
     capacity_factor: float = 1.25
     aux_loss_coef: float = 0.01
+    # chunked cross-entropy: > 0 computes the loss in sequence chunks of
+    # this many tokens, recomputing each chunk's [B, chunk, V] logits in
+    # the backward pass instead of materializing the full [B, T, V] fp32
+    # logits + log-softmax (≈ 2 GB at B8·T1024·V32k).  0 = one-shot.
+    loss_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -261,9 +266,9 @@ def _layer_stack(h, layers, cfg: LlamaConfig, par: ParallelSpec, positions):
     return h, aux
 
 
-def forward(params, tokens, cfg: LlamaConfig, par: ParallelSpec,
-            n_microbatches: int = 0):
-    """Token ids → logits.  Call inside shard_map over the parallel mesh.
+def hidden(params, tokens, cfg: LlamaConfig, par: ParallelSpec,
+           n_microbatches: int = 0):
+    """Token ids → final-norm hidden states ``[B, T, D]`` (pre-head).
 
     ``tokens``: ``[B_local, T_local]`` — batch sharded over dp, sequence
     over sp.  With ``par.pp_axis``, ``n_microbatches`` must divide B_local
@@ -307,20 +312,70 @@ def forward(params, tokens, cfg: LlamaConfig, par: ParallelSpec,
         h, aux = _layer_stack(h, params["layers"], cfg, par, positions)
 
     h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    return h, aux
+
+
+def forward(params, tokens, cfg: LlamaConfig, par: ParallelSpec,
+            n_microbatches: int = 0):
+    """Token ids → logits.  Call inside shard_map over the parallel mesh."""
+    h, aux = hidden(params, tokens, cfg, par, n_microbatches)
     # tied embedding head (Llama-3 unties; tying halves test-model memory
     # and changes no parallel structure — the head matmul stays [D, V])
     logits = h @ params["embed"].T.astype(h.dtype)
     return logits, aux
 
 
+def _chunked_xent(h, w_embed, targets, chunk: int):
+    """Mean cross-entropy without materializing full logits.
+
+    Scans the (local) sequence in chunks; each chunk computes its
+    ``[B, chunk, V]`` logit tile, reduces it to per-token ``lse - target``
+    immediately, and ``jax.checkpoint`` re-derives the tile in the
+    backward pass.  The [B, T, V] fp32 logits / log-softmax buffers of
+    the one-shot path never exist, at the cost of re-running the head
+    matmul once in bwd — the chunked-softmax idea flash attention applies
+    to scores, applied to the vocabulary head.
+    """
+    B, T, D = h.shape
+    n = T // chunk
+    w = w_embed.astype(h.dtype)
+    hs = jnp.moveaxis(h.reshape(B, n, chunk, D), 1, 0)       # [n,B,c,D]
+    ts = jnp.moveaxis(targets.reshape(B, n, chunk), 1, 0)    # [n,B,c]
+
+    @jax.checkpoint
+    def body(acc, xt):
+        hc, tc = xt
+        logits = (hc @ w.T).astype(jnp.float32)              # [B,c,V]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        return acc + (lse - tgt).sum(), None
+
+    # the accumulator derives from h (×0) so it carries h's varying mesh
+    # axes — a fresh constant would fail check_vma's carry-type check
+    acc0 = (h.astype(jnp.float32) * 0).sum()
+    total, _ = lax.scan(body, acc0, (hs, ts))
+    return total / (B * T)
+
+
 def loss_fn(params, tokens, targets, cfg: LlamaConfig, par: ParallelSpec,
             n_microbatches: int = 0):
     """Mean next-token cross-entropy over local tokens plus the MoE
     load-balance auxiliary loss (caller pmeans over dp/sp axes)."""
-    logits, aux = forward(params, tokens, cfg, par, n_microbatches)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    loss = -ll.mean()
+    h, aux = hidden(params, tokens, cfg, par, n_microbatches)
+    if cfg.loss_chunk > 0 and h.shape[1] % cfg.loss_chunk:
+        import logging
+        logging.getLogger("horovod_tpu").warning(
+            "loss_chunk=%d does not divide the local sequence length %d "
+            "(sp sharding?); falling back to one-shot cross-entropy — "
+            "the full [B, T, V] logits WILL be materialized",
+            cfg.loss_chunk, h.shape[1])
+    if cfg.loss_chunk > 0 and h.shape[1] % cfg.loss_chunk == 0:
+        loss = _chunked_xent(h, params["embed"], targets, cfg.loss_chunk)
+    else:
+        logits = h @ params["embed"].T.astype(h.dtype)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        loss = -ll.mean()
     if cfg.n_experts > 0:
         loss = loss + cfg.aux_loss_coef * aux / cfg.n_layers
     return loss

@@ -1,0 +1,94 @@
+"""Low-precision optimizer states: AdamW with bf16 first AND second
+moments.
+
+The reference ships fp16 *wire* compression for gradient traffic
+(horovod/torch/compression.py, SURVEY.md §2.2); the TPU-native analog of
+"spend fewer bytes on the redundant copies" is compressing the optimizer
+state that lives in HBM next to the fp32 master params.  optax's
+``adamw(mu_dtype=...)`` casts only the first moment; at 1B params the
+fp32 second moment is another 4 GB of HBM — enough to evict activations
+and force full rematerialization.  This transform keeps ALL moment
+arithmetic in fp32 (cast up, update, cast down) and stores both moments
+in a compact dtype.
+
+bf16's 8-bit mantissa is fine for ``nu``: Adam normalizes by
+``sqrt(nu) + eps``, so a 2^-8 relative error in ``nu`` is a ~2^-9
+relative error in the step size — far below gradient noise.  This is the
+standard justification used by factored/8-bit optimizer literature
+(PAPERS.md: Adafactor, 8-bit Adam); bf16 is the conservative point on
+that curve and is MXU/VPU-native on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class ScaleByAdamLPState(NamedTuple):
+    count: jnp.ndarray
+    mu: Any
+    nu: Any
+
+
+def scale_by_adam_lp(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                     eps_root: float = 0.0,
+                     mu_dtype: Optional[Any] = jnp.bfloat16,
+                     nu_dtype: Optional[Any] = jnp.bfloat16
+                     ) -> optax.GradientTransformation:
+    """Adam moment tracking with independently-compressed mu/nu storage."""
+    mu_dtype = jnp.dtype(mu_dtype) if mu_dtype is not None else None
+    nu_dtype = jnp.dtype(nu_dtype) if nu_dtype is not None else None
+
+    def cast(tree, dtype):
+        if dtype is None:
+            return tree
+        return jax.tree_util.tree_map(lambda x: x.astype(dtype), tree)
+
+    def init_fn(params):
+        mu = cast(jax.tree_util.tree_map(jnp.zeros_like, params), mu_dtype)
+        nu = cast(jax.tree_util.tree_map(jnp.zeros_like, params), nu_dtype)
+        return ScaleByAdamLPState(jnp.zeros([], jnp.int32), mu, nu)
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+
+        def upd(g, m, v):
+            g32 = g.astype(jnp.float32)
+            m32 = m.astype(jnp.float32) * b1 + g32 * (1.0 - b1)
+            v32 = v.astype(jnp.float32) * b2 + g32 * g32 * (1.0 - b2)
+            mhat = m32 / (1.0 - b1 ** count.astype(jnp.float32))
+            vhat = v32 / (1.0 - b2 ** count.astype(jnp.float32))
+            step = (mhat / (jnp.sqrt(vhat + eps_root) + eps)).astype(g.dtype)
+            return step, m32, v32
+
+        flat_g, treedef = jax.tree_util.tree_flatten(updates)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        out = [upd(g, m, v) for g, m, v in zip(flat_g, flat_m, flat_v)]
+        steps = treedef.unflatten([o[0] for o in out])
+        mu = cast(treedef.unflatten([o[1] for o in out]), mu_dtype)
+        nu = cast(treedef.unflatten([o[2] for o in out]), nu_dtype)
+        return steps, ScaleByAdamLPState(count, mu, nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def adamw_lp(learning_rate, b1: float = 0.9, b2: float = 0.999,
+             eps: float = 1e-8, weight_decay: float = 1e-4,
+             mu_dtype: Any = jnp.bfloat16, nu_dtype: Any = jnp.bfloat16
+             ) -> optax.GradientTransformation:
+    """AdamW with both moment buffers stored low-precision.
+
+    Drop-in for ``optax.adamw``; at bf16/bf16 the optimizer state is 4
+    bytes/param instead of 8 (optax: 8 with ``mu_dtype=bf16`` only 6)."""
+    return optax.chain(
+        scale_by_adam_lp(b1=b1, b2=b2, eps=eps, mu_dtype=mu_dtype,
+                         nu_dtype=nu_dtype),
+        optax.add_decayed_weights(weight_decay),
+        optax.scale_by_learning_rate(learning_rate),
+    )

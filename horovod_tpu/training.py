@@ -88,8 +88,17 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
                           optimizer: Optional[optax.GradientTransformation]
                           = None,
                           attn: str = "ring",
-                          n_microbatches: int = 0) -> TrainStep:
-    """Build the full data/tensor/sequence/pipeline/expert-parallel step."""
+                          n_microbatches: int = 0,
+                          zero1: bool = False) -> TrainStep:
+    """Build the full data/tensor/sequence/pipeline/expert-parallel step.
+
+    ``zero1=True`` additionally shards the optimizer state over the dp
+    axis (ZeRO stage 1): each dp shard keeps 1/dp of every moment buffer,
+    updates its slice, and the updated parameter slices are all-gathered
+    — per-chip optimizer HBM drops by the dp factor.  The reference has
+    no analog (its DP state is fully replicated); on TPU the all-gather
+    rides ICI and overlaps with the next step's compute.
+    """
     par = make_llama_parallel_spec(pmesh, attn, use_ep=cfg.n_experts > 0)
     mesh = pmesh.mesh
     opt = optimizer if optimizer is not None else optax.adamw(3e-4)
@@ -160,36 +169,101 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
             loss = lax.psum(jnp.where(is_last, loss, 0.0), par.pp_axis)
         return loss
 
-    def shard_step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(local_loss)(params, tokens, targets)
-        grads = reduce_grads(grads)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+    pspec_tree = specs
+    param_shapes = jax.eval_shape(
+        partial(llama_mod.init_params, cfg, tp=1), jax.random.PRNGKey(0))
+
+    # --- ZeRO-1: which leaves can shard their optimizer state over dp?
+    # A leaf qualifies when its (pp/tp-local) leading axis divides by dp.
+    # Non-elementwise gradient transforms (global-norm clipping, adafactor
+    # row/col stats) would see slices, so zero1 requires an elementwise
+    # optimizer — the adam/sgd families all are.
+    use_zero = bool(zero1) and dp > 1 and par.dp_axis is not None
+
+    def _zero_entry(spec, shape):
+        entries = list(spec) + [None] * (len(shape.shape) - len(spec))
+        e0 = entries[0] if entries else None
+        axes0 = (e0 if isinstance(e0, tuple)
+                 else (() if e0 is None else (e0,)))
+        if "dp" in axes0 or not shape.shape:
+            return None
+        denom = 1
+        for a in axes0:
+            denom *= pmesh.axis_size(a)
+        local0 = shape.shape[0] // denom
+        if local0 % dp:
+            return None
+        entries[0] = tuple(axes0) + ("dp",) if axes0 else "dp"
+        return P(*entries)
+
+    if use_zero:
+        zspec_or_none = jax.tree_util.tree_map(
+            _zero_entry, specs, param_shapes,
+            is_leaf=lambda x: isinstance(x, P))
+        zero_pspecs = jax.tree_util.tree_map(
+            lambda z, s: s if z is None else z, zspec_or_none, specs,
+            is_leaf=lambda x: x is None or isinstance(x, P))
+    else:
+        zero_pspecs = pspec_tree
+
+    def _mean_loss(loss):
         loss_axes = [par.dp_axis, par.sp_axis, par.tp_axis]
         if ep_dedicated > 1:
             loss_axes.append("ep")
         for ax in loss_axes:
             if ax is not None:
                 loss = lax.pmean(loss, ax)
-        return params, opt_state, loss
+        return loss
 
-    pspec_tree = specs
-    param_shapes = jax.eval_shape(
-        partial(llama_mod.init_params, cfg, tp=1), jax.random.PRNGKey(0))
+    def shard_step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(local_loss)(params, tokens, targets)
+        grads = reduce_grads(grads)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, _mean_loss(loss)
+
+    def shard_grads(params, tokens, targets):
+        loss, grads = jax.value_and_grad(local_loss)(params, tokens, targets)
+        return _mean_loss(loss), reduce_grads(grads)
+
     opt_state_shape = jax.eval_shape(lambda p: opt.init(p), param_shapes)
     opt_specs = opt_state_partition_specs(
-        opt_state_shape, param_shapes, pspec_tree)
+        opt_state_shape, param_shapes, zero_pspecs)
     opt_sharding = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), opt_specs,
         is_leaf=lambda x: isinstance(x, P))
 
     # donate params/opt_state: the updated pytrees reuse the same HBM,
     # halving peak memory and avoiding a full copy per step
-    step_fn = jax.jit(jax.shard_map(
-        shard_step, mesh=mesh,
-        in_specs=(pspec_tree, opt_specs, data_spec, data_spec),
-        out_specs=(pspec_tree, opt_specs, P()),
-        check_vma=True), donate_argnums=(0, 1))
+    if use_zero:
+        # ZeRO at the GSPMD level: the fwd/bwd shard_map emits (psum'd,
+        # dp-invariant) grads; the elementwise optimizer update runs at
+        # jit level where the dp-sharded opt-state shardings make XLA
+        # partition it over dp (each shard updates 1/dp of every buffer)
+        # and the replicated-params output constraint inserts the one
+        # all-gather of updated slices — the ZeRO-1 dance as sharding
+        # propagation instead of hand-written collectives.
+        grads_fn = jax.shard_map(
+            shard_grads, mesh=mesh,
+            in_specs=(pspec_tree, data_spec, data_spec),
+            out_specs=(P(), pspec_tree), check_vma=True)
+
+        def _step(params, opt_state, tokens, targets):
+            loss, grads = grads_fn(params, tokens, targets)
+            opt_state = lax.with_sharding_constraint(opt_state,
+                                                     opt_sharding)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            params = lax.with_sharding_constraint(params, param_sharding)
+            return params, opt_state, loss
+
+        step_fn = jax.jit(_step, donate_argnums=(0, 1))
+    else:
+        step_fn = jax.jit(jax.shard_map(
+            shard_step, mesh=mesh,
+            in_specs=(pspec_tree, opt_specs, data_spec, data_spec),
+            out_specs=(pspec_tree, opt_specs, P()),
+            check_vma=True), donate_argnums=(0, 1))
 
     def init_fn(rng):
         params = jax.jit(

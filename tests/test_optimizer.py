@@ -179,3 +179,61 @@ def test_compression_in_jit(hvd):
                         out_specs=P())(grads)
     assert out["w"].dtype == jnp.float32
     np.testing.assert_allclose(out["w"], np.full((64,), 8.0))
+
+
+def test_adamw_lp_fp32_matches_optax(hvd):
+    """With fp32 storage the low-precision AdamW is exactly optax.adamw."""
+    from horovod_tpu.optim.precision import adamw_lp
+    params = {"w": jnp.linspace(-1.0, 1.0, 32).reshape(8, 4),
+              "b": jnp.arange(4, dtype=jnp.float32)}
+    ref = optax.adamw(1e-2, weight_decay=1e-4)
+    lp = adamw_lp(1e-2, weight_decay=1e-4,
+                  mu_dtype=jnp.float32, nu_dtype=jnp.float32)
+    ps_ref, ps_lp = params, params
+    s_ref, s_lp = ref.init(ps_ref), lp.init(ps_lp)
+    for i in range(5):
+        g = jax.tree_util.tree_map(
+            lambda x: jnp.sin(x + i).astype(x.dtype), params)
+        u, s_ref = ref.update(g, s_ref, ps_ref)
+        ps_ref = optax.apply_updates(ps_ref, u)
+        u, s_lp = lp.update(g, s_lp, ps_lp)
+        ps_lp = optax.apply_updates(ps_lp, u)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+        ps_ref, ps_lp)
+
+
+def test_adamw_lp_bf16_state_tracks_fp32(hvd):
+    """bf16 moment storage stays within bf16 rounding of the fp32 run and
+    actually stores bf16 (the memory claim)."""
+    from horovod_tpu.optim.precision import adamw_lp
+    params = {"w": jnp.linspace(-1.0, 1.0, 256).reshape(16, 16)}
+    hi = adamw_lp(1e-2, mu_dtype=jnp.float32, nu_dtype=jnp.float32)
+    lo = adamw_lp(1e-2)
+    ps_hi, ps_lo = params, params
+    s_hi, s_lo = hi.init(ps_hi), lo.init(ps_lo)
+    assert s_lo[0].mu["w"].dtype == jnp.bfloat16
+    assert s_lo[0].nu["w"].dtype == jnp.bfloat16
+    for i in range(10):
+        g = jax.tree_util.tree_map(
+            lambda x: jnp.cos(x * (i + 1)).astype(jnp.float32), params)
+        u, s_hi = hi.update(g, s_hi, ps_hi)
+        ps_hi = optax.apply_updates(ps_hi, u)
+        u, s_lo = lo.update(g, s_lo, ps_lo)
+        ps_lo = optax.apply_updates(ps_lo, u)
+    np.testing.assert_allclose(ps_hi["w"], ps_lo["w"], atol=5e-3)
+
+
+def test_adamw_lp_state_shards_like_adam(hvd):
+    """training.opt_state_partition_specs must recognize the lp state's
+    mu/nu as param-shaped subtrees (they shard with the params)."""
+    from horovod_tpu import training
+    from horovod_tpu.optim.precision import adamw_lp
+    params = {"a": jnp.zeros((4, 4)), "b": jnp.zeros((2,))}
+    opt = adamw_lp(1e-3)
+    shape = jax.eval_shape(opt.init, params)
+    pspecs = {"a": P("dp", None), "b": P()}
+    specs = training.opt_state_partition_specs(shape, params, pspecs)
+    leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, (P, dict)))
+    assert any(isinstance(l, dict) and l == pspecs for l in leaves)

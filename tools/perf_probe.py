@@ -31,6 +31,8 @@ def main():
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--remat", default="full", choices=["full", "dots"])
+    p.add_argument("--loss-chunk", type=int, default=0)
+    p.add_argument("--opt", default="adamw", choices=["adamw", "adamw_lp"])
     args = p.parse_args()
 
     if args.no_flash:
@@ -57,14 +59,18 @@ def main():
     cfg = llama.LlamaConfig(
         vocab_size=32768, d_model=2048, n_layers=16, n_heads=16,
         n_kv_heads=8, d_ff=8192, max_seq_len=args.seq, remat=True,
-        remat_policy=args.remat)
+        remat_policy=args.remat, loss_chunk=args.loss_chunk)
     if jax.devices()[0].platform == "cpu":  # smoke-test shrink
         cfg = dataclasses.replace(
             cfg, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4,
             d_ff=1024, vocab_size=4096)
     n_chips = jax.local_device_count()
     pmesh = ParallelMesh(MeshConfig(dp=n_chips, pp=1, sp=1, tp=1))
-    opt = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
+    if args.opt == "adamw_lp":
+        from horovod_tpu.optim.precision import adamw_lp
+        opt = adamw_lp(3e-4)
+    else:
+        opt = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
     ts = training.make_llama_train_step(cfg, pmesh, optimizer=opt)
     params, opt_state = ts.init_fn(jax.random.PRNGKey(0))
     rng = np.random.RandomState(0)
