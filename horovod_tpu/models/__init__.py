@@ -6,4 +6,5 @@ Llama-3-style decoder (config 4, flagship) and a Mixtral-style MoE variant
 params, stacked-layer ``lax.scan`` bodies, explicit mesh-axis hooks.
 """
 
-from . import llama, mnist, resnet  # noqa: F401  (bert/moe import on demand)
+from . import generate, llama, mnist, resnet  # noqa: F401  (bert/moe
+#                                                 import on demand)

@@ -1,0 +1,145 @@
+"""Autoregressive decoding with a KV cache for the Llama family.
+
+Inference capability the reference does not have at all (Horovod's scope
+ends at distributed training — SURVEY.md §0); provided here so the model
+zoo is usable end-to-end.  TPU-first shape discipline throughout: the
+cache is a static ``[L, B, max_len, Hkv, D]`` buffer updated with
+``lax.dynamic_update_slice``; the decode loop is a ``lax.scan`` over
+token positions (one compiled program, no per-step retrace); attention
+over the cache uses a position mask instead of dynamic slicing so every
+matmul keeps static shapes for the MXU.
+
+Layout notes: decode attends one query token against the full cache
+buffer with invalid (future/unwritten) positions masked to -inf — at
+decode lengths the wasted FLOPs are negligible and static shapes are
+what keeps XLA from recompiling per step.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .llama import LlamaConfig, ParallelSpec, _mlp, _rmsnorm, _rope
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray        # [L, B, max_len, Hkv, D]
+    v: jnp.ndarray        # [L, B, max_len, Hkv, D]
+    length: jnp.ndarray   # [] int32 — tokens written so far
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,
+                  dtype=None) -> KVCache:
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                   jnp.zeros((), jnp.int32))
+
+
+def _cached_attention(x, lp, cfg: LlamaConfig, k_cache, v_cache,
+                      positions):
+    """Attention of x's tokens against the cache prefix + x itself.
+
+    ``x``: [B, T, D] new tokens at absolute ``positions`` [B, T];
+    ``k_cache/v_cache``: [B, max_len, Hkv, D] with the new k/v already
+    written.  Masks out cache slots >= cache_len + T and enforces
+    causality inside the new block.
+    """
+    B, T, D = x.shape
+    Dh = cfg.head_dim
+    H = cfg.n_heads
+    Hkv = cfg.n_kv_heads
+    q = (x @ lp["wq"].astype(x.dtype)).reshape(B, T, H, Dh)
+    q = _rope(q, positions, cfg.rope_theta)
+    max_len = k_cache.shape[1]
+    g = H // Hkv
+    # [B, max_len, Hkv, D] -> [B, max_len, H, D] (GQA repeat)
+    k = jnp.repeat(k_cache, g, axis=2)
+    v = jnp.repeat(v_cache, g, axis=2)
+    scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * (Dh ** -0.5)
+    slot = jnp.arange(max_len)[None, None, None, :]        # cache position
+    qpos = positions[:, None, :, None]                     # query position
+    mask = slot <= qpos                                    # causal + bounds
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    o = jnp.einsum("bhts,bshd->bthd", probs, v)
+    return o.reshape(B, T, H * Dh) @ lp["wo"].astype(x.dtype)
+
+
+def _write_kv(x, lp, cfg: LlamaConfig, k_cache, v_cache, positions, start):
+    """Project x to k/v, rope them, write into the cache at ``start``."""
+    B, T, _ = x.shape
+    Dh = cfg.head_dim
+    Hkv = cfg.n_kv_heads
+    k = (x @ lp["wk"].astype(x.dtype)).reshape(B, T, Hkv, Dh)
+    v = (x @ lp["wv"].astype(x.dtype)).reshape(B, T, Hkv, Dh)
+    k = _rope(k, positions, cfg.rope_theta)
+    k_cache = lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
+    v_cache = lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
+    return k_cache, v_cache
+
+
+def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: KVCache):
+    """Run ``tokens`` [B, T] through the model, extending ``cache``.
+
+    Returns ``(logits [B, T, V], new_cache)``.  Serves both phases:
+    prefill (T = prompt length, cache.length == 0) and decode (T == 1).
+    """
+    par = ParallelSpec()  # decode path is single-shard per replica
+    B, T = tokens.shape
+    start = cache.length
+    positions = (jnp.arange(T)[None, :] + start) * jnp.ones_like(tokens)
+    h = params["embed"].astype(cfg.dtype)[tokens]
+
+    layers = jax.tree_util.tree_map(
+        lambda w: w.astype(cfg.dtype) if w.dtype != cfg.dtype else w,
+        params["layers"])
+
+    def scan_body(h, layer_io):
+        lp, kc, vc = layer_io
+        attn_in = _rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
+        kc, vc = _write_kv(attn_in, lp, cfg, kc, vc, positions, start)
+        h = h + _cached_attention(attn_in, lp, cfg, kc, vc, positions)
+        h = h + _mlp(_rmsnorm(h, lp["mlp_norm"], cfg.norm_eps), lp, par)
+        return h, (kc, vc)
+
+    h, (k_new, v_new) = lax.scan(scan_body, h,
+                                 (layers, cache.k, cache.v))
+    h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    logits = h @ params["embed"].T.astype(h.dtype)
+    return logits, KVCache(k_new, v_new, start + T)
+
+
+def greedy_generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
+                    max_len: Optional[int] = None):
+    """Greedy decode: prefill the prompt, then scan one token at a time.
+
+    ``prompt``: [B, T_prompt] int32.  Returns [B, max_new_tokens] of
+    generated ids.  One jit-compiled program end to end.
+    """
+    B, Tp = prompt.shape
+    max_len = max_len or (Tp + max_new_tokens)
+    if Tp + max_new_tokens > max_len:
+        raise ValueError(f"max_len={max_len} < prompt {Tp} + new "
+                         f"{max_new_tokens}")
+    cache = init_kv_cache(cfg, B, max_len)
+    logits, cache = forward_with_cache(params, prompt, cfg, cache)
+    next_tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+    def step(carry, _):
+        cache, tok = carry
+        logits, cache = forward_with_cache(params, tok[:, None], cfg,
+                                           cache)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        return (cache, nxt), tok
+
+    (_, _), toks = lax.scan(step, (cache, next_tok), None,
+                            length=max_new_tokens)
+    return jnp.moveaxis(toks, 0, 1)  # [B, max_new]

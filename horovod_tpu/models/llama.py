@@ -59,6 +59,11 @@ class LlamaConfig:
     # the backward pass instead of materializing the full [B, T, V] fp32
     # logits + log-softmax (≈ 2 GB at B8·T1024·V32k).  0 = one-shot.
     loss_chunk: int = 0
+    # partial remat: the LAST k layers (per pipeline stage) run without
+    # rematerialization — their activations are saved, trading HBM for
+    # skipped recompute.  Spend freed memory here: each skipped layer
+    # saves one forward-recompute of itself in the backward pass.
+    remat_skip_layers: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -253,16 +258,30 @@ def _layer_stack(h, layers, cfg: LlamaConfig, par: ParallelSpec, positions):
                   jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
         body = jax.checkpoint(body, static_argnums=(2, 3), policy=policy)
 
-    def scan_body(carry, lp):
-        h, aux = carry
-        h, aux_l = body(h, lp, cfg, par, positions)
-        return (h, aux + aux_l), None
+    def scan_stack(body_fn, carry, ls):
+        def scan_body(carry, lp):
+            h, aux = carry
+            h, aux_l = body_fn(h, lp, cfg, par, positions)
+            return (h, aux + aux_l), None
+        carry, _ = lax.scan(scan_body, carry, ls)
+        return carry
 
     # aux accumulator derives from h (×0) so it inherits h's varying mesh
     # axes — a fresh constant would be invariant and fail check_vma's
     # carry-type check once the MoE aux (data-dependent) joins it
     aux0 = (h.astype(jnp.float32) * 0).sum()
-    (h, aux), _ = lax.scan(scan_body, (h, aux0), layers)
+    n_local = jax.tree_util.tree_leaves(layers)[0].shape[0]
+    k = min(cfg.remat_skip_layers, n_local) if cfg.remat else 0
+    if k > 0:
+        # remat'd prefix, then the last k layers un-remat'd (activations
+        # saved; they are the first to run backward, so their skipped
+        # recompute shortens the critical path immediately)
+        first = jax.tree_util.tree_map(lambda w: w[:n_local - k], layers)
+        last = jax.tree_util.tree_map(lambda w: w[n_local - k:], layers)
+        carry = scan_stack(body, (h, aux0), first)
+        h, aux = scan_stack(block, carry, last)
+    else:
+        h, aux = scan_stack(body, (h, aux0), layers)
     return h, aux
 
 

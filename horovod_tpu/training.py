@@ -180,13 +180,17 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
     # optimizer — the adam/sgd families all are.
     use_zero = bool(zero1) and dp > 1 and par.dp_axis is not None
 
+    def _spec_axes(entry):
+        return (entry if isinstance(entry, tuple)
+                else (() if entry is None else (entry,)))
+
     def _zero_entry(spec, shape):
         entries = list(spec) + [None] * (len(shape.shape) - len(spec))
-        e0 = entries[0] if entries else None
-        axes0 = (e0 if isinstance(e0, tuple)
-                 else (() if e0 is None else (e0,)))
-        if "dp" in axes0 or not shape.shape:
+        # a leaf already sharded over dp on ANY axis (e.g. MoE expert
+        # weights with ep aliased onto dp) must not gain a second dp entry
+        if any("dp" in _spec_axes(e) for e in entries) or not shape.shape:
             return None
+        axes0 = _spec_axes(entries[0] if entries else None)
         denom = 1
         for a in axes0:
             denom *= pmesh.axis_size(a)
@@ -271,6 +275,104 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
             out_shardings=param_sharding)(rng)
         opt_state = jax.jit(
             opt.init, out_shardings=opt_sharding)(params)
+        return params, opt_state
+
+    return TrainStep(step_fn=step_fn, init_fn=init_fn, par=par, mesh=mesh,
+                     data_spec=data_spec, param_sharding=param_sharding)
+
+
+def fsdp_param_specs(param_shapes, dp: int, axis: str = "dp"):
+    """FSDP shardings: each leaf shards its largest dp-divisible axis.
+
+    Stacked layer leaves (under the ``"layers"`` subtree) never shard
+    axis 0 — it is the ``lax.scan`` dimension, and sharding it would put
+    whole layers on single devices instead of splitting every layer
+    across all of them.  Non-stacked leaves (embed, final_norm) may
+    shard any axis.  Leaves with no divisible axis stay replicated
+    (the small norms; their optimizer state is negligible)."""
+    def spec_for(path, shape):
+        dims = shape.shape
+        stacked = any(
+            getattr(k, "key", getattr(k, "name", None)) == "layers"
+            for k in path)
+        start = 1 if (stacked and len(dims) > 1) else 0
+        best, best_i = 0, None
+        for i in range(start, len(dims)):
+            if dims[i] % dp == 0 and dims[i] > best:
+                best, best_i = dims[i], i
+        if best_i is None:
+            return P()
+        entries = [None] * len(dims)
+        entries[best_i] = axis
+        return P(*entries)
+
+    return jax.tree_util.tree_map_with_path(spec_for, param_shapes)
+
+
+def make_llama_fsdp_step(cfg: LlamaConfig, pmesh: ParallelMesh,
+                         optimizer: Optional[optax.GradientTransformation]
+                         = None) -> TrainStep:
+    """Fully-sharded data parallelism (ZeRO-3 class): params, grads AND
+    optimizer state all live dp-sharded; each layer's weights are
+    all-gathered just-in-time inside the scanned layer loop and the
+    gradients reduce-scatter back — per-chip param+optimizer memory is
+    1/dp of the model instead of a full replica.
+
+    TPU-native form: no hand-written collectives at all.  The step is a
+    plain ``jit`` whose sharding constraints (params sharded over dp on a
+    weight axis, batch sharded over dp) make XLA's SPMD partitioner insert
+    the per-layer all-gather/reduce-scatter pairs; because the layer
+    weights enter ``lax.scan`` as per-iteration slices, the gathers stay
+    inside the loop and only one layer is ever resident unsharded.  The
+    reference's DP (SURVEY.md §2.9) always replicates the full model; this
+    is the capability class FSDP/ZeRO-3 adds beyond it.
+
+    Composes with dp only (tp/pp/sp shard the model differently; use
+    ``make_llama_train_step`` for those, optionally with ``zero1``).
+    """
+    if (pmesh.config.tp > 1 or pmesh.config.pp > 1 or pmesh.config.sp > 1
+            or (pmesh.config.ep or 1) > 1 or cfg.n_experts > 0):
+        raise ValueError("FSDP composes with dp only — use "
+                         "make_llama_train_step for tp/pp/sp/ep meshes")
+    mesh = pmesh.mesh
+    dp = pmesh.config.dp
+    opt = optimizer if optimizer is not None else optax.adamw(3e-4)
+    par = ParallelSpec()  # no named-axis collectives — GSPMD does it all
+    param_shapes = jax.eval_shape(
+        partial(llama_mod.init_params, cfg, tp=1), jax.random.PRNGKey(0))
+    pspec_tree = fsdp_param_specs(param_shapes, dp)
+    param_sharding = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), pspec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+    opt_state_shape = jax.eval_shape(lambda p: opt.init(p), param_shapes)
+    opt_specs = opt_state_partition_specs(
+        opt_state_shape, param_shapes, pspec_tree)
+    opt_sharding = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), opt_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    data_spec = P("dp")
+
+    def loss_fn(params, tokens, targets):
+        return llama_mod.loss_fn(params, tokens, targets, cfg, par)
+
+    def _step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        # pin grads to the param sharding: XLA turns the gradient
+        # all-reduce into reduce-scatter + sharded update (ZeRO's trick)
+        grads = lax.with_sharding_constraint(grads, param_sharding)
+        opt_state = lax.with_sharding_constraint(opt_state, opt_sharding)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        params = lax.with_sharding_constraint(params, param_sharding)
+        return params, opt_state, loss
+
+    step_fn = jax.jit(_step, donate_argnums=(0, 1))
+
+    def init_fn(rng):
+        params = jax.jit(
+            partial(llama_mod.init_params, cfg, tp=1),
+            out_shardings=param_sharding)(rng)
+        opt_state = jax.jit(opt.init, out_shardings=opt_sharding)(params)
         return params, opt_state
 
     return TrainStep(step_fn=step_fn, init_fn=init_fn, par=par, mesh=mesh,

@@ -179,3 +179,60 @@ def test_zero1_shards_opt_state_over_dp(hvd):
     # 1/8th of the full buffer per device
     assert (mu_embed.addressable_shards[0].data.size
             == mu_embed.size // 8)
+
+
+def test_remat_skip_layers_matches_baseline(baseline_sgd, hvd):
+    """Partial remat changes memory layout only, never the math."""
+    cfg_s = dataclasses.replace(CFG, remat=True, remat_skip_layers=1)
+    got = run_steps(cfg_s, MeshConfig(2, 1, 2, 2), sgd=True)
+    np.testing.assert_allclose(got, baseline_sgd, atol=1e-4)
+
+
+def test_fsdp_matches_baseline(baseline_sgd, hvd):
+    """FSDP (ZeRO-3 class) training is the same global math as replicated
+    DP — sharding params/grads/opt-state over dp is layout, not numerics."""
+    pmesh = ParallelMesh(MeshConfig(8, 1, 1, 1))
+    ts = training.make_llama_fsdp_step(CFG, pmesh,
+                                       optimizer=optax.sgd(0.05))
+    params, opt_state = ts.init_fn(jax.random.PRNGKey(0))
+    sh = training.make_data_sharding(ts)
+    toks, tgts = jax.device_put(TOKS, sh), jax.device_put(TGTS, sh)
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = ts.step_fn(params, opt_state, toks, tgts)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, baseline_sgd, atol=1e-4)
+    # params are genuinely sharded: largest leaves hold 1/8 per device
+    wq = params["layers"]["wq"]
+    assert "dp" in tuple(wq.sharding.spec), wq.sharding.spec
+    assert wq.addressable_shards[0].data.size == wq.size // 8
+
+
+def test_fsdp_rejects_model_parallel_meshes(hvd):
+    with pytest.raises(ValueError, match="dp only"):
+        training.make_llama_fsdp_step(CFG, ParallelMesh(MeshConfig(2, 1, 1, 2)))
+
+
+def test_zero1_with_aliased_ep_moe(hvd):
+    """Regression: expert weights already sharded over dp (ep aliased)
+    must not gain a second dp entry in their optimizer-state spec."""
+    cfg = dataclasses.replace(CFG, n_experts=4, expert_top_k=2,
+                              capacity_factor=2.0)
+    base = run_steps(cfg, MeshConfig(1, 1, 1, 1), sgd=True)
+    got = run_steps(cfg, MeshConfig(4, 1, 1, 2), sgd=True, zero1=True)
+    np.testing.assert_allclose(got, base, atol=5e-2)
+
+
+def test_fsdp_specs_shard_embed_axis0(hvd):
+    """Non-stacked leaves may shard axis 0: with d_model indivisible by
+    dp, embed [V, D] must still shard over V instead of replicating."""
+    import jax as _jax
+    shapes = {
+        "embed": _jax.ShapeDtypeStruct((64, 6), jnp.float32),
+        "layers": {"wq": _jax.ShapeDtypeStruct((2, 6, 8), jnp.float32)},
+    }
+    specs = training.fsdp_param_specs(shapes, dp=8)
+    from jax.sharding import PartitionSpec as P
+    assert specs["embed"] == P("dp", None), specs["embed"]
+    # stacked leaf: axis 0 excluded (scan dim), shards the 8-wide axis
+    assert specs["layers"]["wq"] == P(None, None, "dp")
