@@ -45,18 +45,27 @@ def main():
     p.add_argument("--num-warmup", type=int, default=2)
     p.add_argument("--num-iters", type=int, default=5)
     p.add_argument("--attn", default="ring", choices=["ring", "ulysses"])
+    p.add_argument("--zero1", action="store_true",
+                   help="shard optimizer state over dp (ZeRO-1)")
+    p.add_argument("--fsdp", action="store_true",
+                   help="fully-sharded DP (ZeRO-3); dp-only meshes")
+    p.add_argument("--loss-chunk", type=int, default=0)
     args = p.parse_args()
 
     hvd.init()
     n_chips = jax.local_device_count()
     dp = args.dp or max(1, n_chips // (args.tp * args.sp * args.pp))
     mc = MeshConfig(dp=dp, tp=args.tp, sp=args.sp, pp=args.pp)
-    cfg = llama.LlamaConfig(**PRESETS[args.preset])
+    cfg = llama.LlamaConfig(**PRESETS[args.preset],
+                            loss_chunk=args.loss_chunk)
     seq = args.seq_len or cfg.max_seq_len
     pmesh = ParallelMesh(mc)
-    ts = training.make_llama_train_step(
-        cfg, pmesh, attn=args.attn,
-        n_microbatches=2 * args.pp if args.pp > 1 else 0)
+    if args.fsdp:
+        ts = training.make_llama_fsdp_step(cfg, pmesh)
+    else:
+        ts = training.make_llama_train_step(
+            cfg, pmesh, attn=args.attn, zero1=args.zero1,
+            n_microbatches=2 * args.pp if args.pp > 1 else 0)
     params, opt_state = ts.init_fn(jax.random.PRNGKey(0))
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
 

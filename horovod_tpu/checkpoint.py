@@ -46,8 +46,12 @@ def restore(path: str, like: Any, step: Optional[int] = None) -> Any:
     if step is not None:
         path = os.path.join(path, str(step))
     ckptr = ocp.StandardCheckpointer()
+    # carry the exemplar's shardings through: a ZeRO/FSDP state restored
+    # without them would materialize fully replicated and blow the HBM
+    # budget the sharding existed to fit
     abstract = jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+        lambda x: jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=getattr(x, "sharding", None))
         if hasattr(x, "shape") else x, like)
     return ckptr.restore(path, abstract)
 

@@ -53,21 +53,21 @@ def _cached_attention(x, lp, cfg: LlamaConfig, k_cache, v_cache,
     Dh = cfg.head_dim
     H = cfg.n_heads
     Hkv = cfg.n_kv_heads
+    g = H // Hkv
     q = (x @ lp["wq"].astype(x.dtype)).reshape(B, T, H, Dh)
     q = _rope(q, positions, cfg.rope_theta)
     max_len = k_cache.shape[1]
-    g = H // Hkv
-    # [B, max_len, Hkv, D] -> [B, max_len, H, D] (GQA repeat)
-    k = jnp.repeat(k_cache, g, axis=2)
-    v = jnp.repeat(v_cache, g, axis=2)
-    scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * (Dh ** -0.5)
-    slot = jnp.arange(max_len)[None, None, None, :]        # cache position
-    qpos = positions[:, None, :, None]                     # query position
-    mask = slot <= qpos                                    # causal + bounds
-    scores = jnp.where(mask, scores, -1e30)
+    # grouped GQA einsum against the un-repeated cache (same head
+    # mapping as ring_attention._block_attend — repeating the cache
+    # would g× the HBM traffic of this bandwidth-bound phase)
+    qg = q.reshape(B, T, Hkv, g, Dh).astype(jnp.float32)
+    scores = jnp.einsum("bthgd,bshd->bhgts", qg,
+                        k_cache.astype(jnp.float32)) * (Dh ** -0.5)
+    slot = jnp.arange(max_len)[None, None, None, None, :]  # cache position
+    qpos = positions[:, None, None, :, None]               # query position
+    scores = jnp.where(slot <= qpos, scores, -1e30)        # causal+bounds
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    o = jnp.einsum("bhts,bshd->bthd", probs, v)
+    o = jnp.einsum("bhgts,bshd->bthgd", probs, v_cache)
     return o.reshape(B, T, H * Dh) @ lp["wo"].astype(x.dtype)
 
 
@@ -92,6 +92,10 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: KVCache):
     Returns ``(logits [B, T, V], new_cache)``.  Serves both phases:
     prefill (T = prompt length, cache.length == 0) and decode (T == 1).
     """
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "KV-cache generation supports dense models only (MoE routing "
+            "in the decode loop is not implemented yet)")
     par = ParallelSpec()  # decode path is single-shard per replica
     B, T = tokens.shape
     start = cache.length
@@ -117,29 +121,55 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: KVCache):
     return logits, KVCache(k_new, v_new, start + T)
 
 
-def greedy_generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
-                    max_len: Optional[int] = None):
-    """Greedy decode: prefill the prompt, then scan one token at a time.
+def _select(logits, rng, temperature: float, top_k: int):
+    """One sampling decision per batch row.  temperature==0 → greedy."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
-    ``prompt``: [B, T_prompt] int32.  Returns [B, max_new_tokens] of
-    generated ids.  One jit-compiled program end to end.
+
+def generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
+             max_len: Optional[int] = None, temperature: float = 0.0,
+             top_k: int = 0, rng=None):
+    """Autoregressive decode: prefill the prompt, then scan one token at
+    a time through the cache.
+
+    ``prompt``: [B, T_prompt] int32.  Returns [B, max_new_tokens] ids.
+    ``temperature=0`` is greedy; otherwise softmax sampling at the given
+    temperature, optionally truncated to the ``top_k`` highest logits.
+    One jit-compiled program end to end.
     """
     B, Tp = prompt.shape
     max_len = max_len or (Tp + max_new_tokens)
     if Tp + max_new_tokens > max_len:
         raise ValueError(f"max_len={max_len} < prompt {Tp} + new "
                          f"{max_new_tokens}")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng key")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
     cache = init_kv_cache(cfg, B, max_len)
     logits, cache = forward_with_cache(params, prompt, cfg, cache)
-    next_tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+    rng, sub = jax.random.split(rng)
+    next_tok = _select(logits[:, -1, :], sub, temperature, top_k)
 
     def step(carry, _):
-        cache, tok = carry
+        cache, tok, rng = carry
         logits, cache = forward_with_cache(params, tok[:, None], cfg,
                                            cache)
-        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-        return (cache, nxt), tok
+        rng, sub = jax.random.split(rng)
+        nxt = _select(logits[:, -1, :], sub, temperature, top_k)
+        return (cache, nxt, rng), tok
 
-    (_, _), toks = lax.scan(step, (cache, next_tok), None,
-                            length=max_new_tokens)
+    (_, _, _), toks = lax.scan(step, (cache, next_tok, rng), None,
+                               length=max_new_tokens)
     return jnp.moveaxis(toks, 0, 1)  # [B, max_new]
+
+
+def greedy_generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
+                    max_len: Optional[int] = None):
+    """Greedy decode (temperature-0 :func:`generate`)."""
+    return generate(params, cfg, prompt, max_new_tokens, max_len=max_len)

@@ -303,10 +303,6 @@ def hidden(params, tokens, cfg: LlamaConfig, par: ParallelSpec,
 
     if par.pp_axis is not None:
         from ..parallel.pipeline import pipeline_apply
-        if cfg.n_experts > 0:
-            raise NotImplementedError(
-                "pipeline + MoE is not supported yet (the pipeline wire "
-                "format is shape-preserving and cannot carry aux losses)")
         if n_microbatches <= 0:
             raise ValueError("pipeline parallelism needs n_microbatches > 0")
         B = h.shape[0]
@@ -321,11 +317,12 @@ def hidden(params, tokens, cfg: LlamaConfig, par: ParallelSpec,
                   ).astype(jnp.int32) * jnp.ones((mb, 1), jnp.int32)
 
         def stage_fn(stage_layers, x):
-            y, _aux = _layer_stack(x, stage_layers, cfg, par, pos_mb)
-            return y
+            return _layer_stack(x, stage_layers, cfg, par, pos_mb)
 
-        out = pipeline_apply(stage_fn, params["layers"], h_mb,
-                             axis_name=par.pp_axis)
+        # the MoE aux loss rides the pipeline's per-stage accumulator,
+        # not the shape-preserving inter-stage wire
+        out, aux = pipeline_apply(stage_fn, params["layers"], h_mb,
+                                  axis_name=par.pp_axis, with_aux=True)
         h = out.reshape(B, Tl, cfg.d_model)
     else:
         h, aux = _layer_stack(h, params["layers"], cfg, par, positions)

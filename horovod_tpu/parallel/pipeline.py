@@ -20,13 +20,17 @@ from jax import lax
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
-                   axis_name: str = "pp"):
+                   axis_name: str = "pp", with_aux: bool = False):
     """Run ``microbatches`` through a pipeline of identical-signature stages.
 
     Args:
       stage_fn: ``f(stage_params, x) -> y`` with ``y.shape == x.shape``
         (the transformer-block case; stages must be shape-preserving so the
-        inter-stage wire format is fixed).
+        inter-stage wire format is fixed).  With ``with_aux=True``:
+        ``f(stage_params, x) -> (y, aux_scalar)`` — the per-stage scalar
+        (e.g. a MoE load-balance loss) is accumulated over *live* ticks
+        only and summed across stages, so it never needs to ride the
+        inter-stage wire.
       stage_params: this shard's stage parameters (use spec ``P('pp')`` on
         the stacked leading dim outside, so each shard sees its own stage;
         pass the already-unstacked local pytree here).
@@ -34,28 +38,43 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
         across pp shards).
       axis_name: the pipeline mesh axis.
 
-    Returns ``[n_micro, mb, ...]`` outputs, replicated across pp shards.
+    Returns ``[n_micro, mb, ...]`` outputs replicated across pp shards —
+    with ``with_aux``, ``(outputs, aux_total)``.
     """
     n_stages = lax.axis_size(axis_name)
+
+    def run(stage_params, x):
+        out = stage_fn(stage_params, x)
+        return out if with_aux else (out, jnp.float32(0.0))
+
+    n_micro = microbatches.shape[0]
     if n_stages == 1:
-        return jax.vmap(lambda x: stage_fn(stage_params, x))(microbatches)
+        out, auxes = jax.vmap(
+            lambda x: run(stage_params, x))(microbatches)
+        # MEAN over microbatches: the aux (load-balance fractions) is
+        # scale-free, so each microbatch contributes ~the full-batch
+        # value — summing would scale the coefficient by n_micro
+        return (out, auxes.sum() / n_micro) if with_aux else out
 
     stage = lax.axis_index(axis_name)
-    n_micro = microbatches.shape[0]
     total_ticks = n_micro + n_stages - 1
-    mb_shape = microbatches.shape[1:]
     # send stage s → s+1 (no wraparound: last stage's send is discarded)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def tick(carry, t):
-        incoming, outputs = carry
+        incoming, outputs, aux_total = carry
         # stage 0 injects microbatch t (clamped during drain ticks);
         # later stages consume what arrived from the previous stage
         mb_idx = jnp.clip(t, 0, n_micro - 1)
         first_in = lax.dynamic_index_in_dim(
             microbatches, mb_idx, axis=0, keepdims=False)
         x = jnp.where(stage == 0, first_in, incoming)
-        y = stage_fn(stage_params, x)
+        y, aux = run(stage_params, x)
+        # stage s processes microbatch t-s at tick t; fill/drain ticks run
+        # on clamped garbage and must not contribute aux (or its grads)
+        live_here = jnp.logical_and(t >= stage, t - stage < n_micro)
+        aux_total = aux_total + jnp.where(live_here,
+                                          aux.astype(jnp.float32), 0.0)
         # last stage retires microbatch t-(n_stages-1) (ignored while <0)
         out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
         live = t - (n_stages - 1) >= 0
@@ -65,7 +84,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
         outputs = lax.dynamic_update_index_in_dim(outputs, retired,
                                                   out_idx, 0)
         incoming = lax.ppermute(y, axis_name, perm)
-        return (incoming, outputs), None
+        return (incoming, outputs, aux_total), None
 
     from .vma import as_varying
     # derive carries from the inputs (×0) so they inherit the inputs'
@@ -73,9 +92,16 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
     exemplar = jax.tree_util.tree_leaves(stage_params)[0]
     incoming0 = as_varying(microbatches[0] * 0, axis_name, like=exemplar)
     outputs0 = as_varying(microbatches * 0, axis_name, like=exemplar)
-    (_, outputs), _ = lax.scan(tick, (incoming0, outputs0),
-                               jnp.arange(total_ticks))
+    aux0 = (incoming0.astype(jnp.float32) * 0).sum()
+    (_, outputs, aux_total), _ = lax.scan(
+        tick, (incoming0, outputs0, aux0), jnp.arange(total_ticks))
     # outputs live on the last stage; replicate so every pp shard returns
-    # the same value (mask-and-psum broadcast over the pp ring)
+    # the same value (mask-and-psum broadcast over the pp ring); each
+    # stage's aux covers its own layers, so the total is the plain psum
     mask = (stage == n_stages - 1).astype(outputs.dtype)
-    return lax.psum(outputs * mask, axis_name)
+    outputs = lax.psum(outputs * mask, axis_name)
+    if with_aux:
+        # psum over stages (each stage's own layers), MEAN over
+        # microbatches (scale-free aux — see the n_stages==1 path)
+        return outputs, lax.psum(aux_total, axis_name) / n_micro
+    return outputs

@@ -93,3 +93,27 @@ def test_checkpoint_save_restore_roundtrip(hvd, tmp_path):
     np.testing.assert_allclose(np.asarray(restored["w"]),
                                np.asarray(tree["w"]))
     assert int(restored["step"]) == 7
+
+
+def test_checkpoint_preserves_fsdp_shardings(hvd, tmp_path):
+    """Restoring a dp-sharded (FSDP/ZeRO) state must come back SHARDED —
+    an unsharded restore would replicate buffers the sharding existed to
+    split."""
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu import checkpoint, training
+    from horovod_tpu.models import llama
+    from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
+
+    cfg = llama.tiny(vocab=64, seq=32)
+    pmesh = ParallelMesh(MeshConfig(8, 1, 1, 1))
+    ts = training.make_llama_fsdp_step(cfg, pmesh)
+    params, _ = ts.init_fn(jax.random.PRNGKey(0))
+    path = str(tmp_path / "fsdp_ckpt")
+    checkpoint.save(path, params)
+    restored = checkpoint.restore(path, params)
+    wq = restored["layers"]["wq"]
+    assert wq.sharding == params["layers"]["wq"].sharding
+    assert wq.addressable_shards[0].data.size == wq.size // 8
+    np.testing.assert_allclose(np.asarray(wq),
+                               np.asarray(params["layers"]["wq"]))

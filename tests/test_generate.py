@@ -65,3 +65,32 @@ def test_generate_rejects_overflow(hvd):
         assert "max_len" in str(e)
     else:
         raise AssertionError("expected ValueError")
+
+
+def test_sampled_generate_respects_top_k(hvd):
+    """top_k=1 sampling at any temperature IS greedy; and sampling is
+    reproducible under a fixed key."""
+    params = _params()
+    rng = np.random.RandomState(2)
+    prompt = jnp.asarray(rng.randint(0, 64, (2, 4)), jnp.int32)
+    greedy = generate.greedy_generate(params, CFG, prompt, 5)
+    top1 = generate.generate(params, CFG, prompt, 5, temperature=0.7,
+                             top_k=1, rng=jax.random.PRNGKey(5))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(top1))
+    s1 = generate.generate(params, CFG, prompt, 5, temperature=1.0,
+                           rng=jax.random.PRNGKey(9))
+    s2 = generate.generate(params, CFG, prompt, 5, temperature=1.0,
+                           rng=jax.random.PRNGKey(9))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    assert int(s1.min()) >= 0 and int(s1.max()) < 64
+
+
+def test_sampling_requires_rng(hvd):
+    params = _params()
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    try:
+        generate.generate(params, CFG, prompt, 2, temperature=0.5)
+    except ValueError as e:
+        assert "rng" in str(e)
+    else:
+        raise AssertionError("expected ValueError")

@@ -108,10 +108,28 @@ def test_moe_dedicated_ep_axis_sgd(hvd):
     np.testing.assert_allclose(ded, base, atol=5e-2)
 
 
-def test_moe_pipeline_rejected(hvd):
-    cfg = dataclasses.replace(CFG, n_experts=4)
-    with pytest.raises(Exception, match="pipeline \\+ MoE"):
-        run_steps(cfg, MeshConfig(1, 2, 1, 1), n_microbatches=2)
+def test_moe_pipeline_tracks_baseline(hvd):
+    """MoE composed with pipeline parallelism: the aux load-balance loss
+    rides the per-stage accumulator (live ticks only), so pp training
+    tracks the single-shard baseline like every other MoE layout."""
+    cfg = dataclasses.replace(CFG, n_experts=4, expert_top_k=2,
+                              capacity_factor=2.0)
+    base = run_steps(cfg, MeshConfig(1, 1, 1, 1))
+    got = run_steps(cfg, MeshConfig(2, 2, 1, 1), n_microbatches=2)
+    np.testing.assert_allclose(got, base, atol=5e-2)
+
+
+def test_moe_pipeline_aux_invariant_to_microbatch_count(hvd):
+    """Regression: the aux term must be a MEAN over microbatches — with
+    a deliberately large coefficient, the first-step loss may not scale
+    with n_microbatches."""
+    cfg = dataclasses.replace(CFG, n_experts=4, expert_top_k=2,
+                              capacity_factor=2.0, aux_loss_coef=1.0)
+    l2 = run_steps(cfg, MeshConfig(1, 2, 1, 1), steps=1,
+                   n_microbatches=2)[0]
+    l4 = run_steps(cfg, MeshConfig(1, 2, 1, 1), steps=1,
+                   n_microbatches=4)[0]
+    assert abs(l2 - l4) < 0.15, (l2, l4)
 
 
 def test_param_count_llama3_8b():
