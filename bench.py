@@ -98,11 +98,20 @@ def main():
     on_cpu = jax.devices()[0].platform == "cpu"
     # ~1B-param geometry: head_dim 128 keeps the flash kernel's score
     # matmuls at the MXU's full 128-wide contraction; full remat trades
-    # recompute FLOPs for the HBM that lets adamw master state fit
+    # recompute FLOPs for the HBM that lets adamw master state fit.
+    # Env knobs (default off — flip only on measured wins):
+    #   HOROVOD_BENCH_LOSS_CHUNK  chunked vocab cross-entropy
+    #   HOROVOD_BENCH_REMAT_SKIP  last-k layers un-remat'd
+    #   HOROVOD_BENCH_OPT=lp      bf16-moment AdamW
+    #   HOROVOD_BENCH_FUSED_XENT  fused Pallas cross-entropy kernel
     cfg = llama.LlamaConfig(
         vocab_size=32768, d_model=2048, n_layers=16, n_heads=16,
         n_kv_heads=8, d_ff=8192, max_seq_len=1024, remat=True,
-        remat_policy="full")
+        remat_policy="full",
+        loss_chunk=int(os.environ.get("HOROVOD_BENCH_LOSS_CHUNK", "0")),
+        remat_skip_layers=int(
+            os.environ.get("HOROVOD_BENCH_REMAT_SKIP", "0")),
+        fused_xent=os.environ.get("HOROVOD_BENCH_FUSED_XENT") == "1")
     batch, seq, steps = 8, 1024, 30
     if on_cpu:  # keep the CPU fallback path quick
         cfg = dataclasses.replace(cfg, d_model=256, n_layers=4, n_heads=8,
@@ -111,7 +120,11 @@ def main():
 
     n_chips = jax.local_device_count()
     pmesh = ParallelMesh(MeshConfig(dp=n_chips, pp=1, sp=1, tp=1))
-    opt = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
+    if os.environ.get("HOROVOD_BENCH_OPT") == "lp":
+        from horovod_tpu.optim.precision import adamw_lp
+        opt = adamw_lp(3e-4)
+    else:
+        opt = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
     ts = training.make_llama_train_step(cfg, pmesh, optimizer=opt)
     params, opt_state = ts.init_fn(jax.random.PRNGKey(0))
     rng = np.random.RandomState(0)

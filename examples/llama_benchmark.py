@@ -61,6 +61,10 @@ def main():
     seq = args.seq_len or cfg.max_seq_len
     pmesh = ParallelMesh(mc)
     if args.fsdp:
+        if args.zero1 or args.attn != "ring" or args.tp > 1 \
+                or args.sp > 1 or args.pp > 1:
+            p.error("--fsdp composes with dp only; drop "
+                    "--zero1/--attn/--tp/--sp/--pp")
         ts = training.make_llama_fsdp_step(cfg, pmesh)
     else:
         ts = training.make_llama_train_step(

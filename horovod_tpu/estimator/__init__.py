@@ -10,8 +10,11 @@ the role of Spark's barrier-mode tasks.
 """
 
 from .keras_estimator import KerasEstimator, KerasModel  # noqa: F401
+from .lightning_estimator import (  # noqa: F401
+    LightningEstimator, LightningModelWrapper)
 from .store import FilesystemStore, LocalStore, Store  # noqa: F401
 from .torch_estimator import TorchEstimator, TorchModel  # noqa: F401
 
 __all__ = ["Store", "LocalStore", "FilesystemStore", "TorchEstimator",
-           "TorchModel", "KerasEstimator", "KerasModel"]
+           "TorchModel", "KerasEstimator", "KerasModel",
+           "LightningEstimator", "LightningModelWrapper"]

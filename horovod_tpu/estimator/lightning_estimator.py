@@ -1,0 +1,153 @@
+"""LightningEstimator: the reference's third estimator flavor.
+
+Reference parity: ``horovod/spark/lightning/estimator.py`` (SURVEY.md
+§2.2 — Keras/Torch/Lightning estimators).  PyTorch Lightning is not in
+the TPU image, so this is a gated adapter: with ``lightning`` (or
+``pytorch_lightning``) importable it trains a ``LightningModule`` over
+the launcher tier by driving the module's own ``training_step`` /
+``configure_optimizers`` contract through the torch adapter; without it,
+construction raises a clear ImportError naming the missing dependency —
+the same graceful-absence contract as the MXNet binding.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .store import Store
+
+
+def _lightning_module_cls():
+    try:
+        import lightning  # noqa: F401
+        return lightning.LightningModule
+    except ImportError:
+        try:
+            import pytorch_lightning  # noqa: F401
+            return pytorch_lightning.LightningModule
+        except ImportError:
+            return None
+
+
+def _first_optimizer(ret):
+    """Normalize configure_optimizers()'s documented return forms:
+    a single optimizer, a list/tuple of optimizers, an
+    ``([optimizers], [schedulers])`` pair, or a dict with an
+    ``"optimizer"`` key.  Schedulers are dropped (the estimator drives
+    fixed-epoch training)."""
+    if isinstance(ret, dict):
+        ret = ret["optimizer"]
+    if isinstance(ret, (list, tuple)):
+        first = ret[0]
+        if isinstance(first, (list, tuple)):
+            first = first[0]
+        ret = first
+    return ret
+
+
+def _train_on_worker(model_bytes, X, y, epochs, batch_size, seed):
+    """Runs on every launched worker (cloudpickled)."""
+    import io
+
+    import numpy as np
+    import torch
+    import horovod_tpu.torch as hvd
+
+    rank, nproc = hvd.cross_rank(), hvd.cross_size()
+    module = torch.load(io.BytesIO(model_bytes), weights_only=False)
+    opt = _first_optimizer(module.configure_optimizers())
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=module.named_parameters())
+    hvd.broadcast_parameters(module.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+    Xs = torch.from_numpy(np.ascontiguousarray(X[rank::nproc]))
+    ys = torch.from_numpy(np.ascontiguousarray(y[rank::nproc]))
+    g = torch.Generator().manual_seed(seed + rank)
+    module.train()
+    for _ in range(epochs):
+        order = torch.randperm(len(Xs), generator=g)
+        for i in range(0, len(Xs) - batch_size + 1, batch_size):
+            idx = order[i:i + batch_size]
+            opt.zero_grad()
+            loss = module.training_step((Xs[idx], ys[idx]), i // batch_size)
+            if isinstance(loss, dict):
+                loss = loss["loss"]
+            loss.backward()
+            opt.step()
+
+    if rank == 0:
+        buf = io.BytesIO()
+        torch.save(module, buf)
+        return buf.getvalue()
+    return None
+
+
+class LightningEstimator:
+    """sklearn-style fit/predict around a ``LightningModule``.
+
+    Drives the module's ``training_step``/``configure_optimizers``
+    contract on ``num_proc`` launched workers with data-parallel
+    gradient reduction; rank 0's fitted module comes back for
+    ``predict``.  Requires PyTorch Lightning — absent, ``__init__``
+    raises ImportError immediately (fail at construction, not at fit).
+    """
+
+    def __init__(self, model, num_proc: int = 2, epochs: int = 1,
+                 batch_size: int = 32, store: Optional[Store] = None,
+                 seed: int = 0, env: Optional[dict] = None,
+                 port: int = 0):
+        lm = _lightning_module_cls()
+        if lm is None:
+            raise ImportError(
+                "LightningEstimator needs `lightning` or "
+                "`pytorch_lightning`, neither of which is installed. "
+                "Use TorchEstimator for plain torch modules.")
+        if not isinstance(model, lm):
+            raise TypeError(f"model must be a LightningModule, got "
+                            f"{type(model).__name__}")
+        self.model = model
+        self.num_proc = num_proc
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.store = store
+        self.seed = seed
+        self.env = env
+        self.port = port
+
+    def fit(self, X: Sequence, y: Sequence) -> "LightningModelWrapper":
+        import io
+
+        import torch
+
+        from ..runner import api as runner_api
+
+        buf = io.BytesIO()
+        torch.save(self.model, buf)
+        extra = {} if self.port == 0 else {"port": self.port}
+        results = runner_api.run(
+            _train_on_worker,
+            args=(buf.getvalue(), np.asarray(X), np.asarray(y),
+                  self.epochs, self.batch_size, self.seed),
+            np=self.num_proc, env=self.env, **extra)
+        fitted_bytes = next(r for r in results if r is not None)
+        if self.store is not None:
+            run_id = f"lightning-{uuid.uuid4().hex[:8]}"
+            self.store.save_checkpoint(run_id, fitted_bytes)
+        fitted = torch.load(io.BytesIO(fitted_bytes), weights_only=False)
+        return LightningModelWrapper(fitted)
+
+
+class LightningModelWrapper:
+    def __init__(self, module: Any):
+        self.module = module
+
+    def predict(self, X) -> np.ndarray:
+        import torch
+        self.module.eval()
+        with torch.no_grad():
+            out = self.module(torch.from_numpy(np.asarray(X)))
+        return out.numpy()

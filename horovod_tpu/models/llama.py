@@ -64,6 +64,11 @@ class LlamaConfig:
     # skipped recompute.  Spend freed memory here: each skipped layer
     # saves one forward-recompute of itself in the backward pass.
     remat_skip_layers: int = 0
+    # fused Pallas cross-entropy (ops/fused_xent.py): head matmul +
+    # online softmax in one kernel, logits never exist beyond a VMEM
+    # tile.  Opt-in; falls back to loss_chunk / one-shot when the
+    # kernel does not support the shape/backend.
+    fused_xent: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -378,16 +383,22 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig, par: ParallelSpec,
     """Mean next-token cross-entropy over local tokens plus the MoE
     load-balance auxiliary loss (caller pmeans over dp/sp axes)."""
     h, aux = hidden(params, tokens, cfg, par, n_microbatches)
-    if cfg.loss_chunk > 0 and h.shape[1] % cfg.loss_chunk:
+    loss = None
+    if cfg.fused_xent:
+        from ..ops import fused_xent
+        if fused_xent.supported(h, params["embed"], targets):
+            loss = fused_xent.fused_xent_mean(h, params["embed"], targets)
+    if loss is None and cfg.loss_chunk > 0 and h.shape[1] % cfg.loss_chunk:
         import logging
         logging.getLogger("horovod_tpu").warning(
             "loss_chunk=%d does not divide the local sequence length %d "
             "(sp sharding?); falling back to one-shot cross-entropy — "
             "the full [B, T, V] logits WILL be materialized",
             cfg.loss_chunk, h.shape[1])
-    if cfg.loss_chunk > 0 and h.shape[1] % cfg.loss_chunk == 0:
+    if loss is None and cfg.loss_chunk > 0 \
+            and h.shape[1] % cfg.loss_chunk == 0:
         loss = _chunked_xent(h, params["embed"], targets, cfg.loss_chunk)
-    else:
+    if loss is None:
         logits = h @ params["embed"].T.astype(h.dtype)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
