@@ -50,6 +50,8 @@ def main():
     p.add_argument("--fsdp", action="store_true",
                    help="fully-sharded DP (ZeRO-3); dp-only meshes")
     p.add_argument("--loss-chunk", type=int, default=0)
+    p.add_argument("--vocab-parallel", action="store_true",
+                   help="shard the tied embedding's vocab axis over tp")
     args = p.parse_args()
 
     hvd.init()
@@ -57,7 +59,8 @@ def main():
     dp = args.dp or max(1, n_chips // (args.tp * args.sp * args.pp))
     mc = MeshConfig(dp=dp, tp=args.tp, sp=args.sp, pp=args.pp)
     cfg = llama.LlamaConfig(**PRESETS[args.preset],
-                            loss_chunk=args.loss_chunk)
+                            loss_chunk=args.loss_chunk,
+                            vocab_parallel=args.vocab_parallel)
     seq = args.seq_len or cfg.max_seq_len
     pmesh = ParallelMesh(mc)
     if args.fsdp:

@@ -1,0 +1,64 @@
+"""Shared data-parallel training loop for the estimator workers.
+
+Runs inside processes launched by ``runner.run`` (cloudpickled callers).
+Both the Torch and Lightning estimators drive this loop; they differ
+only in how a batch becomes a loss (``loss_of_batch``) and in what they
+serialize back.
+
+The per-epoch step count is the GLOBAL MINIMUM of every rank's batch
+count (``X[rank::nproc]`` shards differ by up to one sample): each
+``opt.step()`` issues gradient all-reduces, so ranks must take exactly
+the same number of steps or the collectives desynchronize — one rank's
+spare step would pair with another's next epoch, and the final epoch
+would hang on a collective nobody else joins.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+
+def run_data_parallel_training(model, optimizer,
+                               loss_of_batch: Callable,
+                               X, y, epochs: int, batch_size: int,
+                               seed: int, shuffle: bool = True
+                               ) -> List[float]:
+    """Train ``model`` data-parallel; returns per-epoch averaged losses.
+
+    ``loss_of_batch(model, xb, yb) -> scalar torch loss``.
+    """
+    import numpy as np
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    rank, nproc = hvd.cross_rank(), hvd.cross_size()
+    opt = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+    Xs = torch.from_numpy(np.ascontiguousarray(X[rank::nproc]))
+    ys = torch.from_numpy(np.ascontiguousarray(y[rank::nproc]))
+    gen = torch.Generator().manual_seed(seed + rank)
+    steps_per_epoch = int(hvd.allreduce(
+        torch.tensor(float(len(Xs) // batch_size)), op=hvd.Min,
+        name="estimator.steps_per_epoch"))
+
+    history: List[float] = []
+    for _ in range(epochs):
+        order = (torch.randperm(len(Xs), generator=gen) if shuffle
+                 else torch.arange(len(Xs)))
+        epoch_loss = 0.0
+        for s in range(steps_per_epoch):
+            idx = order[s * batch_size:(s + 1) * batch_size]
+            opt.zero_grad()
+            loss = loss_of_batch(model, Xs[idx], ys[idx])
+            loss.backward()
+            opt.step()
+            epoch_loss += float(loss.detach())
+        avg = hvd.allreduce(
+            torch.tensor(epoch_loss / max(steps_per_epoch, 1)),
+            name="estimator.epoch_loss")
+        history.append(float(avg))
+    return history

@@ -33,18 +33,13 @@ def _lightning_module_cls():
 
 
 def _first_optimizer(ret):
-    """Normalize configure_optimizers()'s documented return forms:
-    a single optimizer, a list/tuple of optimizers, an
-    ``([optimizers], [schedulers])`` pair, or a dict with an
-    ``"optimizer"`` key.  Schedulers are dropped (the estimator drives
-    fixed-epoch training)."""
-    if isinstance(ret, dict):
-        ret = ret["optimizer"]
-    if isinstance(ret, (list, tuple)):
-        first = ret[0]
-        if isinstance(first, (list, tuple)):
-            first = first[0]
-        ret = first
+    """Normalize configure_optimizers()'s documented return forms —
+    a single optimizer, a list/tuple of optimizers, a list of config
+    dicts, an ``([optimizers], [schedulers])`` pair, or a dict with an
+    ``"optimizer"`` key — down to the first optimizer.  Schedulers are
+    dropped (the estimator drives fixed-epoch training)."""
+    while isinstance(ret, (dict, list, tuple)):
+        ret = ret["optimizer"] if isinstance(ret, dict) else ret[0]
     return ret
 
 
@@ -52,34 +47,22 @@ def _train_on_worker(model_bytes, X, y, epochs, batch_size, seed):
     """Runs on every launched worker (cloudpickled)."""
     import io
 
-    import numpy as np
     import torch
     import horovod_tpu.torch as hvd
 
-    rank, nproc = hvd.cross_rank(), hvd.cross_size()
     module = torch.load(io.BytesIO(model_bytes), weights_only=False)
-    opt = _first_optimizer(module.configure_optimizers())
-    opt = hvd.DistributedOptimizer(
-        opt, named_parameters=module.named_parameters())
-    hvd.broadcast_parameters(module.state_dict(), root_rank=0)
-    hvd.broadcast_optimizer_state(opt, root_rank=0)
-
-    Xs = torch.from_numpy(np.ascontiguousarray(X[rank::nproc]))
-    ys = torch.from_numpy(np.ascontiguousarray(y[rank::nproc]))
-    g = torch.Generator().manual_seed(seed + rank)
     module.train()
-    for _ in range(epochs):
-        order = torch.randperm(len(Xs), generator=g)
-        for i in range(0, len(Xs) - batch_size + 1, batch_size):
-            idx = order[i:i + batch_size]
-            opt.zero_grad()
-            loss = module.training_step((Xs[idx], ys[idx]), i // batch_size)
-            if isinstance(loss, dict):
-                loss = loss["loss"]
-            loss.backward()
-            opt.step()
 
-    if rank == 0:
+    def loss_of_batch(m, xb, yb):
+        out = m.training_step((xb, yb), 0)
+        return out["loss"] if isinstance(out, dict) else out
+
+    from ._worker import run_data_parallel_training
+    run_data_parallel_training(
+        module, _first_optimizer(module.configure_optimizers()),
+        loss_of_batch, X, y, epochs, batch_size, seed)
+
+    if hvd.cross_rank() == 0:
         buf = io.BytesIO()
         torch.save(module, buf)
         return buf.getvalue()

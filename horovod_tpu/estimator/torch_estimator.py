@@ -31,33 +31,13 @@ def _train_on_worker(model_bytes, opt_factory, loss_fn, X, y, epochs,
     import torch
     import horovod_tpu.torch as hvd
 
-    rank, nproc = hvd.cross_rank(), hvd.cross_size()
+    rank = hvd.cross_rank()
     model = torch.load(io.BytesIO(model_bytes), weights_only=False)
-    opt = opt_factory(model.parameters())
-    opt = hvd.DistributedOptimizer(
-        opt, named_parameters=model.named_parameters())
-    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
-    hvd.broadcast_optimizer_state(opt, root_rank=0)
-
-    Xs = torch.from_numpy(np.ascontiguousarray(X[rank::nproc]))
-    ys = torch.from_numpy(np.ascontiguousarray(y[rank::nproc]))
-    gen = torch.Generator().manual_seed(seed + rank)
-    history: List[float] = []
-    for _ in range(epochs):
-        order = (torch.randperm(len(Xs), generator=gen)
-                 if shuffle else torch.arange(len(Xs)))
-        epoch_loss, steps = 0.0, 0
-        for i in range(0, len(Xs) - batch_size + 1, batch_size):
-            idx = order[i:i + batch_size]
-            opt.zero_grad()
-            loss = loss_fn(model(Xs[idx]), ys[idx])
-            loss.backward()
-            opt.step()
-            epoch_loss += float(loss.detach())
-            steps += 1
-        avg = hvd.allreduce(
-            torch.tensor(epoch_loss / max(steps, 1)), name="epoch_loss")
-        history.append(float(avg))
+    from ._worker import run_data_parallel_training
+    history = run_data_parallel_training(
+        model, opt_factory(model.parameters()),
+        lambda m, xb, yb: loss_fn(m(xb), yb),
+        X, y, epochs, batch_size, seed, shuffle)
     buf = io.BytesIO()
     torch.save(model.state_dict(), buf)
     return {"state_dict": buf.getvalue() if rank == 0 else None,

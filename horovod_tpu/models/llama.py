@@ -69,6 +69,13 @@ class LlamaConfig:
     # tile.  Opt-in; falls back to loss_chunk / one-shot when the
     # kernel does not support the shape/backend.
     fused_xent: bool = False
+    # vocab-parallel embedding/head (megatron VocabParallelEmbedding):
+    # shards the tied embedding's vocab axis over tp — at Llama-3-8B the
+    # 0.53 GB embedding stops being replicated per tp shard.  Lookup
+    # masks out-of-shard tokens + psum; the loss reduces lse/target
+    # across shards (pmax + psum) so no full-vocab logits exist on any
+    # shard.  Ignored when tp is off.
+    vocab_parallel: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -149,6 +156,8 @@ def param_specs(par: ParallelSpec, cfg: Optional[LlamaConfig] = None):
     from jax.sharding import PartitionSpec as P
     tp = par.tp_axis
     pp = par.pp_axis
+    embed_spec = (P(tp, None) if cfg is not None and cfg.vocab_parallel
+                  and tp is not None else P())
     layers = {
         "attn_norm": P(pp, None),
         "wq": P(pp, None, tp),
@@ -172,10 +181,53 @@ def param_specs(par: ParallelSpec, cfg: Optional[LlamaConfig] = None):
             "w_down": P(pp, tp, None),
         })
     return {
-        "embed": P(),
+        "embed": embed_spec,
         "layers": layers,
         "final_norm": P(),
     }
+
+
+def _vp_active(cfg: LlamaConfig, par: ParallelSpec) -> bool:
+    return cfg.vocab_parallel and par.tp_axis is not None
+
+
+def _embed_lookup(embed, tokens, cfg: LlamaConfig, par: ParallelSpec):
+    """Token embedding; with vocab_parallel the shard holds rows
+    ``[i·V/tp, (i+1)·V/tp)`` — out-of-shard tokens contribute zero and
+    one psum over tp assembles the full rows (megatron
+    VocabParallelEmbedding forward)."""
+    w = embed.astype(cfg.dtype)
+    if not _vp_active(cfg, par):
+        return w[tokens]
+    Vl = w.shape[0]
+    off = lax.axis_index(par.tp_axis) * Vl
+    local = tokens - off
+    inside = (local >= 0) & (local < Vl)
+    rows = w[jnp.clip(local, 0, Vl - 1)]
+    rows = rows * inside[..., None].astype(w.dtype)
+    return lax.psum(rows, par.tp_axis)
+
+
+def _vocab_parallel_xent(h, embed, targets, par: ParallelSpec):
+    """Cross-entropy over a tp-sharded vocabulary: local partial logits
+    ``[B, T, V/tp]``, cross-shard pmax/psum reduction of the logsumexp
+    and a masked psum of the target logit — no shard ever sees the full
+    vocabulary row."""
+    w = embed.astype(h.dtype)
+    Vl = w.shape[0]
+    logits_l = (h @ w.T).astype(jnp.float32)          # [B, T, V/tp]
+    # the stability max carries no gradient (pmax also has no diff rule)
+    m = lax.pmax(lax.stop_gradient(logits_l).max(axis=-1), par.tp_axis)
+    sumexp = lax.psum(
+        jnp.exp(logits_l - m[..., None]).sum(axis=-1), par.tp_axis)
+    lse = m + jnp.log(sumexp)
+    off = lax.axis_index(par.tp_axis) * Vl
+    local = targets - off
+    inside = (local >= 0) & (local < Vl)
+    tgt_l = jnp.take_along_axis(
+        logits_l, jnp.clip(local, 0, Vl - 1)[..., None], axis=-1)[..., 0]
+    tgt = lax.psum(tgt_l * inside.astype(jnp.float32), par.tp_axis)
+    return (lse - tgt).mean()
 
 
 def _rmsnorm(x, w, eps):
@@ -303,7 +355,7 @@ def hidden(params, tokens, cfg: LlamaConfig, par: ParallelSpec,
               if par.sp_axis is not None else 0)
     positions = (jnp.arange(Tl)[None, :] + sp_idx * Tl
                  ).astype(jnp.int32) * jnp.ones_like(tokens)
-    h = params["embed"].astype(cfg.dtype)[tokens]
+    h = _embed_lookup(params["embed"], tokens, cfg, par)
     aux = jnp.float32(0.0)
 
     if par.pp_axis is not None:
@@ -343,6 +395,10 @@ def forward(params, tokens, cfg: LlamaConfig, par: ParallelSpec,
     # tied embedding head (Llama-3 unties; tying halves test-model memory
     # and changes no parallel structure — the head matmul stays [D, V])
     logits = h @ params["embed"].T.astype(h.dtype)
+    if _vp_active(cfg, par):
+        # local [B, T, V/tp] partials → full logits, shard order = vocab
+        # order (API contract; the loss path never materializes this)
+        logits = lax.all_gather(logits, par.tp_axis, axis=-1, tiled=True)
     return logits, aux
 
 
@@ -384,7 +440,9 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig, par: ParallelSpec,
     load-balance auxiliary loss (caller pmeans over dp/sp axes)."""
     h, aux = hidden(params, tokens, cfg, par, n_microbatches)
     loss = None
-    if cfg.fused_xent:
+    if _vp_active(cfg, par):
+        loss = _vocab_parallel_xent(h, params["embed"], targets, par)
+    if loss is None and cfg.fused_xent:
         from ..ops import fused_xent
         if fused_xent.supported(h, params["embed"], targets):
             loss = fused_xent.fused_xent_mean(h, params["embed"], targets)

@@ -171,3 +171,21 @@ def test_lightning_estimator_functional_with_fake_lightning(tmp_path):
         sys.path.remove(str(pkg))
         sys.modules.pop("lightning", None)
         sys.modules.pop("fake_lm_model", None)
+
+
+def test_torch_estimator_uneven_shards(tmp_path):
+    """Regression: 127 samples over 2 workers gives 64/63-sample shards
+    (2 vs 1 batches at bs=32); the per-epoch step count must be the
+    global minimum or the per-step allreduces desynchronize and the fit
+    hangs."""
+    X, y = _regression_data(n=127)
+    torch.manual_seed(0)
+    model = torch.nn.Linear(4, 1)
+    est = TorchEstimator(
+        model=model, optimizer=lambda p: torch.optim.SGD(p, lr=0.05),
+        loss=F.mse_loss, epochs=3, batch_size=32, np=2,
+        store=FilesystemStore(str(tmp_path)), run_id="uneven",
+        env=_env(), port=29612)
+    fitted = est.fit(X, y)
+    assert len(fitted.history) == 3
+    assert fitted.predict(X).shape == (127, 1)
