@@ -25,7 +25,9 @@ def run_data_parallel_training(model, optimizer,
                                ) -> List[float]:
     """Train ``model`` data-parallel; returns per-epoch averaged losses.
 
-    ``loss_of_batch(model, xb, yb) -> scalar torch loss``.
+    ``loss_of_batch(model, xb, yb, step_idx) -> scalar torch loss``
+    (``step_idx`` is the within-epoch batch index — Lightning's
+    ``training_step`` contract receives it).
     """
     import numpy as np
     import torch
@@ -53,7 +55,7 @@ def run_data_parallel_training(model, optimizer,
         for s in range(steps_per_epoch):
             idx = order[s * batch_size:(s + 1) * batch_size]
             opt.zero_grad()
-            loss = loss_of_batch(model, Xs[idx], ys[idx])
+            loss = loss_of_batch(model, Xs[idx], ys[idx], s)
             loss.backward()
             opt.step()
             epoch_loss += float(loss.detach())

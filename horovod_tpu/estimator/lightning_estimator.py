@@ -53,8 +53,8 @@ def _train_on_worker(model_bytes, X, y, epochs, batch_size, seed):
     module = torch.load(io.BytesIO(model_bytes), weights_only=False)
     module.train()
 
-    def loss_of_batch(m, xb, yb):
-        out = m.training_step((xb, yb), 0)
+    def loss_of_batch(m, xb, yb, step_idx):
+        out = m.training_step((xb, yb), step_idx)
         return out["loss"] if isinstance(out, dict) else out
 
     from ._worker import run_data_parallel_training

@@ -36,7 +36,7 @@ def _train_on_worker(model_bytes, opt_factory, loss_fn, X, y, epochs,
     from ._worker import run_data_parallel_training
     history = run_data_parallel_training(
         model, opt_factory(model.parameters()),
-        lambda m, xb, yb: loss_fn(m(xb), yb),
+        lambda m, xb, yb, _s: loss_fn(m(xb), yb),
         X, y, epochs, batch_size, seed, shuffle)
     buf = io.BytesIO()
     torch.save(model.state_dict(), buf)

@@ -208,14 +208,13 @@ def _embed_lookup(embed, tokens, cfg: LlamaConfig, par: ParallelSpec):
     return lax.psum(rows, par.tp_axis)
 
 
-def _vocab_parallel_xent(h, embed, targets, par: ParallelSpec):
-    """Cross-entropy over a tp-sharded vocabulary: local partial logits
-    ``[B, T, V/tp]``, cross-shard pmax/psum reduction of the logsumexp
-    and a masked psum of the target logit — no shard ever sees the full
-    vocabulary row."""
-    w = embed.astype(h.dtype)
+def _vp_chunk_losses(h, w, targets, par: ParallelSpec):
+    """Sum of ``lse - target_logit`` over one sequence chunk against a
+    tp-sharded vocabulary: local partial logits ``[B, c, V/tp]``,
+    cross-shard pmax/psum of the logsumexp and a masked psum of the
+    target logit — no shard ever sees a full vocabulary row."""
     Vl = w.shape[0]
-    logits_l = (h @ w.T).astype(jnp.float32)          # [B, T, V/tp]
+    logits_l = (h @ w.T).astype(jnp.float32)          # [B, c, V/tp]
     # the stability max carries no gradient (pmax also has no diff rule)
     m = lax.pmax(lax.stop_gradient(logits_l).max(axis=-1), par.tp_axis)
     sumexp = lax.psum(
@@ -227,7 +226,31 @@ def _vocab_parallel_xent(h, embed, targets, par: ParallelSpec):
     tgt_l = jnp.take_along_axis(
         logits_l, jnp.clip(local, 0, Vl - 1)[..., None], axis=-1)[..., 0]
     tgt = lax.psum(tgt_l * inside.astype(jnp.float32), par.tp_axis)
-    return (lse - tgt).mean()
+    return (lse - tgt).sum()
+
+
+def _vocab_parallel_xent(h, embed, targets, par: ParallelSpec,
+                         chunk: int = 0):
+    """Mean cross-entropy over a tp-sharded vocabulary; with ``chunk``
+    dividing the local sequence, the ``[B, T, V/tp]`` partial logits are
+    additionally tiled over sequence chunks with per-chunk backward
+    recompute (``loss_chunk`` composed with vocab parallelism)."""
+    w = embed.astype(h.dtype)
+    B, T, D = h.shape
+    if chunk <= 0 or T % chunk:
+        return _vp_chunk_losses(h, w, targets, par) / (B * T)
+    n = T // chunk
+    hs = jnp.moveaxis(h.reshape(B, n, chunk, D), 1, 0)
+    ts = jnp.moveaxis(targets.reshape(B, n, chunk), 1, 0)
+
+    @jax.checkpoint
+    def body(acc, xt):
+        hc, tc = xt
+        return acc + _vp_chunk_losses(hc, w, tc, par), None
+
+    acc0 = (h.astype(jnp.float32) * 0).sum()
+    total, _ = lax.scan(body, acc0, (hs, ts))
+    return total / (B * T)
 
 
 def _rmsnorm(x, w, eps):
@@ -441,7 +464,8 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig, par: ParallelSpec,
     h, aux = hidden(params, tokens, cfg, par, n_microbatches)
     loss = None
     if _vp_active(cfg, par):
-        loss = _vocab_parallel_xent(h, params["embed"], targets, par)
+        loss = _vocab_parallel_xent(h, params["embed"], targets, par,
+                                    chunk=cfg.loss_chunk)
     if loss is None and cfg.fused_xent:
         from ..ops import fused_xent
         if fused_xent.supported(h, params["embed"], targets):

@@ -48,16 +48,67 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--host-discovery-script", default=None,
                    help="executable printing current 'host:slots' lines; "
                         "enables elastic mode")
+    p.add_argument("--check-build", action="store_true",
+                   help="print framework/feature availability and exit "
+                        "(reference: horovodrun --check-build)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="the training command, e.g. python train.py")
     args = p.parse_args(argv)
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
+    if args.check_build:
+        return args
     if not args.command:
         p.error("no command given")
     if args.np is None and not args.host_discovery_script:
         p.error("-np is required (or use --host-discovery-script)")
     return args
+
+
+def check_build(out=None) -> int:
+    """Print the feature matrix (reference: ``horovodrun --check-build``
+    lists built frameworks/controllers/ops)."""
+    out = out or sys.stdout
+
+    def probe(fn):
+        try:
+            return bool(fn())
+        except Exception:  # noqa: BLE001 - availability probe
+            return False
+
+    def has_module(name):
+        import importlib.util
+        return importlib.util.find_spec(name) is not None
+
+    def native_built():
+        from ..native import loader
+        return loader.load() is not None
+
+    def flash_ok():
+        from jax.experimental import pallas  # noqa: F401
+        return True
+
+    import horovod_tpu
+    checks = [
+        ("JAX (XLA collectives data plane)", lambda: has_module("jax")),
+        ("Torch adapter", lambda: has_module("torch")),
+        ("TensorFlow adapter", lambda: has_module("tensorflow")),
+        ("Keras callbacks", lambda: has_module("tensorflow")),
+        ("MXNet adapter", lambda: has_module("mxnet")),
+        ("Native C++ core (_hvd_core)", native_built),
+        ("Pallas kernels (flash attention, fused xent)", flash_ok),
+        ("Elastic training", lambda: has_module("horovod_tpu.elastic")),
+        ("Estimators (Torch/Keras)",
+         lambda: has_module("horovod_tpu.estimator")),
+        ("Lightning estimator", lambda: has_module("lightning")
+         or has_module("pytorch_lightning")),
+    ]
+    print(f"horovod_tpu v{horovod_tpu.__version__}:", file=out)
+    print("\nAvailable features:", file=out)
+    for name, fn in checks:
+        mark = "X" if probe(fn) else " "
+        print(f"    [{mark}] {name}", file=out)
+    return 0
 
 
 def _coordinator_addr(hosts) -> str:
@@ -68,6 +119,8 @@ def _coordinator_addr(hosts) -> str:
 
 
 def run_launcher(args: argparse.Namespace) -> int:
+    if args.check_build:
+        return check_build()
     if args.host_discovery_script:
         from ..elastic.driver import run_elastic_launcher
         return run_elastic_launcher(args)

@@ -283,3 +283,11 @@ def test_vocab_parallel_shards_embedding(hvd):
     par = llama.ParallelSpec(tp_axis=None)
     logits, _ = llama.forward(jax.device_get(params), TOKS[:2], CFG, par)
     assert logits.shape == (2, 32, 64)
+
+
+def test_vocab_parallel_with_loss_chunk_matches_baseline(baseline_sgd, hvd):
+    """loss_chunk composes with vocab_parallel: sequence-chunked,
+    vocab-sharded loss still trains identically."""
+    cfg_vpc = dataclasses.replace(CFG, vocab_parallel=True, loss_chunk=16)
+    got = run_steps(cfg_vpc, MeshConfig(2, 1, 1, 2), sgd=True)
+    np.testing.assert_allclose(got, baseline_sgd, atol=1e-4)

@@ -156,3 +156,17 @@ def test_run_api_worker_failure_propagates():
     from horovod_tpu.runner import run
     with pytest.raises(RuntimeError, match="failed with exit code"):
         run(helpers_runner.failing_fn, np=2, env=_run_env(), port=29515)
+
+
+def test_check_build_flag(capsys):
+    """hvdrun --check-build prints the feature matrix and exits 0
+    (reference: horovodrun --check-build)."""
+    from horovod_tpu.runner import launch
+    args = launch.parse_args(["--check-build"])
+    assert args.check_build
+    rc = launch.run_launcher(args)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Available features" in out
+    assert "[X] JAX" in out
+    assert "Torch adapter" in out
