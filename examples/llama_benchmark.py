@@ -52,6 +52,8 @@ def main():
     p.add_argument("--loss-chunk", type=int, default=0)
     p.add_argument("--vocab-parallel", action="store_true",
                    help="shard the tied embedding's vocab axis over tp")
+    p.add_argument("--grad-accum", type=int, default=0,
+                   help="accumulate gradients over k in-step microbatches")
     args = p.parse_args()
 
     hvd.init()
@@ -72,6 +74,7 @@ def main():
     else:
         ts = training.make_llama_train_step(
             cfg, pmesh, attn=args.attn, zero1=args.zero1,
+            grad_accum=args.grad_accum,
             n_microbatches=2 * args.pp if args.pp > 1 else 0)
     params, opt_state = ts.init_fn(jax.random.PRNGKey(0))
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))

@@ -462,6 +462,14 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig, par: ParallelSpec,
     """Mean next-token cross-entropy over local tokens plus the MoE
     load-balance auxiliary loss (caller pmeans over dp/sp axes)."""
     h, aux = hidden(params, tokens, cfg, par, n_microbatches)
+    if cfg.loss_chunk > 0 and h.shape[1] % cfg.loss_chunk:
+        import logging
+        logging.getLogger("horovod_tpu").warning(
+            "loss_chunk=%d does not divide the local sequence length %d "
+            "(sp sharding?); falling back to one-shot cross-entropy — "
+            "the full [B, T, V%s] logits WILL be materialized",
+            cfg.loss_chunk, h.shape[1],
+            "/tp" if _vp_active(cfg, par) else "")
     loss = None
     if _vp_active(cfg, par):
         loss = _vocab_parallel_xent(h, params["embed"], targets, par,
@@ -470,13 +478,6 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig, par: ParallelSpec,
         from ..ops import fused_xent
         if fused_xent.supported(h, params["embed"], targets):
             loss = fused_xent.fused_xent_mean(h, params["embed"], targets)
-    if loss is None and cfg.loss_chunk > 0 and h.shape[1] % cfg.loss_chunk:
-        import logging
-        logging.getLogger("horovod_tpu").warning(
-            "loss_chunk=%d does not divide the local sequence length %d "
-            "(sp sharding?); falling back to one-shot cross-entropy — "
-            "the full [B, T, V] logits WILL be materialized",
-            cfg.loss_chunk, h.shape[1])
     if loss is None and cfg.loss_chunk > 0 \
             and h.shape[1] % cfg.loss_chunk == 0:
         loss = _chunked_xent(h, params["embed"], targets, cfg.loss_chunk)

@@ -82,7 +82,8 @@ def check_build(out=None) -> int:
 
     def native_built():
         from ..native import loader
-        return loader.load() is not None
+        # report-only: never kick off a compile from a status command
+        return loader.load(auto_build=False) is not None
 
     def flash_ok():
         from jax.experimental import pallas  # noqa: F401

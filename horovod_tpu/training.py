@@ -89,7 +89,8 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
                           = None,
                           attn: str = "ring",
                           n_microbatches: int = 0,
-                          zero1: bool = False) -> TrainStep:
+                          zero1: bool = False,
+                          grad_accum: int = 0) -> TrainStep:
     """Build the full data/tensor/sequence/pipeline/expert-parallel step.
 
     ``zero1=True`` additionally shards the optimizer state over the dp
@@ -98,6 +99,12 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
     — per-chip optimizer HBM drops by the dp factor.  The reference has
     no analog (its DP state is fully replicated); on TPU the all-gather
     rides ICI and overlaps with the next step's compute.
+
+    ``grad_accum=k`` accumulates gradients over k local microbatches
+    inside the compiled step (a ``lax.scan`` of fwd+bwd, one optimizer
+    update) — the jit-path form of the reference's
+    ``backward_passes_per_step`` (horovod/torch/optimizer.py), trading
+    activation memory for k× the per-step batch.
     """
     par = make_llama_parallel_spec(pmesh, attn, use_ep=cfg.n_experts > 0)
     mesh = pmesh.mesh
@@ -219,15 +226,41 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
                 loss = lax.pmean(loss, ax)
         return loss
 
+    def loss_and_grads(params, tokens, targets):
+        if grad_accum <= 1:
+            return jax.value_and_grad(local_loss)(params, tokens, targets)
+        k = grad_accum
+        B = tokens.shape[0]
+        if B % k:
+            raise ValueError(
+                f"local batch {B} not divisible by grad_accum={k}")
+        tok_mb = tokens.reshape(k, B // k, *tokens.shape[1:])
+        tgt_mb = targets.reshape(k, B // k, *targets.shape[1:])
+
+        def body(carry, xt):
+            loss_acc, g_acc = carry
+            l, g = jax.value_and_grad(local_loss)(params, xt[0], xt[1])
+            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+            return (loss_acc + l, g_acc), None
+
+        # accumulators derive from traced values so they carry the right
+        # varying mesh axes under check_vma
+        loss0 = (tokens.astype(jnp.float32) * 0).sum()
+        g0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (loss, grads), _ = lax.scan(body, (loss0, g0), (tok_mb, tgt_mb))
+        inv_k = 1.0 / k
+        return loss * inv_k, jax.tree_util.tree_map(
+            lambda g: g * jnp.asarray(inv_k, g.dtype), grads)
+
     def shard_step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(local_loss)(params, tokens, targets)
+        loss, grads = loss_and_grads(params, tokens, targets)
         grads = reduce_grads(grads)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, _mean_loss(loss)
 
     def shard_grads(params, tokens, targets):
-        loss, grads = jax.value_and_grad(local_loss)(params, tokens, targets)
+        loss, grads = loss_and_grads(params, tokens, targets)
         return _mean_loss(loss), reduce_grads(grads)
 
     opt_state_shape = jax.eval_shape(lambda p: opt.init(p), param_shapes)

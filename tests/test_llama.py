@@ -291,3 +291,17 @@ def test_vocab_parallel_with_loss_chunk_matches_baseline(baseline_sgd, hvd):
     cfg_vpc = dataclasses.replace(CFG, vocab_parallel=True, loss_chunk=16)
     got = run_steps(cfg_vpc, MeshConfig(2, 1, 1, 2), sgd=True)
     np.testing.assert_allclose(got, baseline_sgd, atol=1e-4)
+
+
+@pytest.mark.parametrize("name,mc,kw", [
+    ("accum2_dp2_tp2", MeshConfig(2, 1, 1, 2), {"grad_accum": 2}),
+    ("accum4_dp2", MeshConfig(2, 1, 1, 1), {"grad_accum": 4}),
+    ("accum2_zero1", MeshConfig(2, 1, 1, 2),
+     {"grad_accum": 2, "zero1": True}),
+])
+def test_grad_accum_matches_baseline(baseline_sgd, name, mc, kw):
+    """In-jit gradient accumulation (the jit-path backward_passes_per_step)
+    sees the same global batch in k microbatches — averaged grads equal
+    the full-batch gradient exactly."""
+    got = run_steps(CFG, mc, sgd=True, **kw)
+    np.testing.assert_allclose(got, baseline_sgd, atol=1e-4, err_msg=name)
