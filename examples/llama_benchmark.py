@@ -67,9 +67,9 @@ def main():
     pmesh = ParallelMesh(mc)
     if args.fsdp:
         if args.zero1 or args.attn != "ring" or args.tp > 1 \
-                or args.sp > 1 or args.pp > 1:
+                or args.sp > 1 or args.pp > 1 or args.grad_accum:
             p.error("--fsdp composes with dp only; drop "
-                    "--zero1/--attn/--tp/--sp/--pp")
+                    "--zero1/--attn/--tp/--sp/--pp/--grad-accum")
         ts = training.make_llama_fsdp_step(cfg, pmesh)
     else:
         ts = training.make_llama_train_step(

@@ -17,22 +17,53 @@ from typing import Any, Optional
 from . import runtime
 
 
+_async_ckptr = None
+
+
 def save(path: str, tree: Any, step: Optional[int] = None,
-         force: bool = False):
+         force: bool = False, asynchronous: bool = False):
     """Write ``tree`` durably at ``path``.
 
     Rank 0 writes (the reference idiom); every rank then meets at a
     barrier so the save-then-restore / save-then-latest_step sequence on
     other workers never races rank 0's in-flight write.
+
+    ``asynchronous=True`` returns as soon as the device→host copy is
+    done and lets orbax's background thread do the IO — training resumes
+    while bytes hit disk (call :func:`wait` before reading the files or
+    exiting).  The completion barrier moves into :func:`wait`.
     """
+    global _async_ckptr
     if runtime.rank() == 0:
         import orbax.checkpoint as ocp
+        if _async_ckptr is not None:
+            # drain the previous in-flight save first — overwriting the
+            # handle would make wait() forget the earlier checkpoint
+            _async_ckptr.wait_until_finished()
+            _async_ckptr = None
         abs_path = os.path.abspath(path)
         if step is not None:
             abs_path = os.path.join(abs_path, str(step))
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(abs_path, tree, force=force)
-        ckptr.wait_until_finished()
+        if asynchronous:
+            # StandardCheckpointer is async under the hood: save()
+            # returns after serialization; keep the handle for wait()
+            _async_ckptr = ckptr
+        else:
+            ckptr.wait_until_finished()
+    if not asynchronous:
+        from . import api
+        api.barrier()
+
+
+def wait():
+    """Block until an in-flight :func:`save(asynchronous=True)` is fully
+    durable on disk, then barrier all workers."""
+    global _async_ckptr
+    if _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
+        _async_ckptr = None
     from . import api
     api.barrier()
 

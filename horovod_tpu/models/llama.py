@@ -462,16 +462,22 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig, par: ParallelSpec,
     """Mean next-token cross-entropy over local tokens plus the MoE
     load-balance auxiliary loss (caller pmeans over dp/sp axes)."""
     h, aux = hidden(params, tokens, cfg, par, n_microbatches)
-    if cfg.loss_chunk > 0 and h.shape[1] % cfg.loss_chunk:
-        import logging
-        logging.getLogger("horovod_tpu").warning(
-            "loss_chunk=%d does not divide the local sequence length %d "
-            "(sp sharding?); falling back to one-shot cross-entropy — "
-            "the full [B, T, V%s] logits WILL be materialized",
-            cfg.loss_chunk, h.shape[1],
-            "/tp" if _vp_active(cfg, par) else "")
+
+    def warn_unchunked():
+        # only on paths that actually materialize the unchunked logits
+        # (the fused kernel never does — it must not trigger this)
+        if cfg.loss_chunk > 0 and h.shape[1] % cfg.loss_chunk:
+            import logging
+            logging.getLogger("horovod_tpu").warning(
+                "loss_chunk=%d does not divide the local sequence length "
+                "%d (sp sharding?); falling back to one-shot "
+                "cross-entropy — the full [B, T, V%s] logits WILL be "
+                "materialized", cfg.loss_chunk, h.shape[1],
+                "/tp" if _vp_active(cfg, par) else "")
+
     loss = None
     if _vp_active(cfg, par):
+        warn_unchunked()
         loss = _vocab_parallel_xent(h, params["embed"], targets, par,
                                     chunk=cfg.loss_chunk)
     if loss is None and cfg.fused_xent:
@@ -482,6 +488,7 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig, par: ParallelSpec,
             and h.shape[1] % cfg.loss_chunk == 0:
         loss = _chunked_xent(h, params["embed"], targets, cfg.loss_chunk)
     if loss is None:
+        warn_unchunked()
         logits = h @ params["embed"].T.astype(h.dtype)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

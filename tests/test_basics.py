@@ -117,3 +117,18 @@ def test_checkpoint_preserves_fsdp_shardings(hvd, tmp_path):
     assert wq.addressable_shards[0].data.size == wq.size // 8
     np.testing.assert_allclose(np.asarray(wq),
                                np.asarray(params["layers"]["wq"]))
+
+
+def test_checkpoint_async_save(hvd, tmp_path):
+    """asynchronous=True returns before durability; wait() makes the
+    checkpoint readable and is idempotent."""
+    import jax.numpy as jnp
+    from horovod_tpu import checkpoint
+    tree = {"w": jnp.arange(12.0).reshape(3, 4)}
+    path = str(tmp_path / "async_ckpt")
+    checkpoint.save(path, tree, asynchronous=True)
+    checkpoint.wait()
+    checkpoint.wait()  # idempotent
+    restored = checkpoint.restore(path, tree)
+    np.testing.assert_allclose(np.asarray(restored["w"]),
+                               np.asarray(tree["w"]))
