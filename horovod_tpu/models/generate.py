@@ -13,6 +13,12 @@ Layout notes: decode attends one query token against the full cache
 buffer with invalid (future/unwritten) positions masked to -inf — at
 decode lengths the wasted FLOPs are negligible and static shapes are
 what keeps XLA from recompiling per step.
+
+MoE models decode with local (no-ep) routing through the same
+``moe_layer`` as training.  Caveat: expert capacity is computed over the
+tokens in the call — B tokens per decode step — so capacity-bound token
+dropping can differ from a full-sequence forward; decode cannot drop
+when ``capacity = ceil(k·B/E·cf) ≥ B``, i.e. ``capacity_factor ≥ E/k``.
 """
 
 from __future__ import annotations
@@ -23,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .llama import LlamaConfig, ParallelSpec, _mlp, _rmsnorm, _rope
+from .llama import LlamaConfig, ParallelSpec, _rmsnorm, _rope, ffn
 
 
 class KVCache(NamedTuple):
@@ -92,10 +98,6 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: KVCache):
     Returns ``(logits [B, T, V], new_cache)``.  Serves both phases:
     prefill (T = prompt length, cache.length == 0) and decode (T == 1).
     """
-    if cfg.n_experts > 0:
-        raise NotImplementedError(
-            "KV-cache generation supports dense models only (MoE routing "
-            "in the decode loop is not implemented yet)")
     par = ParallelSpec()  # decode path is single-shard per replica
     B, T = tokens.shape
     start = cache.length
@@ -111,7 +113,9 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: KVCache):
         attn_in = _rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
         kc, vc = _write_kv(attn_in, lp, cfg, kc, vc, positions, start)
         h = h + _cached_attention(attn_in, lp, cfg, kc, vc, positions)
-        h = h + _mlp(_rmsnorm(h, lp["mlp_norm"], cfg.norm_eps), lp, par)
+        pre = _rmsnorm(h, lp["mlp_norm"], cfg.norm_eps)
+        y, _aux = ffn(pre, lp, cfg, par)  # local routing (no ep axis)
+        h = h + y
         return h, (kc, vc)
 
     h, (k_new, v_new) = lax.scan(scan_body, h,

@@ -304,17 +304,22 @@ def _mlp(x, lp, par: ParallelSpec):
     return out
 
 
+def ffn(pre, lp, cfg: LlamaConfig, par: ParallelSpec):
+    """The post-attention FFN sublayer: dense SwiGLU or MoE routing.
+    Returns (y, aux_loss) — the single dispatch point shared by the
+    training block and the KV-cache decode path."""
+    if cfg.n_experts > 0:
+        from .moe import moe_layer
+        return moe_layer(pre, lp, cfg, par)
+    return _mlp(pre, lp, par), jnp.float32(0.0)
+
+
 def block(x, lp, cfg: LlamaConfig, par: ParallelSpec, positions):
     """One transformer block (shape-preserving — the pipeline stage unit).
     Returns (x, aux_loss) — aux is 0 for dense MLPs."""
     x = x + _attention(_rmsnorm(x, lp["attn_norm"], cfg.norm_eps),
                        lp, cfg, par, positions)
-    pre = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-    if cfg.n_experts > 0:
-        from .moe import moe_layer
-        y, aux = moe_layer(pre, lp, cfg, par)
-    else:
-        y, aux = _mlp(pre, lp, par), jnp.float32(0.0)
+    y, aux = ffn(_rmsnorm(x, lp["mlp_norm"], cfg.norm_eps), lp, cfg, par)
     return x + y, aux
 
 

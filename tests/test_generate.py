@@ -56,6 +56,25 @@ def test_greedy_generate_matches_naive_recompute(hvd):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_moe_decode_matches_full_forward(hvd):
+    """MoE decode (local routing) matches the full forward when expert
+    capacity has headroom (no token dropping either way)."""
+    import dataclasses
+    cfg = dataclasses.replace(CFG, n_experts=4, expert_top_k=2,
+                              capacity_factor=8.0)
+    params = llama.init_params(cfg, jax.random.PRNGKey(5))
+    rng = np.random.RandomState(3)
+    toks = jnp.asarray(rng.randint(0, 64, (2, 6)), jnp.int32)
+    full_logits, _ = llama.forward(params, toks, cfg, PAR)
+    cache = generate.init_kv_cache(cfg, 2, 8)
+    pre, cache = generate.forward_with_cache(params, toks[:, :5], cfg,
+                                             cache)
+    np.testing.assert_allclose(pre, full_logits[:, :5], atol=2e-4)
+    step, cache = generate.forward_with_cache(params, toks[:, 5:6], cfg,
+                                              cache)
+    np.testing.assert_allclose(step[:, 0], full_logits[:, 5], atol=2e-3)
+
+
 def test_generate_rejects_overflow(hvd):
     params = _params()
     prompt = jnp.zeros((1, 10), jnp.int32)

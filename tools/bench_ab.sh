@@ -1,0 +1,27 @@
+#!/bin/bash
+# Serial A/B of the bench.py llama_1b config knobs on the real chip.
+# ONE TPU client at a time (the axon tunnel serializes; a killed client
+# wedges the chip — let every run exit on its own).
+#
+#   bash tools/bench_ab.sh [steps]
+#
+# Prints one JSON line per variant; highest tokens/s wins and its knobs
+# belong in BENCH defaults.
+set -u
+cd "$(dirname "$0")/.."
+run() {
+  echo "=== $* ==="
+  # NO timeout wrapper: SIGTERM/SIGKILL on a mid-claim PJRT client is
+  # exactly what wedges the tunnel (BENCH_NOTE_r03.md) — each variant
+  # runs ~5 min; babysit the sweep rather than killing clients
+  env "$@" python bench.py 2>&1 | grep -E '^\{' || echo FAILED
+}
+run HOROVOD_BENCH_NOOP=1   # plain baseline (env ignored by bench)
+run HOROVOD_BENCH_LOSS_CHUNK=1024 HOROVOD_BENCH_OPT=lp
+run HOROVOD_BENCH_LOSS_CHUNK=1024 HOROVOD_BENCH_OPT=lp HOROVOD_BENCH_REMAT_SKIP=1
+run HOROVOD_BENCH_LOSS_CHUNK=1024 HOROVOD_BENCH_OPT=lp HOROVOD_BENCH_REMAT_SKIP=2
+run HOROVOD_BENCH_FUSED_XENT=1
+run HOROVOD_BENCH_FUSED_XENT=1 HOROVOD_BENCH_OPT=lp
+run HOROVOD_BENCH_FUSED_XENT=1 HOROVOD_BENCH_OPT=lp HOROVOD_BENCH_REMAT_SKIP=1
+run HOROVOD_BENCH_MODEL=bert
+run HOROVOD_BENCH_MODEL=longctx
