@@ -54,6 +54,9 @@ def main():
                    help="shard the tied embedding's vocab axis over tp")
     p.add_argument("--grad-accum", type=int, default=0,
                    help="accumulate gradients over k in-step microbatches")
+    p.add_argument("--moe", type=int, default=0, metavar="N_EXPERTS",
+                   help="Mixtral-style MoE FFN with N experts (top-2 "
+                        "routing, expert parallelism over dp)")
     args = p.parse_args()
 
     hvd.init()
@@ -62,7 +65,8 @@ def main():
     mc = MeshConfig(dp=dp, tp=args.tp, sp=args.sp, pp=args.pp)
     cfg = llama.LlamaConfig(**PRESETS[args.preset],
                             loss_chunk=args.loss_chunk,
-                            vocab_parallel=args.vocab_parallel)
+                            vocab_parallel=args.vocab_parallel,
+                            n_experts=args.moe)
     seq = args.seq_len or cfg.max_seq_len
     pmesh = ParallelMesh(mc)
     if args.fsdp:
@@ -102,7 +106,14 @@ def main():
     dt = time.perf_counter() - t0
     if hvd.rank() == 0:
         tok_s = B * seq * args.num_iters / dt
-        step_flops = 6 * n_params * B * seq  # fwd+bwd matmul FLOPs
+        # active params per token: top-k routing executes only k of the
+        # E expert FFNs — counting all E would inflate MoE TFLOP/s ~E/k×
+        active_params = n_params
+        if args.moe:
+            per_layer_expert = 3 * cfg.d_model * cfg.d_ff
+            active_params -= (cfg.n_layers * per_layer_expert
+                              * (args.moe - cfg.expert_top_k))
+        step_flops = 6 * active_params * B * seq  # fwd+bwd matmul FLOPs
         print(f"loss={float(loss):.4f}  tokens/sec={tok_s:,.0f}  "
               f"tokens/sec/chip={tok_s / n_chips:,.0f}  "
               f"TFLOP/s/chip={step_flops * args.num_iters / dt / n_chips / 1e12:.1f}")
