@@ -111,8 +111,8 @@ def main():
         active_params = n_params
         if args.moe:
             per_layer_expert = 3 * cfg.d_model * cfg.d_ff
-            active_params -= (cfg.n_layers * per_layer_expert
-                              * (args.moe - cfg.expert_top_k))
+            inactive = max(0, args.moe - cfg.expert_top_k)
+            active_params -= cfg.n_layers * per_layer_expert * inactive
         step_flops = 6 * active_params * B * seq  # fwd+bwd matmul FLOPs
         print(f"loss={float(loss):.4f}  tokens/sec={tok_s:,.0f}  "
               f"tokens/sec/chip={tok_s / n_chips:,.0f}  "

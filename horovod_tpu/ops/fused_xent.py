@@ -47,6 +47,29 @@ NEG_INF = -1e30
 _INTERPRET = False  # flipped by tests to run kernels on CPU
 
 
+def _extra_vma(x, like):
+    """Mesh axes ``like`` varies over that ``x`` does not (empty when
+    the vma type system is unavailable)."""
+    try:
+        return tuple(sorted(jax.typeof(like).vma - jax.typeof(x).vma))
+    except (AttributeError, TypeError):
+        return ()
+
+
+def _match_vma(x, like):
+    """pvary ``x`` up to ``like``'s varying mesh axes: ops inside the
+    kernel require operands with matching vma sets, and the replicated
+    embedding must join the activations' axes (free — pvary is a
+    type-level cast for replicated values)."""
+    extra = _extra_vma(x, like)
+    if not extra:
+        return x
+    try:
+        return lax.pcast(x, extra, to="varying")
+    except (AttributeError, ValueError):  # older jax spells it pvary
+        return lax.pvary(x, extra)
+
+
 def _blocks(n_rows: int, vocab: int):
     br = next((b for b in (256, 128, 64, 32, 16, 8) if n_rows % b == 0),
               None)
@@ -110,6 +133,7 @@ def _xent_fwd(h, w, y_blocked, br, bv):
     N, D = h.shape
     V = w.shape[0]
     nr, nv = N // br, V // bv
+    w = _match_vma(w, h)
     m, l, tgt = pl.pallas_call(
         functools.partial(_fwd_kernel, bv=bv),
         grid=(nr, nv),
@@ -183,6 +207,7 @@ def _xent_bwd_kernels(h, w, y_blocked, lse, br, bv):
     N, D = h.shape
     V = w.shape[0]
     nr, nv = N // br, V // bv
+    w = _match_vma(w, h)
 
     dh32 = pl.pallas_call(
         functools.partial(_dh_kernel, bv=bv),
@@ -235,7 +260,17 @@ def _xent_sum_bwd(br, bv, res, g):
     # cannot parameterize a kernel statically); integer targets get the
     # float0 zero cotangent jax requires for int primals
     dy = np.zeros(y_blocked.shape, jax.dtypes.float0)
-    return (dh32 * g).astype(h.dtype), (dw32 * g).astype(w.dtype), dy
+    dw = dw32 * g
+    # Inside shard_map the embedding is replicated over the data axes
+    # while h (and the upstream cotangent g) vary over them: the dW
+    # cotangent must carry the cross-shard psum itself — a custom_vjp IS
+    # the transpose rule, so check_vma cannot insert it for us.  psum
+    # AFTER scaling by g: Σ_shards g·dW_shard is the total gradient, and
+    # scaling after the psum would re-mark the result varying.
+    extra = _extra_vma(w, dw)
+    if extra:
+        dw = lax.psum(dw, extra)
+    return (dh32 * g).astype(h.dtype), dw.astype(w.dtype), dy
 
 
 _xent_sum.defvjp(_xent_sum_fwd, _xent_sum_bwd)

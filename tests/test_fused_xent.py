@@ -100,3 +100,29 @@ def test_llama_loss_fn_fused_path_matches(hvd):
     np.testing.assert_allclose(float(l0), float(l1), rtol=1e-5)
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5), g0, g1)
+
+
+def test_fused_xent_traces_inside_sharded_train_step(hvd, monkeypatch):
+    """The TPU path's vma contract under shard_map: abstractly trace the
+    full sharded train step with the kernel engaged (pallas abstract
+    eval carries the varying-axes types; the custom_vjp's dW psum must
+    satisfy check_vma).  eval_shape never lowers, so this validates the
+    real-hardware path from the CPU suite — the interpret-mode
+    executable path is covered by the unsharded tests above."""
+    import dataclasses
+    from horovod_tpu import training
+    from horovod_tpu.models import llama
+    from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
+
+    monkeypatch.setattr(fused_xent, "_INTERPRET", False)
+    monkeypatch.setattr(fused_xent, "supported",
+                        lambda h, w, t: h.shape[-1] % 128 == 0)
+    cfg = dataclasses.replace(llama.tiny(vocab=128, seq=32),
+                              d_model=128, fused_xent=True)
+    ts = training.make_llama_train_step(
+        cfg, ParallelMesh(MeshConfig(2, 1, 2, 2)))
+    params, opt = ts.init_fn(jax.random.PRNGKey(0))
+    toks = jnp.zeros((8, 32), jnp.int32)
+    # trace-time check_vma validation is the assertion; shapes sanity:
+    out = jax.eval_shape(ts.step_fn, params, opt, toks, toks)
+    assert out[2].shape == ()
