@@ -46,7 +46,7 @@ import json
 import logging
 import threading
 import time
-from collections import Counter
+from collections import Counter, OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -143,12 +143,26 @@ class Controller:
     def __init__(self, cfg=None, stall=None, namespace: str = "0"):
         self.stall = stall
         self.namespace = str(namespace)
+        # _lock guards quick mutable state only (seq counters, the hash
+        # cache, join flags, stats); it is NEVER held across a blocking
+        # peer wait, so user-thread entry points (set_joined, stats) stay
+        # responsive while a round waits on a slow peer.  _round_lock
+        # serializes whole negotiation rounds so per-group sequence
+        # numbers publish in order.
         self._lock = threading.RLock()
+        self._round_lock = threading.Lock()
         # per member-group round counters and steady-state caches
         self._seq: Dict[str, int] = {}
-        # (group, hash) -> sorted token list (reference: ResponseCache +
-        # CacheCoordinator bit vector)
-        self._hash_cache: Dict[Tuple[str, str], List[str]] = {}
+        # LRU set of fully-negotiated (group, cycle-hash) signatures
+        # (reference: ResponseCache + CacheCoordinator bit vector).
+        # Bounded like the reference's response_cache.cc: long-running
+        # jobs with shifting tensor sets (elastic resizes, process-set
+        # churn) must not grow it forever.  capacity <= 0 disables the
+        # steady-state fast path entirely, same convention as the
+        # engine-side ResponseCache for the one env var configuring both.
+        self._hash_cache: "OrderedDict[Tuple[str, str], None]" = OrderedDict()
+        cap = getattr(cfg, "cache_capacity", 1024) if cfg is not None else 1024
+        self._cache_capacity = int(cap)
         self.joined = False
         self._join_seq: Optional[int] = None
         self._left = False
@@ -166,6 +180,7 @@ class Controller:
         self.fast_rounds = 0
         self.full_rounds = 0
         self.tokens_deferred = 0
+        self.cache_evictions = 0
 
     # -- lifecycle -----------------------------------------------------------
     @property
@@ -202,13 +217,34 @@ class Controller:
                 self._join_seq = None
 
     def stats(self) -> dict:
-        return {
-            "rounds": self.rounds,
-            "fast_rounds": self.fast_rounds,
-            "full_rounds": self.full_rounds,
-            "tokens_deferred": self.tokens_deferred,
-            "cached_cycles": len(self._hash_cache),
-        }
+        with self._lock:
+            return {
+                "rounds": self.rounds,
+                "fast_rounds": self.fast_rounds,
+                "full_rounds": self.full_rounds,
+                "tokens_deferred": self.tokens_deferred,
+                "cached_cycles": len(self._hash_cache),
+                "cache_capacity": self._cache_capacity,
+                "cache_evictions": self.cache_evictions,
+            }
+
+    # -- steady-state cache (LRU set; caller must hold self._lock) -----------
+    def _cache_touch(self, gk: str, h: str) -> bool:
+        """True if the cycle signature is cached; refresh its recency."""
+        key = (gk, h)
+        if key in self._hash_cache:
+            self._hash_cache.move_to_end(key)
+            return True
+        return False
+
+    def _cache_put(self, gk: str, h: str):
+        if self._cache_capacity <= 0:
+            return
+        self._hash_cache[(gk, h)] = None
+        self._hash_cache.move_to_end((gk, h))
+        while len(self._hash_cache) > self._cache_capacity:
+            self._hash_cache.popitem(last=False)
+            self.cache_evictions += 1
 
     # -- the round -----------------------------------------------------------
     def negotiate(self, tokens: List[str],
@@ -222,28 +258,35 @@ class Controller:
         the property the reference's rank-0 ResponseList broadcast exists
         to provide.
         """
-        with self._lock:
-            me = jax.process_index()
-            if me not in procs:
-                raise HorovodInternalError(
-                    f"process {me} negotiating for a group it is not a "
-                    f"member of: {procs}")
-            gk = "g" + hashlib.sha1(
-                ",".join(map(str, procs)).encode()).hexdigest()[:12]
-            seq = self._seq.get(gk, 0)
-            self._seq[gk] = seq + 1
-            client = _client()
-            my_sorted = sorted(tokens)
-            h = hashlib.sha1("\n".join(my_sorted).encode()).hexdigest()
+        me = jax.process_index()
+        if me not in procs:
+            raise HorovodInternalError(
+                f"process {me} negotiating for a group it is not a "
+                f"member of: {procs}")
+        gk = "g" + hashlib.sha1(
+            ",".join(map(str, procs)).encode()).hexdigest()[:12]
+        my_sorted = sorted(tokens)
+        h = hashlib.sha1("\n".join(my_sorted).encode()).hexdigest()
+        client = _client()
 
-            if self.joined and self._join_seq is None:
-                self._join_seq = seq
+        with self._round_lock:
+            # Quick-state critical section only; the blocking peer waits
+            # below run with no lock held, so set_joined()/stats() from
+            # user threads return promptly during a slow round.
+            with self._lock:
+                seq = self._seq.get(gk, 0)
+                self._seq[gk] = seq + 1
+                if self.joined and self._join_seq is None:
+                    self._join_seq = seq
+                joined = self.joined
+                join_seq = self._join_seq
+                cached = self._cache_touch(gk, h)
 
             val: dict = {"h": h}
-            if self.joined:
+            if joined:
                 val["j"] = True
-                val["js"] = self._join_seq
-            if (gk, h) not in self._hash_cache or self.joined:
+                val["js"] = join_seq
+            if not cached or joined:
                 val["e"] = my_sorted
             _kv_set(client, self._key(gk, f"{seq}/a/{me}"),
                     json.dumps(val, separators=(",", ":")))
@@ -257,7 +300,8 @@ class Controller:
 
             joined_ps = sorted(q for q in vals if vals[q].get("j"))
             active = [q for q in procs if q not in joined_ps]
-            self.rounds += 1
+            with self._lock:
+                self.rounds += 1
 
             if not active:
                 # every process has joined: resolve join() everywhere
@@ -270,17 +314,19 @@ class Controller:
                 # steady state: identical cycles on every member.  The
                 # hash was either cached (hash-only value — the bit-vector
                 # analog) or is cached now for the next occurrence.
-                self._hash_cache[(gk, h)] = my_sorted
                 fast = all("e" not in vals[q] for q in active)
-                if fast:
-                    self.fast_rounds += 1
-                else:
-                    self.full_rounds += 1
+                with self._lock:
+                    self._cache_put(gk, h)
+                    if fast:
+                        self.fast_rounds += 1
+                    else:
+                        self.full_rounds += 1
                 self._cleanup(client, gk, seq, me)
                 return NegotiationResult(counts=Counter(tokens), fast=fast)
 
             # mismatch (or join in progress): full request lists needed.
-            self.full_rounds += 1
+            with self._lock:
+                self.full_rounds += 1
             full: Dict[int, List[str]] = {}
             if "e" not in val:
                 _kv_set(client, self._key(gk, f"{seq}/b/{me}"),
@@ -339,7 +385,8 @@ class Controller:
 
         counts, missing, deferred = self._decide_counts(
             full, active, counters, all_tokens)
-        self.tokens_deferred += deferred
+        with self._lock:
+            self.tokens_deferred += deferred
 
         if self.stall is not None:
             for name, lagging in missing.items():
@@ -349,7 +396,8 @@ class Controller:
         if not missing and not joined_ps:
             my_sorted = sorted(full[me])
             h = hashlib.sha1("\n".join(my_sorted).encode()).hexdigest()
-            self._hash_cache[(gk, h)] = my_sorted
+            with self._lock:
+                self._cache_put(gk, h)
 
         last = -1
         if joined_ps:

@@ -268,3 +268,25 @@ def join_uneven_fn():
         sums.append(float(np.asarray(out)[0]))
     last = hvd.join()
     return {"rank": r, "sums": sums, "last_joiner": last}
+
+
+def cache_eviction_fn():
+    """HOROVOD_CACHE_CAPACITY bounds the controller's steady-state hash
+    cache (reference: response_cache.cc is an LRU for the same reason):
+    more distinct cycle signatures than capacity evict the oldest, and an
+    evicted signature still negotiates correctly when it recurs."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    for name in ("sig_a", "sig_b", "sig_c", "sig_d"):
+        hvd.allreduce(np.full((2,), float(r + 1), np.float32), name=name,
+                      op=hvd.Sum)
+    # sig_a has been evicted by now; re-running it must still be correct
+    out = hvd.allreduce(np.full((2,), float(r + 1), np.float32),
+                        name="sig_a", op=hvd.Sum)
+    stats = hvd.runtime._state().engine.stats()["negotiation"]
+    return {"rank": r, "sum": np.asarray(out).tolist(),
+            "cached": stats["cached_cycles"],
+            "evictions": stats["cache_evictions"],
+            "capacity": stats["cache_capacity"]}

@@ -165,3 +165,117 @@ def test_barrier_holds_early_process():
     assert by_rank[0]["waited"] > 0.5   # held for the late process
     assert by_rank[1]["waited"] < 0.5   # straggler passes straight through
     assert all(r["sum"] == 1.0 for r in results)
+
+
+def test_hash_cache_lru_eviction_cross_process():
+    """VERDICT r3 #4: the controller's steady-state hash cache is an LRU
+    bounded by HOROVOD_CACHE_CAPACITY (reference: response_cache.cc);
+    driving more distinct cycle signatures than capacity keeps the cache
+    bounded, counts evictions, and an evicted signature still reduces
+    correctly when it recurs."""
+    results = run(helpers_runner.cache_eviction_fn, np=2,
+                  env=_env({"HOROVOD_CACHE_CAPACITY": "2"}), port=29543)
+    for r in results:
+        assert r["sum"] == [3.0, 3.0]          # (1)+(2) both times
+        assert r["capacity"] == 2
+        assert r["cached"] <= 2                # bounded
+        assert r["evictions"] >= 1             # sig_a (at least) evicted
+
+
+def test_hash_cache_lru_bounds_and_recency():
+    """Unit-level LRU semantics: capacity enforced, eviction counter
+    advances, and recency (not insertion order) decides the victim."""
+    from horovod_tpu.ops.controller import Controller
+
+    class Cfg:
+        cache_capacity = 3
+
+    ctl = Controller(Cfg())
+    with ctl._lock:
+        for i in range(10):
+            ctl._cache_put("g", f"h{i}")
+    assert len(ctl._hash_cache) == 3
+    assert ctl.stats()["cache_evictions"] == 7
+    with ctl._lock:
+        assert ctl._cache_touch("g", "h7")     # refresh oldest survivor
+        ctl._cache_put("g", "hx")              # evicts h8, not h7
+        assert ctl._cache_touch("g", "h7")
+        assert not ctl._cache_touch("g", "h8")
+
+    class Cfg0:
+        cache_capacity = 0                     # disables the fast path
+
+    ctl0 = Controller(Cfg0())
+    with ctl0._lock:
+        ctl0._cache_put("g", "h0")
+        assert not ctl0._cache_touch("g", "h0")
+    assert len(ctl0._hash_cache) == 0
+
+
+def test_stats_and_set_joined_responsive_during_slow_round():
+    """VERDICT r3 #9: the state lock is not held across blocking peer
+    waits — set_joined() and stats() return promptly while negotiate()
+    is waiting on a slow peer, and the round still completes once the
+    peer answers."""
+    import json
+    import threading
+    import time
+
+    from horovod_tpu.ops import controller as ctl_mod
+
+    release = threading.Event()
+
+    class FakeClient:
+        def __init__(self):
+            self.kv = {}
+
+        def key_value_set(self, k, v, allow_overwrite=True):
+            self.kv[k] = v
+
+        def blocking_key_value_get(self, k, timeout_ms):
+            if "/a/1" in k:
+                if release.is_set():
+                    mine = next(v for kk, v in self.kv.items()
+                                if "/a/0" in kk)
+                    mine = json.loads(mine)
+                    return json.dumps({"h": mine["h"],
+                                       "e": mine.get("e", [])})
+                time.sleep(timeout_ms / 1000.0)
+            raise TimeoutError("deadline exceeded")
+
+        def key_value_delete(self, k):
+            self.kv.pop(k, None)
+
+    fake = FakeClient()
+    orig_client = ctl_mod._client
+    orig_pi = ctl_mod.jax.process_index
+    ctl_mod._client = lambda: fake
+    ctl_mod.jax.process_index = lambda: 0
+    try:
+        ctl = ctl_mod.Controller()
+        tok = json.dumps({"s": [["t", "allreduce", "sum", "float32", [2],
+                                 0, False, -1, 1.0, 1.0]],
+                          "r": -1, "sp": None},
+                         separators=(",", ":"), sort_keys=True)
+        out = {}
+
+        def round_thread():
+            out["res"] = ctl.negotiate([tok], (0, 1))
+
+        t = threading.Thread(target=round_thread, daemon=True)
+        t.start()
+        time.sleep(0.4)                 # round is now polling the peer
+        assert t.is_alive()
+        t0 = time.monotonic()
+        ctl.set_joined(False)
+        st = ctl.stats()
+        assert time.monotonic() - t0 < 0.2, \
+            "user-thread entry points blocked behind a negotiation round"
+        assert st["rounds"] == 0        # round not finished yet
+        release.set()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert out["res"].counts[tok] == 1
+    finally:
+        ctl_mod._client = orig_client
+        ctl_mod.jax.process_index = orig_pi
