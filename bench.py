@@ -219,5 +219,69 @@ def main():
     }))
 
 
+def _device_probe_guard(timeout_s: float) -> None:
+    """Fail fast (parseable) when the TPU tunnel is wedged.
+
+    A wedged axon terminal session lock makes the first device touch
+    block indefinitely in the claim loop (BENCH_NOTE_r03.md).  Probe
+    device init in a SUBPROCESS with a deadline; on timeout the probe is
+    left running — killing a mid-claim PJRT client is exactly what
+    wedges the tunnel, and the orphan exits cleanly on its own if the
+    terminal ever recovers — and this process prints an error JSON line
+    and exits nonzero so the driver records a failure instead of
+    hanging (and instead of SIGKILLing a mid-claim client itself).
+    """
+    import os
+    import subprocess
+
+    if os.environ.get("HOROVOD_BENCH_SKIP_PROBE") == "1":
+        return
+    # honor HOROVOD_TPU_FORCE_PLATFORM like runner/run_task.py — the
+    # axon sitecustomize overrides JAX_PLATFORMS programmatically, so a
+    # CPU-forced bench must not send its probe to the TPU claim queue
+    probe_src = (
+        "import os, jax\n"
+        "plat = os.environ.get('HOROVOD_TPU_FORCE_PLATFORM')\n"
+        "if plat:\n"
+        "    jax.config.update('jax_platforms', plat)\n"
+        "jax.devices(); print('ok')\n")
+    probe = subprocess.Popen(
+        [sys.executable, "-c", probe_src],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        start_new_session=True)
+    try:
+        out, _ = probe.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({
+            "metric": "llama_1b_train_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "tokens/s/chip",
+            "vs_baseline": 0.0,
+            "error": f"device init did not complete within {timeout_s:.0f}s "
+                     "(wedged TPU tunnel? see BENCH_NOTE_r03.md); probe "
+                     "left running to avoid a mid-claim kill",
+        }))
+        sys.exit(1)
+    if b"ok" not in out:
+        print(json.dumps({
+            "metric": "llama_1b_train_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "tokens/s/chip",
+            "vs_baseline": 0.0,
+            "error": f"device probe exited rc={probe.returncode}",
+        }))
+        sys.exit(1)
+
+
 if __name__ == "__main__":
+    import os as _os
+    # CPU-forced runs (CI, smoke tests) must never enter the TPU claim
+    # queue: the axon sitecustomize sets jax_platforms programmatically,
+    # so the env var alone is not enough (runner/run_task.py does the
+    # same for launched workers).
+    _plat = _os.environ.get("HOROVOD_TPU_FORCE_PLATFORM")
+    if _plat:
+        jax.config.update("jax_platforms", _plat)
+    _device_probe_guard(float(_os.environ.get(
+        "HOROVOD_BENCH_PROBE_TIMEOUT", "300")))
     sys.exit(main())

@@ -102,6 +102,14 @@ class ParameterManager:
         self.warmup_remaining = cfg.autotune_warmup_samples
         self.steps_per_sample = cfg.autotune_steps_per_sample
         self.max_samples = getattr(cfg, "autotune_max_samples", 20)
+        # tuned-state regression watch (reference: parameter_manager
+        # re-tunes when observed throughput regresses): a sustained score
+        # drop > retune_drop for retune_windows consecutive windows
+        # re-enters sampling instead of keeping stale parameters forever
+        self.retune_drop = getattr(cfg, "autotune_retune_drop", 0.2)
+        self.retune_windows = getattr(cfg, "autotune_retune_windows", 3)
+        self._regress_count = 0
+        self.retunes = 0
         self._gp = _GP()
         self._cycle_grid = sorted(set(_CYCLE_GRID_MS)
                                   | {float(cfg.cycle_time_ms)})
@@ -134,6 +142,7 @@ class ParameterManager:
 
     def record_cycle(self, nbytes: int, elapsed_s: float):
         if self._tuned:
+            self._watch_regression(nbytes, elapsed_s)
             return
         self._sample_bytes += nbytes
         self._sample_time += elapsed_s
@@ -174,3 +183,48 @@ class ParameterManager:
         self._sample_bytes = 0
         self._sample_time = 0.0
         self._sample_steps = 0
+
+    def _watch_regression(self, nbytes: int, elapsed_s: float):
+        """Tuned-state monitoring: keep scoring windows; a sustained drop
+        below (1 - retune_drop) x the converged score for retune_windows
+        consecutive windows means the workload shifted (sequence-length
+        change, elastic resize) — discard the stale surrogate and re-enter
+        warmup -> sample from the current point."""
+        if (self.retune_drop <= 0 or self.retune_windows <= 0
+                or self._best is None):
+            return
+        self._sample_bytes += nbytes
+        self._sample_time += elapsed_s
+        self._sample_steps += 1
+        if self._sample_steps < self.steps_per_sample:
+            return
+        score = self._sample_bytes / max(self._sample_time, 1e-9)
+        self._sample_bytes = 0
+        self._sample_time = 0.0
+        self._sample_steps = 0
+        if score < (1.0 - self.retune_drop) * self._best[1]:
+            self._regress_count += 1
+        else:
+            self._regress_count = 0
+        if self._log_file:
+            self._log_file.write(
+                f"{time.time():.3f},{self.current_fusion_threshold()},"
+                f"{self.current_cycle_time_ms():g},{score:.6g},tuned\n")
+            self._log_file.flush()
+        if self._regress_count >= self.retune_windows:
+            logger.info(
+                "autotune re-entering sampling: tuned score %.3g B/s "
+                "regressed to %.3g B/s for %d consecutive windows "
+                "(workload shift)", self._best[1], score,
+                self._regress_count)
+            self._tuned = False
+            self._gp = _GP()           # stale observations: new workload
+            self._best = None
+            self.warmup_remaining = self.cfg.autotune_warmup_samples
+            self._regress_count = 0
+            self.retunes += 1
+            if self._log_file:
+                self._log_file.write(
+                    f"{time.time():.3f},{self.current_fusion_threshold()},"
+                    f"{self.current_cycle_time_ms():g},{score:.6g},retune\n")
+                self._log_file.flush()

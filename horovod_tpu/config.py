@@ -72,6 +72,11 @@ class Config:
     autotune_warmup_samples: int = 3
     autotune_steps_per_sample: int = 10
     autotune_max_samples: int = 20
+    # tuned-state regression watch: re-enter sampling when the rolling
+    # score drops > retune_drop for retune_windows consecutive windows
+    # (0 disables). Reference: parameter_manager re-tunes on regression.
+    autotune_retune_drop: float = 0.2
+    autotune_retune_windows: int = 3
     # --- logging ---
     log_level: str = "warning"
     log_timestamp: bool = False
@@ -129,6 +134,10 @@ class Config:
             "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", c.autotune_steps_per_sample)
         c.autotune_max_samples = _env_int(
             "HOROVOD_AUTOTUNE_MAX_SAMPLES", c.autotune_max_samples)
+        c.autotune_retune_drop = _env_float(
+            "HOROVOD_AUTOTUNE_RETUNE_DROP", c.autotune_retune_drop)
+        c.autotune_retune_windows = _env_int(
+            "HOROVOD_AUTOTUNE_RETUNE_WINDOWS", c.autotune_retune_windows)
         c.log_level = _env_str("HOROVOD_LOG_LEVEL", c.log_level) or "warning"
         c.log_timestamp = _env_bool("HOROVOD_LOG_TIMESTAMP", c.log_timestamp)
         c.elastic = _env_bool("HOROVOD_ELASTIC", c.elastic)

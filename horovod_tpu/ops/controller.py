@@ -105,6 +105,10 @@ class NegotiationResult:
     all_joined: bool = False
     last_joiner: int = -1       # process index of the last process to join
     fast: bool = False          # hash-only round (response-cache steady state)
+    # tuned runtime parameters agreed this round: the lowest-indexed
+    # active member's published dict (reference: parameter_manager syncs
+    # tuned params from rank 0 via the coordinator)
+    params: Optional[dict] = None
 
 
 def entry_token(entry) -> str:
@@ -247,8 +251,8 @@ class Controller:
             self.cache_evictions += 1
 
     # -- the round -----------------------------------------------------------
-    def negotiate(self, tokens: List[str],
-                  procs: Tuple[int, ...]) -> NegotiationResult:
+    def negotiate(self, tokens: List[str], procs: Tuple[int, ...],
+                  params: Optional[dict] = None) -> NegotiationResult:
         """Run one negotiation round over ``tokens`` with the member
         ``procs`` (sorted process indices of the collective's process set).
 
@@ -257,6 +261,12 @@ class Controller:
         decision — identical on every member by construction, which is
         the property the reference's rank-0 ResponseList broadcast exists
         to provide.
+
+        ``params``, when given, is this process's view of the tuned
+        runtime parameters; every member publishes its own and the
+        decision adopts the lowest-indexed active member's (the rank-0
+        sync of the reference's parameter_manager, made cycle-exact by
+        riding the round itself so all members flip in the same cycle).
         """
         me = jax.process_index()
         if me not in procs:
@@ -286,6 +296,8 @@ class Controller:
             if joined:
                 val["j"] = True
                 val["js"] = join_seq
+            if params is not None:
+                val["p"] = params
             if not cached or joined:
                 val["e"] = my_sorted
             _kv_set(client, self._key(gk, f"{seq}/a/{me}"),
@@ -300,6 +312,11 @@ class Controller:
 
             joined_ps = sorted(q for q in vals if vals[q].get("j"))
             active = [q for q in procs if q not in joined_ps]
+            # agreed tuned params: lowest-indexed active publisher wins
+            # (identical decision on every member — same vals everywhere)
+            agreed_params = next(
+                (vals[q]["p"] for q in sorted(active) if "p" in vals[q]),
+                None)
             with self._lock:
                 self.rounds += 1
 
@@ -322,7 +339,8 @@ class Controller:
                     else:
                         self.full_rounds += 1
                 self._cleanup(client, gk, seq, me)
-                return NegotiationResult(counts=Counter(tokens), fast=fast)
+                return NegotiationResult(counts=Counter(tokens), fast=fast,
+                                         params=agreed_params)
 
             # mismatch (or join in progress): full request lists needed.
             with self._lock:
@@ -342,6 +360,7 @@ class Controller:
                                        tokens))
 
             result = self._decide(gk, full, active, joined_ps, vals, me)
+            result.params = agreed_params
             self._cleanup(client, gk, seq, me)
             return result
 

@@ -156,6 +156,9 @@ class CollectiveEngine:
         self._bytes_reduced = 0
         self._cycle_active = False
         self._cycle_started: Optional[float] = None
+        # tuned (threshold, cycle) agreed through the controller's rounds
+        # in multi-process jobs (rank-0 parameter sync)
+        self._negotiated_params: Optional[dict] = None
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
@@ -233,10 +236,15 @@ class CollectiveEngine:
 
     # -- the loop -----------------------------------------------------------
     def _cycle_time_s(self) -> float:
-        if self.autotuner is not None and not (
-                self._controller is not None and self._controller.enabled):
-            # single-process: the autotuner may be exploring cycle time
-            # (multi-process pins to config, like the fusion threshold)
+        if self.autotuner is not None:
+            if self._controller is not None and self._controller.enabled:
+                # multi-process: apply the round-negotiated parameters
+                # (rank 0's exploration) so every process batches with the
+                # same window; before the first negotiated round, config
+                if self._negotiated_params is not None:
+                    return float(self._negotiated_params["c"]) / 1000.0
+                return max(self.cfg.cycle_time_ms, 0.0) / 1000.0
+            # single-process: the autotuner explores cycle time directly
             return self.autotuner.current_cycle_time_ms() / 1000.0
         return max(self.cfg.cycle_time_ms, 0.0) / 1000.0
 
@@ -329,10 +337,29 @@ class CollectiveEngine:
             else:
                 groups.setdefault(procs, []).append(e)
         last_res = NegotiationResult()
+        all_procs = tuple(range(jax.process_count()))
         for procs in sorted(groups):
             grp = groups[procs]
             tokens = [entry_token(e) for e in grp]
-            res = ctl.negotiate(tokens, procs)
+            # autotune parameter sync rides the GLOBAL group's round: every
+            # member publishes its local tuner's view, the round adopts the
+            # lowest active rank's, and all members apply it this cycle —
+            # so the fusion plan (which must be identical across processes)
+            # follows rank 0's exploration (reference: parameter_manager
+            # rank-0 sync)
+            # Only the leader (lowest member of the global group) publishes:
+            # follower tuners never have their suggestions applied, so
+            # their state is untrained and must not become authoritative
+            # (e.g. after the leader joins in an uneven-input epoch —
+            # params then freeze at the last agreed values).
+            params = None
+            if (self.autotuner is not None and procs == all_procs
+                    and me == procs[0]):
+                params = {"t": self.autotuner.current_fusion_threshold(),
+                          "c": self.autotuner.current_cycle_time_ms()}
+            res = ctl.negotiate(tokens, procs, params=params)
+            if res.params is not None:
+                self._negotiated_params = res.params
             last_res = res
             counts = dict(res.counts)
             for e, t in zip(grp, tokens):
@@ -502,10 +529,16 @@ class CollectiveEngine:
                     except Exception:  # noqa: BLE001
                         logger.exception("handle callback failed")
 
+        nbytes = sum(s.nbytes for s in sigs)
+        self._bytes_reduced += nbytes
         if self.autotuner is not None and failed is None:
-            nbytes = sum(s.nbytes for s in sigs)
-            self._bytes_reduced += nbytes
-            self.autotuner.record_cycle(nbytes, time.monotonic() - t0)
+            # multi-process: only the leader's tuner learns — follower
+            # cycles execute under the NEGOTIATED parameters, so feeding
+            # a follower's GP would attribute those scores to local
+            # suggestions that were never applied
+            if (self._controller is None or not self._controller.enabled
+                    or jax.process_index() == 0):
+                self.autotuner.record_cycle(nbytes, time.monotonic() - t0)
         if self.stall:
             self.stall.check()
 
@@ -513,10 +546,12 @@ class CollectiveEngine:
         if self.autotuner is not None:
             if self._controller is not None and self._controller.enabled:
                 # multi-process: the plan must be identical on every
-                # process, and per-process autotuners evolve different
-                # thresholds from local timings — pin to the configured
-                # value (the reference syncs tuned params from rank 0;
-                # a negotiated-parameter round is future work)
+                # process, so all apply the parameters the negotiation
+                # round agreed (rank 0's tuner view, adopted by every
+                # member in the same cycle — the reference's rank-0
+                # parameter sync); before the first round, config
+                if self._negotiated_params is not None:
+                    return int(self._negotiated_params["t"])
                 return self.cfg.fusion_threshold_bytes
             return self.autotuner.current_fusion_threshold()
         return self.cfg.fusion_threshold_bytes
@@ -587,4 +622,12 @@ class CollectiveEngine:
         }
         if self._controller is not None:
             out["negotiation"] = self._controller.stats()
+        if self.autotuner is not None:
+            out["autotune"] = {
+                "fusion_threshold_bytes": self._fusion_threshold(),
+                "cycle_time_ms": self._cycle_time_s() * 1000.0,
+                "tuned": self.autotuner.tuned,
+                "retunes": getattr(self.autotuner, "retunes", 0),
+                "negotiated": self._negotiated_params is not None,
+            }
         return out

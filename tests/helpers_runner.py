@@ -290,3 +290,21 @@ def cache_eviction_fn():
             "cached": stats["cached_cycles"],
             "evictions": stats["cache_evictions"],
             "capacity": stats["cache_capacity"]}
+
+
+def negotiated_autotune_fn():
+    """Multi-process autotune (reference: parameter_manager rank-0 sync):
+    every process publishes its local tuner's (threshold, cycle) on the
+    global round; the round adopts rank 0's, and all processes apply the
+    agreed values in the same cycle — so the fusion plan stays identical
+    while rank 0 explores."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    for i in range(12):
+        hvd.allreduce(np.ones((64,), np.float32), name=f"g{i % 2}",
+                      op=hvd.Sum)
+    st = hvd.runtime._state().engine.stats()["autotune"]
+    return {"rank": r, "thr": st["fusion_threshold_bytes"],
+            "cyc": st["cycle_time_ms"], "negotiated": st["negotiated"]}

@@ -174,7 +174,7 @@ def test_hash_cache_lru_eviction_cross_process():
     bounded, counts evictions, and an evicted signature still reduces
     correctly when it recurs."""
     results = run(helpers_runner.cache_eviction_fn, np=2,
-                  env=_env({"HOROVOD_CACHE_CAPACITY": "2"}), port=29543)
+                  env=_env({"HOROVOD_CACHE_CAPACITY": "2"}), port=29547)
     for r in results:
         assert r["sum"] == [3.0, 3.0]          # (1)+(2) both times
         assert r["capacity"] == 2
