@@ -13,8 +13,9 @@ from .keras_estimator import KerasEstimator, KerasModel  # noqa: F401
 from .lightning_estimator import (  # noqa: F401
     LightningEstimator, LightningModelWrapper)
 from .store import FilesystemStore, LocalStore, Store  # noqa: F401
-from .torch_estimator import TorchEstimator, TorchModel  # noqa: F401
+from .torch_estimator import (  # noqa: F401
+    TorchEstimator, TorchModel, load_model)
 
 __all__ = ["Store", "LocalStore", "FilesystemStore", "TorchEstimator",
            "TorchModel", "KerasEstimator", "KerasModel",
-           "LightningEstimator", "LightningModelWrapper"]
+           "LightningEstimator", "LightningModelWrapper", "load_model"]

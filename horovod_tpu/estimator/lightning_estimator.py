@@ -58,14 +58,14 @@ def _train_on_worker(model_bytes, X, y, epochs, batch_size, seed):
         return out["loss"] if isinstance(out, dict) else out
 
     from ._worker import run_data_parallel_training
-    run_data_parallel_training(
+    history = run_data_parallel_training(
         module, _first_optimizer(module.configure_optimizers()),
         loss_of_batch, X, y, epochs, batch_size, seed)
 
     if hvd.cross_rank() == 0:
         buf = io.BytesIO()
         torch.save(module, buf)
-        return buf.getvalue()
+        return {"module": buf.getvalue(), "history": history}
     return None
 
 
@@ -116,17 +116,23 @@ class LightningEstimator:
             args=(buf.getvalue(), np.asarray(X), np.asarray(y),
                   self.epochs, self.batch_size, self.seed),
             np=self.num_proc, env=self.env, **extra)
-        fitted_bytes = next(r for r in results if r is not None)
+        fitted = next(r for r in results if r is not None)
         if self.store is not None:
             run_id = f"lightning-{uuid.uuid4().hex[:8]}"
-            self.store.save_checkpoint(run_id, fitted_bytes)
-        fitted = torch.load(io.BytesIO(fitted_bytes), weights_only=False)
-        return LightningModelWrapper(fitted)
+            self.store.save_checkpoint(run_id, fitted)
+        module = torch.load(io.BytesIO(fitted["module"]),
+                            weights_only=False)
+        return LightningModelWrapper(module, fitted["history"])
 
 
 class LightningModelWrapper:
-    def __init__(self, module: Any):
+    """Fitted module + per-epoch loss history (parity with
+    TorchModel.history — the reference's lightning estimator records
+    metrics on the returned model)."""
+
+    def __init__(self, module: Any, history: Optional[list] = None):
         self.module = module
+        self.history = list(history or [])
 
     def predict(self, X) -> np.ndarray:
         import torch

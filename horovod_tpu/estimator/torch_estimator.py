@@ -114,8 +114,14 @@ class TorchEstimator:
         fitted.load_state_dict(torch.load(
             io.BytesIO(state_bytes), weights_only=False))
         if self.store is not None:
+            # SELF-CONTAINED checkpoint: the serialized fitted module
+            # (definition + weights) rides along with the raw state dict,
+            # so load_model() needs no matching live estimator
+            # (reference: the store checkpoint is self-contained)
+            mbuf = io.BytesIO()
+            torch.save(fitted, mbuf)
             self.store.save_checkpoint(
-                self.run_id, {"state_dict": state_bytes,
+                self.run_id, {"model": mbuf.getvalue(),
                               "history": history})
         return TorchModel(fitted, history, self.run_id)
 
@@ -123,16 +129,12 @@ class TorchEstimator:
              run_id: Optional[str] = None) -> TorchModel:
         """Rehydrate a fitted model from the store (reference:
         TorchModel load from checkpoint)."""
-        import io
-        import torch
         store = store or self.store
         run_id = run_id or self.run_id
-        ckpt = store.load_checkpoint(run_id)
-        model = torch.load(
-            io.BytesIO(self._serialized_model()), weights_only=False)
-        model.load_state_dict(torch.load(
-            io.BytesIO(ckpt["state_dict"]), weights_only=False))
-        return TorchModel(model, ckpt.get("history", []), run_id)
+        # the method itself as a LAZY fallback: only legacy (state-dict-
+        # only) checkpoints pay for serializing self.model
+        return load_model(store, run_id,
+                          fallback_model_bytes=self._serialized_model)
 
     def _serialized_model(self) -> bytes:
         import io
@@ -140,3 +142,31 @@ class TorchEstimator:
         buf = io.BytesIO()
         torch.save(self.model, buf)
         return buf.getvalue()
+
+
+def load_model(store: Store, run_id: str,
+               fallback_model_bytes: Optional[Any] = None) -> TorchModel:
+    """Rehydrate a fitted :class:`TorchModel` from a store checkpoint,
+    with NO live estimator required: the checkpoint carries the model
+    definition (``"model"``).  Pre-round-4 checkpoints that hold only a
+    state dict need ``fallback_model_bytes`` — a ``torch.save``'d module
+    of the matching architecture, or a zero-arg callable returning one
+    (evaluated only on the legacy path)."""
+    import io
+    import torch
+    ckpt = store.load_checkpoint(run_id)
+    if "model" in ckpt:
+        model = torch.load(io.BytesIO(ckpt["model"]), weights_only=False)
+    elif fallback_model_bytes is not None:
+        if callable(fallback_model_bytes):
+            fallback_model_bytes = fallback_model_bytes()
+        model = torch.load(io.BytesIO(fallback_model_bytes),
+                           weights_only=False)
+        model.load_state_dict(torch.load(
+            io.BytesIO(ckpt["state_dict"]), weights_only=False))
+    else:
+        raise ValueError(
+            f"checkpoint '{run_id}' predates self-contained checkpoints "
+            f"(no serialized model); pass fallback_model_bytes or load "
+            f"through an estimator constructed with the architecture")
+    return TorchModel(model, ckpt.get("history", []), run_id)

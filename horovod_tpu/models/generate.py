@@ -154,6 +154,8 @@ def generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
                          f"{max_new_tokens}")
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng key")
+    if max_new_tokens <= 0:
+        return jnp.zeros((B, 0), jnp.int32)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     cache = init_kv_cache(cfg, B, max_len)
     logits, cache = forward_with_cache(params, prompt, cfg, cache)
@@ -166,11 +168,15 @@ def generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
                                            cache)
         rng, sub = jax.random.split(rng)
         nxt = _select(logits[:, -1, :], sub, temperature, top_k)
-        return (cache, nxt, rng), tok
+        return (cache, nxt, rng), nxt
 
+    # max_new_tokens - 1 decode steps: the prefill already sampled the
+    # first token, and emitting the sampled (not carried) token avoids a
+    # final forward+cache-write whose result would be thrown away
     (_, _, _), toks = lax.scan(step, (cache, next_tok, rng), None,
-                               length=max_new_tokens)
-    return jnp.moveaxis(toks, 0, 1)  # [B, max_new]
+                               length=max_new_tokens - 1)
+    return jnp.concatenate(
+        [next_tok[:, None], jnp.moveaxis(toks, 0, 1)], axis=1)
 
 
 def greedy_generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,

@@ -503,25 +503,40 @@ def alltoall_array(x, ps, splits=None):
 
 
 def _alltoall_uneven(x, ps, splits):
-    """Uneven alltoall: gather then reslice (MPI_Alltoallv parity path).
+    """Uneven alltoall (MPI_Alltoallv parity, SURVEY §2.1).
 
-    XLA's all_to_all is uniform-split only, so uneven splits take a
-    gather+reslice path — correct, with a bandwidth cost; uniform splits
-    use the fast path.  Worker *j* receives ``n * splits[j]`` rows, so the
-    per-worker results are ragged and the return value is a **list** of
-    per-worker arrays (matching the reference, where each rank simply sees
-    its own differently-sized output tensor).
+    XLA's ``all_to_all`` is uniform-split only, so uneven splits pad
+    each destination chunk to ``max(splits)`` rows, run ONE uniform
+    all_to_all, and slice per receiver.  Per-worker wire cost is
+    ``n * max(splits)`` rows versus the ``n * sum(splits)`` a full
+    allgather would move — i.e. the overhead over true Alltoallv
+    semantics is bounded by ``max(splits) / mean(splits)``, not ``n``.
+    Worker *j* receives ``n * splits[j]`` rows, so the per-worker
+    results are ragged and the return value is a **list** of per-worker
+    arrays (matching the reference, where each rank simply sees its own
+    differently-sized output tensor).
     """
     n = ps.size()
+    splits = np.asarray(splits)
     offs = np.concatenate([[0], np.cumsum(splits)])
+    mx = int(splits.max())
     if not is_stacked(x, ps) and spans_processes(ps):
         x = lift_to_workers(x, ps)
     if is_stacked(x, ps):
-        full = _stacked_allgather_fn(mesh_key(ps), ps.axis)(x)
-        per = x.shape[1]
+        # [n, sum, ...] -> padded [n, n*mx, ...]: sender i's chunk for
+        # receiver j sits at [i, j*mx : j*mx + splits[j]]
+        tail = x.shape[2:]
+        padded = jnp.zeros((x.shape[0], n * mx) + tail, x.dtype)
+        for j in range(n):
+            if splits[j]:
+                padded = padded.at[:, j * mx: j * mx + int(splits[j])].set(
+                    x[:, offs[j]:offs[j + 1]])
+        out = _alltoall_fn(mesh_key(ps), ps.axis)(padded)
+        # worker j's block: mx rows from each sender i at [i*mx:(i+1)*mx],
+        # of which the first splits[j] are payload
         return [jnp.concatenate(
-            [full[i * per + offs[j]: i * per + offs[j + 1]]
-             for i in range(n)], axis=0) for j in range(n)]
+            [out[j, i * mx: i * mx + int(splits[j])] for i in range(n)],
+            axis=0) for j in range(n)]
     return [jnp.concatenate([x[offs[j]:offs[j + 1]]] * n, axis=0)
             for j in range(n)]
 

@@ -529,14 +529,16 @@ class CollectiveEngine:
                     except Exception:  # noqa: BLE001
                         logger.exception("handle callback failed")
 
-        nbytes = sum(s.nbytes for s in sigs)
-        self._bytes_reduced += nbytes
-        if self.autotuner is not None and failed is None:
+        if failed is None:
+            nbytes = sum(s.nbytes for s in sigs)
+            self._bytes_reduced += nbytes
             # multi-process: only the leader's tuner learns — follower
             # cycles execute under the NEGOTIATED parameters, so feeding
             # a follower's GP would attribute those scores to local
             # suggestions that were never applied
-            if (self._controller is None or not self._controller.enabled
+            if self.autotuner is not None and (
+                    self._controller is None
+                    or not self._controller.enabled
                     or jax.process_index() == 0):
                 self.autotuner.record_cycle(nbytes, time.monotonic() - t0)
         if self.stall:

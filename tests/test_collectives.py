@@ -266,3 +266,42 @@ def test_join_and_barrier(hvd):
 def test_engine_stats(hvd):
     stats = horovod_tpu.runtime._state().engine.stats()
     assert stats["cycles"] > 0
+
+
+def test_alltoall_uneven_bounded_wire_cost(hvd, monkeypatch):
+    """VERDICT r3 #6: uneven alltoall pads each destination chunk to
+    max(splits) and runs ONE uniform all_to_all — the wire payload is
+    n*max(splits) rows per worker, not the n*sum(splits) of the old
+    allgather+reslice path.  Also covers a zero split and a 2-D tail."""
+    from horovod_tpu.ops import collectives as C
+
+    shapes = []
+    real = C._alltoall_fn
+
+    def spy(mk, axis):
+        fn = real(mk, axis)
+
+        def wrapped(x):
+            shapes.append(tuple(x.shape))
+            return fn(x)
+        return wrapped
+
+    monkeypatch.setattr(C, "_alltoall_fn", spy)
+    splits = [1, 0, 3, 1, 1, 1, 1, 1]          # sum 9, max 3
+
+    def contrib(i):
+        return np.stack([np.full((2,), 100.0 * i + r) for r in range(9)])
+
+    x = hvd.worker_values(contrib)
+    out = hvd.alltoall(x, splits=splits)
+    # one uniform all_to_all over the padded buffer: n * max(splits) rows
+    assert shapes and shapes[0][1] == 8 * 3
+    assert isinstance(out, list) and len(out) == 8
+    assert np.asarray(out[1]).shape == (0, 2)  # zero split is legal
+    offs = np.concatenate([[0], np.cumsum(splits)])
+    for j in range(8):
+        expected = np.concatenate(
+            [[[100.0 * i + r] * 2 for r in range(offs[j], offs[j + 1])]
+             for i in range(8)]) if splits[j] else np.zeros((0, 2))
+        np.testing.assert_allclose(np.asarray(out[j]),
+                                   expected.reshape(-1, 2))

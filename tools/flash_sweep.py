@@ -31,9 +31,14 @@ def main():
             out = subprocess.run(cmd, capture_output=True, text=True,
                                  timeout=600, cwd=REPO)
         except subprocess.TimeoutExpired:
-            results[blk] = "TIMEOUT (candidate hung; continuing sweep)"
-            print(f"block {blk:4d}: {results[blk]}")
-            continue
+            # the timeout just killed a mid-claim TPU client, which is
+            # exactly what wedges the axon tunnel (BENCH_NOTE_r03.md) —
+            # every later candidate would hang too; stop the sweep
+            results[blk] = "TIMEOUT"
+            print(f"block {blk:4d}: TIMEOUT — aborting sweep (killed "
+                  f"candidate likely wedged the TPU tunnel; remaining "
+                  f"candidates would hang)")
+            break
         line = next((ln for ln in out.stdout.splitlines()
                      if "tokens/s/chip" in ln), None)
         if line is None:
