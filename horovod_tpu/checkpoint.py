@@ -7,6 +7,12 @@ posture: a thin rank-0-gated wrapper over orbax for pytrees, so user
 scripts keep the familiar ``if hvd.rank() == 0: save`` idiom without
 hand-rolling the orbax incantations, and the elastic ``State`` stays the
 recovery path (restore-from-memory, not disk).
+
+Durability off the slice: orbax writes to any path the VM can reach —
+on preemptible TPU slices point ``path`` at a GCS bucket (gcsfuse
+mount, or orbax's native ``gs://`` support).  The estimator tier's
+analog is ``estimator.RemoteStore`` / ``Store.create("gs://...")``
+(reference: horovod/spark/common/store.py remote backends).
 """
 
 from __future__ import annotations

@@ -12,10 +12,11 @@ the role of Spark's barrier-mode tasks.
 from .keras_estimator import KerasEstimator, KerasModel  # noqa: F401
 from .lightning_estimator import (  # noqa: F401
     LightningEstimator, LightningModelWrapper)
-from .store import FilesystemStore, LocalStore, Store  # noqa: F401
+from .store import (  # noqa: F401
+    FilesystemStore, LocalStore, RemoteStore, Store)
 from .torch_estimator import (  # noqa: F401
     TorchEstimator, TorchModel, load_model)
 
-__all__ = ["Store", "LocalStore", "FilesystemStore", "TorchEstimator",
-           "TorchModel", "KerasEstimator", "KerasModel",
+__all__ = ["Store", "LocalStore", "FilesystemStore", "RemoteStore",
+           "TorchEstimator", "TorchModel", "KerasEstimator", "KerasModel",
            "LightningEstimator", "LightningModelWrapper", "load_model"]

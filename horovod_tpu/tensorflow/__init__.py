@@ -12,6 +12,14 @@ execute as XLA programs over the TPU mesh.  Inside ``tf.function`` the
 collective is reached through ``tf.py_function`` — the graph-compatible
 escape hatch to the engine (the reference reached its C++ core through
 registered custom ops; SURVEY §2.1 ``HorovodAllreduceOp``).
+
+XLA compilation boundary (reference: ``xla_mpi_ops.cc``): single-process
+jobs lower collectives to pure TF ops at trace time, so
+``tf.function(jit_compile=True)`` compiles them natively; multi-process
+collectives must cross the process boundary through the engine and are
+NOT XLA-compilable — the py_function op names carry
+``requires_jit_compile_False_see_docs_adapters_md`` so the XLA
+"unsupported op" error is actionable.  See docs/adapters.md.
 """
 
 from __future__ import annotations
@@ -52,6 +60,52 @@ def _eager_allreduce_np(x: np.ndarray, name: str, op: str,
     return np.asarray(out)
 
 
+# Reference: horovod/tensorflow/xla_mpi_ops.cc kept collectives alive
+# under XLA compilation via CustomCall.  The rebuild's analog has two
+# halves: (1) single-process jobs lower collectives to pure TF ops at
+# trace time — fully compilable under tf.function(jit_compile=True),
+# and XLA fuses the identity/scale arithmetic away; (2) multi-process
+# jobs must reach the cross-process engine, which cannot live inside an
+# XLA cluster, so the py_function op carries a self-documenting name —
+# the "unsupported op" error XLA raises then names the fix
+# (jit_compile=False) and the doc (docs/adapters.md) instead of a bare
+# EagerPyFunc.
+_XLA_FENCE = "requires_jit_compile_False_see_docs_adapters_md"
+
+
+def _n_workers(process_set) -> int:
+    return process_set.size() if process_set is not None else size()
+
+
+def _graph_singleproc() -> bool:
+    """Tracing a tf.function in a single-process job?  (Eager calls keep
+    the engine path for timeline/stats; multi-process always does.)"""
+    if tf.executing_eagerly():
+        return False
+    try:
+        return cross_size() == 1
+    except Exception:  # noqa: BLE001 - not initialized: engine path raises
+        return False
+
+
+def _scaled(x, factor: float):
+    return x if factor == 1.0 else x * tf.cast(factor, x.dtype)
+
+
+def _replicated_reduce(x, op, n: int):
+    """Mirror the engine's replicated-input op table
+    (ops/collectives.py _replicated_allreduce_fn): Sum scales by the
+    worker count, Product is x**n, the idempotent ops (Average, Adasum,
+    Min, Max) are identity on identical contributions."""
+    if n <= 1:
+        return x
+    if op == Sum:
+        return x * tf.cast(n, x.dtype)
+    if op == ReduceOp.PRODUCT:
+        return x ** n
+    return x
+
+
 def allreduce(tensor, average=None, name=None, op=None,
               compression=Compression.none, prescale_factor=1.0,
               postscale_factor=1.0, process_set=None):
@@ -72,11 +126,19 @@ def allreduce(tensor, average=None, name=None, op=None,
                             process_set=process_set)
         return tf.cast(reduced, wire_dtype)
 
+    if _graph_singleproc():
+        # all local workers contribute the same replicated tensor —
+        # pure TF ops, XLA-compilable under jit_compile=True
+        out = _scaled(tensor, prescale_factor)
+        out = _replicated_reduce(out, rop, _n_workers(process_set))
+        return _scaled(out, postscale_factor)
+
     def _np_op(x):
         return _eager_allreduce_np(x.numpy(), nm, rop, prescale_factor,
                                    postscale_factor, process_set)
 
-    out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype)
+    out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype,
+                         name=f"HorovodAllreduce__{_XLA_FENCE}")
     out.set_shape(tensor.shape)
     return out
 
@@ -89,6 +151,10 @@ def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
         Average if (average is None or average) else Sum)
     nm = name or "tfgrouped"
 
+    if _graph_singleproc():
+        n = _n_workers(process_set)
+        return [_replicated_reduce(t, rop, n) for t in tensors]
+
     def _np_op(*xs):
         outs = _api.grouped_allreduce([x.numpy() for x in xs],
                                       name=nm, op=rop,
@@ -96,7 +162,8 @@ def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
         return [np.asarray(o) for o in outs]
 
     outs = tf.py_function(_np_op, list(tensors),
-                          Tout=[t.dtype for t in tensors])
+                          Tout=[t.dtype for t in tensors],
+                          name=f"HorovodGroupedAllreduce__{_XLA_FENCE}")
     for o, t in zip(outs, tensors):
         o.set_shape(t.shape)
     return list(outs)
@@ -105,11 +172,16 @@ def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
 def allgather(tensor, name=None, process_set=None):
     nm = name or "tfallgather"
 
+    if _graph_singleproc():
+        # replicated allgather = worker-count copies along dim 0
+        return tf.concat([tensor] * _n_workers(process_set), axis=0)
+
     def _np_op(x):
         return np.asarray(_api.allgather(x.numpy(), name=nm,
                                          process_set=process_set))
 
-    out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype)
+    out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype,
+                         name=f"HorovodAllgather__{_XLA_FENCE}")
     shape = tensor.shape.as_list()
     if shape:
         shape[0] = None
@@ -120,11 +192,15 @@ def allgather(tensor, name=None, process_set=None):
 def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):
     nm = name or "tfbroadcast"
 
+    if _graph_singleproc():
+        return tf.identity(tensor)  # replicated: already everywhere
+
     def _np_op(x):
         return np.asarray(_api.broadcast(x.numpy(), root_rank, name=nm,
                                          process_set=process_set))
 
-    out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype)
+    out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype,
+                         name=f"HorovodBroadcast__{_XLA_FENCE}")
     out.set_shape(tensor.shape)
     return out
 
@@ -140,7 +216,8 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
             res = res[runtime.rank()]
         return np.asarray(res)
 
-    out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype)
+    out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype,
+                         name=f"HorovodAlltoall__{_XLA_FENCE}")
     return out
 
 

@@ -230,3 +230,52 @@ def test_load_model_legacy_checkpoint_contract(tmp_path):
     np.testing.assert_allclose(
         out.predict(x),
         model(torch.from_numpy(x)).detach().numpy(), atol=1e-6)
+
+
+def test_remote_store_roundtrip_and_scheme_dispatch():
+    """VERDICT r3 #5 (reference: horovod/spark/common/store.py remote
+    backends): Store.create dispatches on URL scheme; the fsspec-backed
+    RemoteStore round-trips checkpoints against a remote filesystem
+    (memory:// in tests — the gs:// path a preemptible TPU slice needs
+    is the same code with gcsfs)."""
+    from horovod_tpu.estimator import RemoteStore, Store
+
+    s = Store.create("memory://hvdtest/store1")
+    assert isinstance(s, RemoteStore)
+    assert not s.exists("runA")
+    s.save_checkpoint("runA", {"w": np.arange(4.0), "history": [1.0]})
+    assert s.exists("runA")
+    ckpt = s.load_checkpoint("runA")
+    np.testing.assert_array_equal(ckpt["w"], np.arange(4.0))
+    assert s.logs_path("runA").endswith("/logs")
+    # overwrite is atomic-ish and visible
+    s.save_checkpoint("runA", {"w": np.zeros(2)})
+    np.testing.assert_array_equal(s.load_checkpoint("runA")["w"],
+                                  np.zeros(2))
+    # scheme dispatch: bare paths and file:// stay on the filesystem
+    import tempfile
+    d = tempfile.mkdtemp()
+    assert isinstance(Store.create(d), FilesystemStore)
+    assert isinstance(Store.create("file://" + d), FilesystemStore)
+
+
+def test_torch_estimator_fit_with_remote_store(tmp_path):
+    """Estimator round-trip against the mocked remote filesystem: fit
+    checkpoints into memory:// and load_model rehydrates from it with no
+    live estimator."""
+    from horovod_tpu.estimator import Store, load_model
+
+    X, y = _regression_data(n=48)
+    torch.manual_seed(0)
+    model = torch.nn.Linear(4, 1)
+    store = Store.create("memory://hvdtest/store2")
+    est = TorchEstimator(
+        model=model, optimizer=lambda p: torch.optim.SGD(p, lr=0.05),
+        loss=F.mse_loss, epochs=2, batch_size=16, np=2,
+        store=store, run_id="rfit", env=_env(), port=29613)
+    fitted = est.fit(X, y)
+    assert store.exists("rfit")
+    standalone = load_model(store, "rfit")
+    np.testing.assert_allclose(standalone.predict(X), fitted.predict(X),
+                               atol=1e-6)
+    assert standalone.history == fitted.history
