@@ -45,9 +45,14 @@ class Store:
         ``file://`` stay on :class:`FilesystemStore`."""
         if prefix_path and "://" in prefix_path:
             scheme = prefix_path.split("://", 1)[0]
-            if scheme in ("file", "local"):
-                return FilesystemStore(prefix_path.split("://", 1)[1])
-            return RemoteStore(prefix_path, **storage_options)
+            if scheme not in ("file", "local"):
+                return RemoteStore(prefix_path, **storage_options)
+            prefix_path = prefix_path.split("://", 1)[1]
+        if storage_options:
+            raise ValueError(
+                f"storage_options {sorted(storage_options)} only apply "
+                f"to remote URLs (gs://, s3://, ...), not filesystem "
+                f"path {prefix_path!r}")
         return FilesystemStore(prefix_path)
 
 
@@ -120,13 +125,17 @@ class RemoteStore(Store):
 
     def checkpoint_path(self, run_id: str) -> str:
         # pure path computation: probes (exists) must not issue write
-        # RPCs or materialize directories for runs that never happened
-        return f"{self._root}/{run_id}/checkpoint.pkl"
+        # RPCs or materialize directories for runs that never happened.
+        # Returned WITH the protocol — the Store contract (reference:
+        # get_checkpoint_path returns full URLs) hands out paths any
+        # fsspec-aware consumer can use directly.
+        return self._fs.unstrip_protocol(
+            f"{self._root}/{run_id}/checkpoint.pkl")
 
     def logs_path(self, run_id: str) -> str:
         d = f"{self._root}/{run_id}/logs"
         self._fs.makedirs(d, exist_ok=True)
-        return d
+        return self._fs.unstrip_protocol(d)
 
     def save_checkpoint(self, run_id: str, obj: Any):
         # object stores PUT atomically per key; directory-like backends

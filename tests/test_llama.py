@@ -305,3 +305,33 @@ def test_grad_accum_matches_baseline(baseline_sgd, name, mc, kw):
     the full-batch gradient exactly."""
     got = run_steps(CFG, mc, sgd=True, **kw)
     np.testing.assert_allclose(got, baseline_sgd, atol=1e-4, err_msg=name)
+
+
+def test_llama3_8b_aot_rehearsal_subprocess():
+    """VERDICT r3 #7 (BASELINE config 4 readiness): the REAL llama3_8b
+    training step — dp16 x tp4 (v5p-128's 64 chips), vocab-parallel
+    embedding/head, ZeRO-1, bf16-moment AdamW, chunked loss, full remat
+    — AOT-lowers end to end over 64 virtual CPU devices, and the
+    per-chip HBM of the sharded train state fits v5p with headroom
+    (docs/estimators.md records the table this asserts)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)          # the script sets its own count
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "rehearse_8b.py")],
+        capture_output=True, text=True, timeout=900, env=env)
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert lines, (out.stdout[-2000:], out.stderr[-2000:])
+    r = json.loads(lines[-1])
+    assert r["ok"] and r["mesh"]["chips"] == 64
+    assert r["n_params"] > 7e9          # the real 8B geometry traced
+    assert r["stablehlo_bytes"] > 10_000
+    # sharded state + transients leave ample activation headroom on v5p
+    assert r["per_chip_gib"]["steady_plus_peak"] < 0.5 * r["v5p_hbm_gib"]

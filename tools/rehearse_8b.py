@@ -1,0 +1,122 @@
+"""AOT compile rehearsal for BASELINE config 4 (Llama-3-8B DP, v5p-128).
+
+The single tunneled chip cannot run the 8B workload, so this rehearses
+it the AOT way: build the REAL ``llama3_8b()`` training step — dp x tp
+mesh, vocab-parallel embedding/head, ZeRO-1, bf16-moment AdamW, chunked
+vocab cross-entropy, full remat — over a SIMULATED 64-chip mesh
+(v5p-128 = 64 chips) of virtual CPU devices, ``jax.jit(...).lower()``
+it end to end (trace + StableHLO emission, no executable build), and
+report the per-chip HBM the sharded train state needs, computed from
+the actual shapes and NamedShardings.
+
+Prints ONE JSON line; ``tests/test_llama.py`` runs this in a subprocess
+and asserts the contract, and docs/estimators.md records the numbers.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=64")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import dataclasses  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+
+def per_chip_bytes(tree_shapes, tree_shardings, mesh) -> int:
+    """Bytes one chip holds for ``tree_shapes`` under ``tree_shardings``
+    (a leaf's per-chip share is nbytes / prod(mesh axes in its spec))."""
+    total = 0
+    leaves_s = jax.tree_util.tree_leaves(tree_shapes)
+    leaves_p = jax.tree_util.tree_leaves(
+        tree_shardings, is_leaf=lambda x: hasattr(x, "spec"))
+    assert len(leaves_s) == len(leaves_p), (len(leaves_s), len(leaves_p))
+    for sh, nsh in zip(leaves_s, leaves_p):
+        denom = 1
+        for axes in nsh.spec:
+            if axes is None:
+                continue
+            for ax in (axes if isinstance(axes, tuple) else (axes,)):
+                denom *= mesh.shape[ax]
+        total += sh.size * sh.dtype.itemsize // denom
+    return total
+
+
+def main():
+    from horovod_tpu import training
+    from horovod_tpu.models import llama
+    from horovod_tpu.optim.precision import adamw_lp
+    from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
+
+    dp, tp = 16, 4                       # 64 chips = v5p-128
+    seq = int(os.environ.get("REHEARSE_SEQ", "4096"))
+    per_dp_batch = 1
+    cfg = dataclasses.replace(
+        llama.llama3_8b(), vocab_parallel=True, loss_chunk=1024,
+        remat=True, remat_policy="full", max_seq_len=seq)
+    pmesh = ParallelMesh(MeshConfig(dp=dp, tp=tp))
+    ts = training.make_llama_train_step(
+        cfg, pmesh, optimizer=adamw_lp(3e-4), zero1=True)
+
+    rng = jax.random.PRNGKey(0)
+    params_s, opt_s = jax.eval_shape(ts.init_fn, rng)
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params_s))
+
+    B = per_dp_batch * dp
+    tok = jax.ShapeDtypeStruct((B, seq), jnp.int32)
+    lowered = ts.step_fn.lower(params_s, opt_s, tok, tok)
+    hlo_bytes = len(lowered.as_text("stablehlo"))
+
+    # per-chip steady-state HBM from the REAL shapes + shardings:
+    # fp32 master params (tp-sharded; norms replicated) ...
+    p_bytes = per_chip_bytes(params_s, ts.param_sharding, pmesh.mesh)
+    # ... moments follow the param specs (norm moments are tp-replicated)
+    # and ZeRO-1 additionally shards them over dp; non-param-shaped
+    # leaves (step counters, scalars) are replicated
+    pdef = jax.tree_util.tree_structure(params_s)
+
+    def _is_param_tree(x):
+        try:
+            return jax.tree_util.tree_structure(x) == pdef
+        except Exception:  # noqa: BLE001 - non-pytree nodes
+            return False
+
+    o_bytes = 0
+    for sub in jax.tree_util.tree_leaves(opt_s, is_leaf=_is_param_tree):
+        if _is_param_tree(sub):
+            o_bytes += per_chip_bytes(sub, ts.param_sharding,
+                                      pmesh.mesh) // dp
+        else:
+            o_bytes += sub.size * sub.dtype.itemsize
+    # ... transient: bf16 compute copy of the tp shard + fp32 grads
+    g_bytes = p_bytes                    # fp32 grads, param-sharded
+    c_bytes = p_bytes // 2               # bf16 cast of the tp shard
+    gib = 1 << 30
+    print(json.dumps({
+        "ok": True,
+        "n_params": int(n_params),
+        "mesh": {"dp": dp, "tp": tp, "chips": dp * tp},
+        "seq": seq,
+        "global_batch": B,
+        "stablehlo_bytes": hlo_bytes,
+        "per_chip_gib": {
+            "params_fp32": round(p_bytes / gib, 2),
+            "opt_moments_bf16_zero1": round(o_bytes / gib, 2),
+            "grads_fp32_transient": round(g_bytes / gib, 2),
+            "bf16_copy_transient": round(c_bytes / gib, 2),
+            "steady_plus_peak": round(
+                (p_bytes + o_bytes + g_bytes + c_bytes) / gib, 2),
+        },
+        "v5p_hbm_gib": 95,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
