@@ -5,13 +5,16 @@ the reference runs Bayesian optimization (Gaussian-process surrogate) over
 fusion-threshold AND cycle-time, scoring candidates by observed throughput,
 with warmup → sampling → tuned phases, logging to ``HOROVOD_AUTOTUNE_LOG``.
 
-TPU redesign: the same two parameters matter — the fusion threshold
-(bucket size of the flatten-concat-psum) and the background cycle time
-(batching window for eager submissions).  The search is a 2-D
-Gaussian-process expected-improvement loop over (log2 threshold,
-cycle-time index), same phases and logging as the reference, implemented
-with numpy (the reference vendored Eigen+LBFGS for the same job).  A
-sample budget bounds the search (the full grid need not be visited).
+TPU redesign: the same tunables matter — the fusion threshold (bucket
+size of the flatten-concat-psum), the background cycle time (batching
+window for eager submissions), and the categorical response-cache and
+hierarchical-allreduce switches.  The search is a 4-D Gaussian-process
+expected-improvement loop over (log2 threshold, cycle-time index,
+cache flag, hierarchical flag), same phases and logging as the
+reference, implemented with numpy (the reference vendored Eigen+LBFGS
+for the same job).  A sample budget bounds the search (the full grid
+need not be visited).  After convergence a regression watch re-enters
+sampling on a sustained score drop (workload shift).
 """
 
 from __future__ import annotations
@@ -26,20 +29,29 @@ import numpy as np
 logger = logging.getLogger("horovod_tpu")
 
 _MIB = 1024 * 1024
-# candidate grids: log2 bucket bytes 1 MiB..512 MiB × cycle time ms
+# candidate grids: log2 bucket bytes 1 MiB..512 MiB × cycle time ms ×
+# response-cache on/off × hierarchical-allreduce on/off (the reference's
+# parameter_manager tunes the same categorical knobs alongside the
+# numeric pair)
 _THRESH_GRID = [float(e) for e in range(20, 30)]
 _CYCLE_GRID_MS = [0.0, 0.5, 1.0, 2.0, 5.0, 10.0]
-# 2-D candidate points in normalized coordinates (threshold exponent,
-# cycle index) — the cycle dim uses its INDEX so the RBF sees uniform
-# spacing despite the geometric ms grid
-_GRID_2D = [(t, float(ci)) for t in _THRESH_GRID
-            for ci in range(len(_CYCLE_GRID_MS))]
+_BIN = (0.0, 1.0)
+
+
+def _make_grid(cycle_grid, cache_flags=_BIN, hier_flags=_BIN):
+    """Candidate points in normalized coordinates (threshold exponent,
+    cycle index, cache flag, hier flag) — the cycle dim uses its INDEX
+    so the RBF sees uniform spacing despite the geometric ms grid."""
+    return [(t, float(ci), ca, hi) for t in _THRESH_GRID
+            for ci in range(len(cycle_grid))
+            for ca in cache_flags for hi in hier_flags]
 
 
 class _GP:
     """Tiny Gaussian process (RBF kernel) for N-D expected improvement."""
 
-    def __init__(self, length_scales=(1.5, 1.0), noise: float = 1e-2):
+    def __init__(self, length_scales=(1.5, 1.0, 0.6, 0.6),
+                 noise: float = 1e-2):
         self.ls = np.asarray(length_scales)
         self.noise = noise
         self.xs: List[Tuple[float, ...]] = []
@@ -65,8 +77,7 @@ class _GP:
         v = 1.0 + self.noise - np.sum(Ks * np.linalg.solve(K, Ks.T).T, axis=1)
         return mu, np.sqrt(np.maximum(v, 1e-12))
 
-    def suggest(self, grid=None) -> Tuple[float, float]:
-        grid = grid if grid is not None else _GRID_2D
+    def suggest(self, grid) -> Tuple[float, ...]:
         unseen = [p for p in grid if p not in set(self.xs)]
         if not unseen:
             return grid[0]
@@ -113,11 +124,17 @@ class ParameterManager:
         self._gp = _GP()
         self._cycle_grid = sorted(set(_CYCLE_GRID_MS)
                                   | {float(cfg.cycle_time_ms)})
-        self._grid_2d = [(t, float(ci)) for t in _THRESH_GRID
-                         for ci in range(len(self._cycle_grid))]
+        # cache_capacity <= 0 hard-disables ResponseCache.get/put, so the
+        # cache dimension would be inert — pin it off instead of letting
+        # the GP converge to a value that cannot take effect
+        cache_flags = _BIN if cfg.cache_capacity > 0 else (0.0,)
+        self._grid = _make_grid(self._cycle_grid, cache_flags=cache_flags)
         self._current = (math.log2(cfg.fusion_threshold_bytes),
                          float(self._cycle_grid.index(
-                             float(cfg.cycle_time_ms))))
+                             float(cfg.cycle_time_ms))),
+                         1.0 if cfg.cache_capacity > 0 else 0.0,
+                         1.0 if getattr(cfg, "hierarchical_allreduce",
+                                        False) else 0.0)
         self._sample_bytes = 0
         self._sample_time = 0.0
         self._sample_steps = 0
@@ -128,13 +145,19 @@ class ParameterManager:
         if self._log_file:
             self._log_file.write(
                 "timestamp,fusion_threshold_bytes,cycle_time_ms,"
-                "score_bytes_per_sec,phase\n")
+                "cache,hierarchical,score_bytes_per_sec,phase\n")
 
     def current_fusion_threshold(self) -> int:
         return int(2 ** self._current[0])
 
     def current_cycle_time_ms(self) -> float:
         return self._cycle_grid[int(self._current[1])]
+
+    def current_cache_enabled(self) -> bool:
+        return bool(self._current[2])
+
+    def current_hierarchical(self) -> bool:
+        return bool(self._current[3])
 
     @property
     def tuned(self) -> bool:
@@ -153,8 +176,7 @@ class ParameterManager:
         phase = "warmup" if self.warmup_remaining > 0 else "sample"
         # log row pairs the score with the parameters it was MEASURED at
         # (self._current moves to the next suggestion below)
-        measured_thr = self.current_fusion_threshold()
-        measured_cyc = self.current_cycle_time_ms()
+        measured = self._current
         if self.warmup_remaining > 0:
             self.warmup_remaining -= 1
         else:
@@ -162,27 +184,36 @@ class ParameterManager:
             if self._best is None or score > self._best[1]:
                 self._best = (self._current, score)
             if (len(self._gp.xs) >= self.max_samples
-                    or len(self._gp.xs) >= len(self._grid_2d)):
+                    or len(self._gp.xs) >= len(self._grid)):
                 # converge: lock in the best observed point
                 self._current = self._best[0]
                 self._tuned = True
                 phase = "tuned"
                 logger.info(
                     "autotune converged: fusion_threshold=%d bytes "
-                    "(%.1f MiB), cycle_time=%.1f ms, score=%.3g B/s",
+                    "(%.1f MiB), cycle_time=%.1f ms, cache=%s, "
+                    "hierarchical=%s, score=%.3g B/s",
                     self.current_fusion_threshold(),
                     self.current_fusion_threshold() / _MIB,
-                    self.current_cycle_time_ms(), self._best[1])
+                    self.current_cycle_time_ms(),
+                    self.current_cache_enabled(),
+                    self.current_hierarchical(), self._best[1])
             else:
-                self._current = self._gp.suggest(self._grid_2d)
-        if self._log_file:
-            self._log_file.write(
-                f"{time.time():.3f},{measured_thr},"
-                f"{measured_cyc:g},{score:.6g},{phase}\n")
-            self._log_file.flush()
+                self._current = self._gp.suggest(self._grid)
+        self._log_row(measured, score, phase)
         self._sample_bytes = 0
         self._sample_time = 0.0
         self._sample_steps = 0
+
+    def _log_row(self, point, score: float, phase: str):
+        if not self._log_file:
+            return
+        thr = int(2 ** point[0])
+        cyc = self._cycle_grid[int(point[1])]
+        self._log_file.write(
+            f"{time.time():.3f},{thr},{cyc:g},{int(point[2])},"
+            f"{int(point[3])},{score:.6g},{phase}\n")
+        self._log_file.flush()
 
     def _watch_regression(self, nbytes: int, elapsed_s: float):
         """Tuned-state monitoring: keep scoring windows; a sustained drop
@@ -206,11 +237,7 @@ class ParameterManager:
             self._regress_count += 1
         else:
             self._regress_count = 0
-        if self._log_file:
-            self._log_file.write(
-                f"{time.time():.3f},{self.current_fusion_threshold()},"
-                f"{self.current_cycle_time_ms():g},{score:.6g},tuned\n")
-            self._log_file.flush()
+        self._log_row(self._current, score, "tuned")
         if self._regress_count >= self.retune_windows:
             logger.info(
                 "autotune re-entering sampling: tuned score %.3g B/s "
@@ -223,8 +250,4 @@ class ParameterManager:
             self.warmup_remaining = self.cfg.autotune_warmup_samples
             self._regress_count = 0
             self.retunes += 1
-            if self._log_file:
-                self._log_file.write(
-                    f"{time.time():.3f},{self.current_fusion_threshold()},"
-                    f"{self.current_cycle_time_ms():g},{score:.6g},retune\n")
-                self._log_file.flush()
+            self._log_row(self._current, score, "retune")

@@ -432,8 +432,14 @@ def allreduce_arrays(arrays: List, ps, op: str = ReduceOp.AVERAGE,
         hier = None
         if op in _SUMMABLE and fuse:
             from .. import runtime
-            cfg = runtime._state().config
-            if cfg is not None and cfg.hierarchical_allreduce:
+            st = runtime._state()
+            hier_on = (st.config is not None
+                       and st.config.hierarchical_allreduce)
+            if st.engine is not None and st.engine.autotuner is not None:
+                # tuned dimension: the engine's applied value (local or
+                # negotiated) overrides config WITHOUT mutating it
+                hier_on = st.engine._hierarchical_enabled()
+            if hier_on:
                 hier = ps.hier_shape()
         if hier is not None:
             fn = _hier_allreduce_fn(

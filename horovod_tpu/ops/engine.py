@@ -159,6 +159,8 @@ class CollectiveEngine:
         # tuned (threshold, cycle) agreed through the controller's rounds
         # in multi-process jobs (rank-0 parameter sync)
         self._negotiated_params: Optional[dict] = None
+        self._last_threshold = (cfg.fusion_threshold_bytes
+                                if cfg is not None else 0)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
@@ -356,7 +358,9 @@ class CollectiveEngine:
             if (self.autotuner is not None and procs == all_procs
                     and me == procs[0]):
                 params = {"t": self.autotuner.current_fusion_threshold(),
-                          "c": self.autotuner.current_cycle_time_ms()}
+                          "c": self.autotuner.current_cycle_time_ms(),
+                          "ca": self.autotuner.current_cache_enabled(),
+                          "hi": self.autotuner.current_hierarchical()}
             res = ctl.negotiate(tokens, procs, params=params)
             if res.params is not None:
                 self._negotiated_params = res.params
@@ -482,11 +486,18 @@ class CollectiveEngine:
                 # cycle's agreed dispatch set (requeued entries stay open)
                 self.timeline.negotiate_end(e.name)
 
-        plan = self._cache.get(sigs)
+        use_cache = self._cache_enabled()
+        threshold = self._fusion_threshold()
+        if threshold != self._last_threshold:
+            # cached plans were built at the previous threshold; keeping
+            # them would score tuner candidates against stale plans
+            self._cache.clear()
+            self._last_threshold = threshold
+        plan = self._cache.get(sigs) if use_cache else None
         if plan is None:
-            threshold = self._fusion_threshold()
             plan = self._plan_fn(sigs, threshold)
-            self._cache.put(sigs, plan)
+            if use_cache:
+                self._cache.put(sigs, plan)
 
         # autotune scoring clock: from cycle start (includes the batching
         # window being tuned) when the background loop set it
@@ -558,6 +569,25 @@ class CollectiveEngine:
             return self.autotuner.current_fusion_threshold()
         return self.cfg.fusion_threshold_bytes
 
+    def _cache_enabled(self) -> bool:
+        if self.autotuner is not None:
+            if self._controller is not None and self._controller.enabled:
+                if self._negotiated_params is not None:
+                    return bool(self._negotiated_params.get("ca", True))
+                return True
+            return self.autotuner.current_cache_enabled()
+        return True
+
+    def _hierarchical_enabled(self) -> bool:
+        if self.autotuner is not None:
+            if self._controller is not None and self._controller.enabled:
+                if self._negotiated_params is not None:
+                    return bool(self._negotiated_params.get(
+                        "hi", self.cfg.hierarchical_allreduce))
+                return self.cfg.hierarchical_allreduce
+            return self.autotuner.current_hierarchical()
+        return self.cfg.hierarchical_allreduce
+
     # -- dispatch -----------------------------------------------------------
     def _dispatch_bucket(self, entries, sigs, owner, base, bucket, results):
         first = sigs[bucket[0]]
@@ -628,6 +658,8 @@ class CollectiveEngine:
             out["autotune"] = {
                 "fusion_threshold_bytes": self._fusion_threshold(),
                 "cycle_time_ms": self._cycle_time_s() * 1000.0,
+                "cache_enabled": self._cache_enabled(),
+                "hierarchical": self._hierarchical_enabled(),
                 "tuned": self.autotuner.tuned,
                 "retunes": getattr(self.autotuner, "retunes", 0),
                 "negotiated": self._negotiated_params is not None,

@@ -13,8 +13,7 @@ import os
 import numpy as np
 import pytest
 
-from horovod_tpu.autotune import (_CYCLE_GRID_MS, _GRID_2D,
-                                  ParameterManager)
+from horovod_tpu.autotune import _CYCLE_GRID_MS, ParameterManager
 from horovod_tpu.config import Config
 
 
@@ -50,14 +49,12 @@ def test_tunes_both_dimensions_and_converges():
         return 1e9 * math.exp(t + c)
     _feed(pm, score)
     assert pm.tuned
-    # converged point must be one of the sampled grid points, and both
-    # dims must have been explored
+    # converged point must be one of the sampled grid points, and the
+    # numeric dims must have been explored
     xs = pm._gp.xs
     assert len({x[0] for x in xs}) > 1 or len({x[1] for x in xs}) > 1
     assert pm.current_cycle_time_ms() in _CYCLE_GRID_MS
-    assert (math.log2(pm.current_fusion_threshold()),
-            float(_CYCLE_GRID_MS.index(pm.current_cycle_time_ms()))
-            ) in set(xs)
+    assert pm._current in set(xs)
 
 
 def test_converges_at_sample_budget():
@@ -74,12 +71,13 @@ def test_autotune_log_schema(tmp_path):
     pm._log_file.flush()
     lines = open(log).read().strip().splitlines()
     assert lines[0] == ("timestamp,fusion_threshold_bytes,cycle_time_ms,"
-                        "score_bytes_per_sec,phase")
+                        "cache,hierarchical,score_bytes_per_sec,phase")
     assert any(line.endswith("tuned") for line in lines[1:])
-    # every row carries a cycle time from the grid
+    # every row carries a cycle time from the grid and binary flags
     for line in lines[1:]:
-        cyc = float(line.split(",")[2])
-        assert cyc in _CYCLE_GRID_MS
+        cols = line.split(",")
+        assert float(cols[2]) in _CYCLE_GRID_MS
+        assert cols[3] in ("0", "1") and cols[4] in ("0", "1")
 
 
 def test_engine_reads_tuned_cycle_time(hvd):
@@ -187,3 +185,57 @@ def test_negotiated_autotune_identical_across_processes():
     assert by_rank[0]["negotiated"] and by_rank[1]["negotiated"]
     assert by_rank[0]["thr"] == by_rank[1]["thr"]
     assert by_rank[0]["cyc"] == by_rank[1]["cyc"]
+
+
+def test_tunes_cache_dimension():
+    """The categorical response-cache dim is part of the search
+    (reference: parameter_manager tunes cache on/off): a workload where
+    cache-off scores higher converges with the cache disabled."""
+    pm = ParameterManager(_cfg(max_samples=60))
+    for _ in range(800):
+        if pm.tuned:
+            break
+        bps = 1e9 if not pm.current_cache_enabled() else 1e5
+        pm.record_cycle(nbytes=int(bps), elapsed_s=1.0)
+    assert pm.tuned
+    assert pm.current_cache_enabled() is False
+
+
+def test_engine_applies_cache_and_hier_toggles(hvd):
+    """The live engine honors the tuner's cache/hierarchical dims each
+    cycle: cache-off cycles never touch the plan cache, and the applied
+    values surface in engine.stats()['autotune']."""
+    from horovod_tpu import runtime
+
+    eng = runtime._state().engine
+    pm = ParameterManager(_cfg())
+    old_tuner = eng.autotuner
+    old_hier = eng.cfg.hierarchical_allreduce
+    eng.autotuner = pm
+    try:
+        pm._current = (pm._current[0], pm._current[1], 0.0, 0.0)
+        before = eng.stats()["cache"]["entries"]
+        hvd.allreduce(np.ones((4,), np.float32), name="ca_off_t")
+        st = eng.stats()
+        assert st["cache"]["entries"] == before   # cache bypassed
+        assert st["autotune"]["cache_enabled"] is False
+        assert st["autotune"]["hierarchical"] is False
+        pm._current = (pm._current[0], pm._current[1], 1.0, 0.0)
+        hvd.allreduce(np.ones((4,), np.float32), name="ca_on_t")
+        st = eng.stats()
+        assert st["cache"]["entries"] > before    # cache back on
+        assert st["autotune"]["cache_enabled"] is True
+    finally:
+        eng.autotuner = old_tuner
+        eng.cfg.hierarchical_allreduce = old_hier
+
+
+def test_cache_dim_pinned_when_capacity_zero():
+    """HOROVOD_CACHE_CAPACITY=0 hard-disables the plan cache, so the
+    tuner must not explore (or converge to) cache-on candidates that
+    cannot take effect."""
+    pm = ParameterManager(_cfg(cache_capacity=0, max_samples=10))
+    assert pm.current_cache_enabled() is False
+    assert all(p[2] == 0.0 for p in pm._grid)
+    _feed(pm, lambda thr, cyc: 1e6)
+    assert pm.tuned and pm.current_cache_enabled() is False
