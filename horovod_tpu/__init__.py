@@ -42,6 +42,7 @@ from .api import (  # noqa: F401
     allgather, allgather_async, grouped_allgather, grouped_allgather_async,
     broadcast, broadcast_async, broadcast_, broadcast_async_,
     broadcast_object,
+    allgather_object,
     alltoall, alltoall_async,
     reducescatter, reducescatter_async, grouped_reducescatter,
     synchronize, poll, wait, join, barrier,

@@ -197,6 +197,34 @@ def broadcast_object(obj, root_rank: int = 0, name=None, process_set=None):
     return pickle.loads(data)
 
 
+def allgather_object(obj, name=None, process_set=None):
+    """Gather an arbitrary picklable object from every process; returns
+    a list ordered by process index.
+
+    Reference: ``horovod/torch/mpi_ops.py`` allgather_object (pickle →
+    byte tensor → allgather sizes → allgather payload).  Object
+    collectives are process-granular in single-controller SPMD (one
+    Python object per process, like :func:`broadcast_object`); with a
+    subset process set only the member processes participate.
+    """
+    import pickle
+    _require_init()
+    ps = _ps(process_set)
+    procs = sorted({d.process_index for d in ps.mesh.devices.flat})
+    me = runtime.cross_rank()
+    if len(procs) > 1 and me not in procs:
+        raise ValueError(
+            f"allgather_object: process {me} is not a member of the "
+            f"process set (member processes: {procs}) — the reference "
+            f"rejects collectives from non-members")
+    if len(procs) <= 1:
+        return [obj]
+    from .utils import multihost_subset_allgather_bytes
+    blobs = multihost_subset_allgather_bytes(
+        pickle.dumps(obj), procs, tag=name or "ago")
+    return [pickle.loads(b) for b in blobs]
+
+
 # ---------------------------------------------------------------------------
 # alltoall
 # ---------------------------------------------------------------------------

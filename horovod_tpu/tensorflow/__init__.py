@@ -44,7 +44,8 @@ __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "Average", "Sum", "Adasum",
     "allreduce", "grouped_allreduce", "allgather", "broadcast",
-    "broadcast_variables", "broadcast_object", "alltoall", "join",
+    "broadcast_variables", "broadcast_object", "allgather_object",
+    "alltoall", "join",
     "barrier", "DistributedGradientTape", "DistributedOptimizer",
     "Compression", "ProcessSet", "add_process_set", "remove_process_set",
 ]
@@ -231,6 +232,10 @@ def barrier(process_set=None):
 
 def broadcast_object(obj, root_rank: int = 0, name=None, process_set=None):
     return _api.broadcast_object(obj, root_rank, name, process_set)
+
+
+def allgather_object(obj, name=None, process_set=None):
+    return _api.allgather_object(obj, name, process_set)
 
 
 def broadcast_variables(variables, root_rank: int = 0, process_set=None):

@@ -47,7 +47,8 @@ __all__ = [
     "allgather_async", "grouped_allgather", "reducescatter",
     "broadcast", "broadcast_async", "broadcast_",
     "broadcast_async_", "alltoall", "alltoall_async", "synchronize",
-    "poll", "join", "barrier", "broadcast_object", "broadcast_parameters",
+    "poll", "join", "barrier", "broadcast_object", "allgather_object",
+    "broadcast_parameters",
     "broadcast_optimizer_state", "DistributedOptimizer", "Compression",
     "ProcessSet", "add_process_set", "remove_process_set",
 ]
@@ -274,6 +275,10 @@ def barrier(process_set=None):
 
 def broadcast_object(obj, root_rank: int = 0, name=None, process_set=None):
     return _api.broadcast_object(obj, root_rank, name, process_set)
+
+
+def allgather_object(obj, name=None, process_set=None):
+    return _api.allgather_object(obj, name, process_set)
 
 
 # ---------------------------------------------------------------------------

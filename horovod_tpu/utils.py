@@ -10,12 +10,18 @@ the native replacement for both.
 from __future__ import annotations
 
 import base64
+import hashlib
 import itertools
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 import jax
 
+# Lockstep counter: every process calls the global multihost_* helpers
+# the same number of times in the same order, so derived key names agree.
 _counter = itertools.count()
+# Subset-scoped helpers must NOT advance the global counter (only the
+# member processes call them); each member group counts its own calls.
+_subset_counters: Dict[Tuple[int, ...], int] = {}
 
 
 def _client():
@@ -54,6 +60,33 @@ def multihost_broadcast_bytes(payload: Optional[bytes],
         client.key_value_set(key, base64.b64encode(payload).decode())
     raw = client.blocking_key_value_get(key, timeout_s * 1000)
     return base64.b64decode(raw)
+
+
+def multihost_subset_allgather_bytes(payload: bytes, procs,
+                                     tag: str = "ags",
+                                     timeout_s: int = 300) -> list:
+    """Gather one byte string from each process in ``procs`` (sorted
+    member processes; every member must call in the same order,
+    non-members must not call).  Keys are namespaced by a per-GROUP
+    call counter — the global lockstep counter must not advance on a
+    subset of processes or every later global helper would disagree on
+    its key names.  No barrier needed: gets block until each member's
+    put lands."""
+    procs = tuple(sorted(procs))
+    if len(procs) <= 1:
+        return [payload]
+    me = jax.process_index()
+    if me not in procs:
+        raise ValueError(
+            f"process {me} is not a member of the gather group {procs}")
+    client = _client()
+    gk = hashlib.sha1(",".join(map(str, procs)).encode()).hexdigest()[:10]
+    n = _subset_counters[procs] = _subset_counters.get(procs, 0) + 1
+    prefix = f"hvd_ags_{tag}_{gk}_{n}"
+    client.key_value_set(f"{prefix}/{me}",
+                         base64.b64encode(payload).decode())
+    return [base64.b64decode(client.blocking_key_value_get(
+        f"{prefix}/{p}", timeout_s * 1000)) for p in procs]
 
 
 def multihost_allgather_str(value: str, tag: str = "ag",

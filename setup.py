@@ -13,6 +13,8 @@ Build in place with::
 or let ``horovod_tpu.native.loader`` build it on first use.
 """
 
+import os
+
 from setuptools import Extension, find_packages, setup
 
 ext = Extension(
@@ -22,13 +24,19 @@ ext = Extension(
     extra_compile_args=["-std=c++17", "-O2", "-fvisibility=hidden"],
 )
 
+# Feature-flag matrix (reference: HOROVOD_WITH_*/HOROVOD_WITHOUT_* in
+# the reference's setup.py): one flag suffices here — frameworks are
+# pure-Python adapters over the shared engine, so only the native core
+# is a build-time choice.  `hvdrun --check-build` prints what was built.
+exts = [] if os.environ.get("HOROVOD_WITHOUT_NATIVE_CORE") == "1" else [ext]
+
 setup(
     name="horovod_tpu",
     version="0.1.0",
     description="TPU-native distributed training framework "
                 "(capability rebuild of Horovod)",
     packages=find_packages(exclude=("tests", "tests.*")),
-    ext_modules=[ext],
+    ext_modules=exts,
     entry_points={
         "console_scripts": [
             "hvdrun = horovod_tpu.runner.launch:main",

@@ -308,3 +308,13 @@ def negotiated_autotune_fn():
     st = hvd.runtime._state().engine.stats()["autotune"]
     return {"rank": r, "thr": st["fusion_threshold_bytes"],
             "cyc": st["cycle_time_ms"], "negotiated": st["negotiated"]}
+
+
+def allgather_object_fn():
+    """hvd.allgather_object gathers one picklable object per process,
+    ordered by process index (reference: torch/mpi_ops.py)."""
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    objs = hvd.allgather_object({"rank": r, "payload": [r] * (r + 1)})
+    return {"rank": r, "objs": objs}

@@ -132,3 +132,11 @@ def test_checkpoint_async_save(hvd, tmp_path):
     restored = checkpoint.restore(path, tree)
     np.testing.assert_allclose(np.asarray(restored["w"]),
                                np.asarray(tree["w"]))
+
+
+def test_allgather_object_single_process(hvd):
+    """Object collectives are process-granular: one process -> [obj]."""
+    obj = {"a": 1, "b": [2, 3]}
+    assert hvd.allgather_object(obj) == [obj]
+    import horovod_tpu.torch as thvd
+    assert thvd.allgather_object(obj) == [obj]

@@ -279,3 +279,13 @@ def test_stats_and_set_joined_responsive_during_slow_round():
     finally:
         ctl_mod._client = orig_client
         ctl_mod.jax.process_index = orig_pi
+
+
+def test_allgather_object_cross_process():
+    """hvd.allgather_object returns every process's object, ordered by
+    process index, on all processes (reference: allgather_object)."""
+    results = run(helpers_runner.allgather_object_fn, np=2, env=_env(),
+                  port=29549)
+    expected = [{"rank": 0, "payload": [0]}, {"rank": 1, "payload": [1, 1]}]
+    for r in results:
+        assert r["objs"] == expected
