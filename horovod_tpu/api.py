@@ -212,7 +212,7 @@ def allgather_object(obj, name=None, process_set=None):
     ps = _ps(process_set)
     procs = sorted({d.process_index for d in ps.mesh.devices.flat})
     me = runtime.cross_rank()
-    if len(procs) > 1 and me not in procs:
+    if me not in procs:
         raise ValueError(
             f"allgather_object: process {me} is not a member of the "
             f"process set (member processes: {procs}) — the reference "

@@ -11,19 +11,28 @@ count (``X[rank::nproc]`` shards differ by up to one sample): each
 the same number of steps or the collectives desynchronize — one rank's
 spare step would pair with another's next epoch, and the final epoch
 would hang on a collective nobody else joins.
+
+``validation`` (reference: the Spark estimators' ``validation`` param)
+holds out that fraction of each rank's shard before training; the
+per-epoch validation loss is reduced as a (sum, count) pair so ranks
+with differently-sized (even empty) validation shards stay in lockstep
+— exactly one extra allreduce per epoch.
 """
 
 from __future__ import annotations
 
-from typing import Callable, List
+from typing import Callable, Dict, List
 
 
 def run_data_parallel_training(model, optimizer,
                                loss_of_batch: Callable,
                                X, y, epochs: int, batch_size: int,
-                               seed: int, shuffle: bool = True
-                               ) -> List[float]:
-    """Train ``model`` data-parallel; returns per-epoch averaged losses.
+                               seed: int, shuffle: bool = True,
+                               validation: float = 0.0
+                               ) -> Dict[str, List[float]]:
+    """Train ``model`` data-parallel; returns per-epoch histories:
+    ``{"loss": [...], "val_loss": [...]}`` (``val_loss`` empty when
+    ``validation`` is 0).
 
     ``loss_of_batch(model, xb, yb, step_idx) -> scalar torch loss``
     (``step_idx`` is the within-epoch batch index — Lightning's
@@ -42,12 +51,19 @@ def run_data_parallel_training(model, optimizer,
 
     Xs = torch.from_numpy(np.ascontiguousarray(X[rank::nproc]))
     ys = torch.from_numpy(np.ascontiguousarray(y[rank::nproc]))
+    Xv = yv = None
+    if validation > 0.0:
+        n_val = int(len(Xs) * validation)
+        split_gen = torch.Generator().manual_seed(seed + 977)
+        perm = torch.randperm(len(Xs), generator=split_gen)
+        Xv, yv = Xs[perm[:n_val]], ys[perm[:n_val]]
+        Xs, ys = Xs[perm[n_val:]], ys[perm[n_val:]]
     gen = torch.Generator().manual_seed(seed + rank)
     steps_per_epoch = int(hvd.allreduce(
         torch.tensor(float(len(Xs) // batch_size)), op=hvd.Min,
         name="estimator.steps_per_epoch"))
 
-    history: List[float] = []
+    history: Dict[str, List[float]] = {"loss": [], "val_loss": []}
     for _ in range(epochs):
         order = (torch.randperm(len(Xs), generator=gen) if shuffle
                  else torch.arange(len(Xs)))
@@ -62,5 +78,22 @@ def run_data_parallel_training(model, optimizer,
         avg = hvd.allreduce(
             torch.tensor(epoch_loss / max(steps_per_epoch, 1)),
             name="estimator.epoch_loss")
-        history.append(float(avg))
+        history["loss"].append(float(avg))
+
+        if validation > 0.0:
+            vsum, vcnt = 0.0, 0
+            model.eval()
+            with torch.no_grad():
+                for s in range(0, len(Xv), batch_size):
+                    xb, yb = Xv[s:s + batch_size], yv[s:s + batch_size]
+                    vsum += float(loss_of_batch(
+                        model, xb, yb, s // batch_size)) * len(xb)
+                    vcnt += len(xb)
+            model.train()
+            # (sum, count) reduce: ranks may hold different (even zero)
+            # validation counts without desynchronizing
+            tot = hvd.allreduce(torch.tensor([vsum, float(vcnt)]),
+                                op=hvd.Sum, name="estimator.val_loss")
+            history["val_loss"].append(
+                float(tot[0]) / max(float(tot[1]), 1.0))
     return history

@@ -17,7 +17,7 @@ from .store import Store
 
 
 def _train_on_worker(model_bytes, compile_kwargs, X, y, epochs,
-                     batch_size, seed):
+                     batch_size, seed, validation=0.0):
     """Runs on every launched worker (cloudpickled)."""
     import numpy as np
     import tensorflow as tf
@@ -35,6 +35,7 @@ def _train_on_worker(model_bytes, compile_kwargs, X, y, epochs,
     hist = model.fit(
         X[rank::nproc], y[rank::nproc], epochs=epochs,
         batch_size=batch_size, verbose=0,
+        validation_split=validation or 0.0,
         callbacks=[khvd.BroadcastGlobalVariablesCallback(0),
                    khvd.MetricAverageCallback()])
     return {"weights": model.get_weights() if rank == 0 else None,
@@ -70,7 +71,7 @@ class KerasEstimator:
                  store: Optional[Store] = None,
                  run_id: Optional[str] = None, seed: int = 0,
                  env: Optional[dict] = None, port: int = 29610,
-                 verbose: int = 0):
+                 verbose: int = 0, validation: float = 0.0):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -84,6 +85,10 @@ class KerasEstimator:
         self.env = env
         self.port = port
         self.verbose = verbose
+        if not 0.0 <= validation < 1.0:
+            raise ValueError(
+                f"validation must be a fraction in [0, 1), got {validation}")
+        self.validation = validation
 
     def fit(self, X: np.ndarray, y: np.ndarray) -> KerasModel:
         import tensorflow as tf
@@ -98,7 +103,7 @@ class KerasEstimator:
             args=(payload, {"optimizer": opt_cfg, "loss": self.loss,
                             "metrics": self.metrics},
                   np.asarray(X), np.asarray(y), self.epochs,
-                  self.batch_size, self.seed),
+                  self.batch_size, self.seed, self.validation),
             np=self.num_proc, env=self.env, port=self.port,
             verbose=bool(self.verbose))
         fitted = tf.keras.models.model_from_json(payload["json"])

@@ -43,7 +43,8 @@ def _first_optimizer(ret):
     return ret
 
 
-def _train_on_worker(model_bytes, X, y, epochs, batch_size, seed):
+def _train_on_worker(model_bytes, X, y, epochs, batch_size, seed,
+                     validation=0.0):
     """Runs on every launched worker (cloudpickled)."""
     import io
 
@@ -58,14 +59,16 @@ def _train_on_worker(model_bytes, X, y, epochs, batch_size, seed):
         return out["loss"] if isinstance(out, dict) else out
 
     from ._worker import run_data_parallel_training
-    history = run_data_parallel_training(
+    hist = run_data_parallel_training(
         module, _first_optimizer(module.configure_optimizers()),
-        loss_of_batch, X, y, epochs, batch_size, seed)
+        loss_of_batch, X, y, epochs, batch_size, seed,
+        validation=validation)
 
     if hvd.cross_rank() == 0:
         buf = io.BytesIO()
         torch.save(module, buf)
-        return {"module": buf.getvalue(), "history": history}
+        return {"module": buf.getvalue(), "history": hist["loss"],
+                "val_history": hist["val_loss"]}
     return None
 
 
@@ -82,7 +85,7 @@ class LightningEstimator:
     def __init__(self, model, num_proc: int = 2, epochs: int = 1,
                  batch_size: int = 32, store: Optional[Store] = None,
                  seed: int = 0, env: Optional[dict] = None,
-                 port: int = 0):
+                 port: int = 0, validation: float = 0.0):
         lm = _lightning_module_cls()
         if lm is None:
             raise ImportError(
@@ -100,6 +103,10 @@ class LightningEstimator:
         self.seed = seed
         self.env = env
         self.port = port
+        if not 0.0 <= validation < 1.0:
+            raise ValueError(
+                f"validation must be a fraction in [0, 1), got {validation}")
+        self.validation = validation
 
     def fit(self, X: Sequence, y: Sequence) -> "LightningModelWrapper":
         import io
@@ -114,7 +121,8 @@ class LightningEstimator:
         results = runner_api.run(
             _train_on_worker,
             args=(buf.getvalue(), np.asarray(X), np.asarray(y),
-                  self.epochs, self.batch_size, self.seed),
+                  self.epochs, self.batch_size, self.seed,
+                  self.validation),
             np=self.num_proc, env=self.env, **extra)
         fitted = next(r for r in results if r is not None)
         if self.store is not None:
@@ -122,7 +130,8 @@ class LightningEstimator:
             self.store.save_checkpoint(run_id, fitted)
         module = torch.load(io.BytesIO(fitted["module"]),
                             weights_only=False)
-        return LightningModelWrapper(module, fitted["history"])
+        return LightningModelWrapper(module, fitted["history"],
+                                     fitted.get("val_history"))
 
 
 class LightningModelWrapper:
@@ -130,9 +139,11 @@ class LightningModelWrapper:
     TorchModel.history — the reference's lightning estimator records
     metrics on the returned model)."""
 
-    def __init__(self, module: Any, history: Optional[list] = None):
+    def __init__(self, module: Any, history: Optional[list] = None,
+                 val_history: Optional[list] = None):
         self.module = module
         self.history = list(history or [])
+        self.val_history = list(val_history or [])
 
     def predict(self, X) -> np.ndarray:
         import torch

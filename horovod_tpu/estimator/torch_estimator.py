@@ -24,7 +24,7 @@ from .store import Store
 
 
 def _train_on_worker(model_bytes, opt_factory, loss_fn, X, y, epochs,
-                     batch_size, seed, shuffle):
+                     batch_size, seed, shuffle, validation):
     """Runs on every launched worker (cloudpickled)."""
     import io
     import numpy as np
@@ -34,22 +34,24 @@ def _train_on_worker(model_bytes, opt_factory, loss_fn, X, y, epochs,
     rank = hvd.cross_rank()
     model = torch.load(io.BytesIO(model_bytes), weights_only=False)
     from ._worker import run_data_parallel_training
-    history = run_data_parallel_training(
+    hist = run_data_parallel_training(
         model, opt_factory(model.parameters()),
         lambda m, xb, yb, _s: loss_fn(m(xb), yb),
-        X, y, epochs, batch_size, seed, shuffle)
+        X, y, epochs, batch_size, seed, shuffle, validation)
     buf = io.BytesIO()
     torch.save(model.state_dict(), buf)
     return {"state_dict": buf.getvalue() if rank == 0 else None,
-            "history": history}
+            "history": hist["loss"], "val_history": hist["val_loss"]}
 
 
 class TorchModel:
     """Fitted model wrapper (reference: TorchModel transformer)."""
 
-    def __init__(self, model, history: List[float], run_id: str):
+    def __init__(self, model, history: List[float], run_id: str,
+                 val_history: Optional[List[float]] = None):
         self.model = model
         self.history = history
+        self.val_history = list(val_history or [])
         self.run_id = run_id
 
     def predict(self, X: np.ndarray) -> np.ndarray:
@@ -78,7 +80,8 @@ class TorchEstimator:
                  store: Optional[Store] = None,
                  run_id: Optional[str] = None, shuffle: bool = True,
                  seed: int = 0, env: Optional[dict] = None,
-                 port: int = 29600, verbose: int = 0):
+                 port: int = 29600, verbose: int = 0,
+                 validation: float = 0.0):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -92,6 +95,10 @@ class TorchEstimator:
         self.env = env
         self.port = port
         self.verbose = verbose
+        if not 0.0 <= validation < 1.0:
+            raise ValueError(
+                f"validation must be a fraction in [0, 1), got {validation}")
+        self.validation = validation
 
     def fit(self, X: np.ndarray, y: np.ndarray) -> TorchModel:
         import io
@@ -104,11 +111,13 @@ class TorchEstimator:
             _train_on_worker,
             args=(buf.getvalue(), self.optimizer, self.loss,
                   np.asarray(X), np.asarray(y), self.epochs,
-                  self.batch_size, self.seed, self.shuffle),
+                  self.batch_size, self.seed, self.shuffle,
+                  self.validation),
             np=self.num_proc, env=self.env, port=self.port,
             verbose=bool(self.verbose))
         state_bytes = results[0]["state_dict"]
         history = results[0]["history"]
+        val_history = results[0].get("val_history", [])
         fitted = torch.load(io.BytesIO(buf.getvalue()),
                             weights_only=False)
         fitted.load_state_dict(torch.load(
@@ -122,8 +131,10 @@ class TorchEstimator:
             torch.save(fitted, mbuf)
             self.store.save_checkpoint(
                 self.run_id, {"model": mbuf.getvalue(),
-                              "history": history})
-        return TorchModel(fitted, history, self.run_id)
+                              "history": history,
+                              "val_history": val_history})
+        return TorchModel(fitted, history, self.run_id,
+                          val_history=val_history)
 
     def load(self, store: Optional[Store] = None,
              run_id: Optional[str] = None) -> TorchModel:
@@ -169,4 +180,5 @@ def load_model(store: Store, run_id: str,
             f"checkpoint '{run_id}' predates self-contained checkpoints "
             f"(no serialized model); pass fallback_model_bytes or load "
             f"through an estimator constructed with the architecture")
-    return TorchModel(model, ckpt.get("history", []), run_id)
+    return TorchModel(model, ckpt.get("history", []), run_id,
+                      val_history=ckpt.get("val_history", []))

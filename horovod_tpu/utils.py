@@ -20,8 +20,9 @@ import jax
 # the same number of times in the same order, so derived key names agree.
 _counter = itertools.count()
 # Subset-scoped helpers must NOT advance the global counter (only the
-# member processes call them); each member group counts its own calls.
-_subset_counters: Dict[Tuple[int, ...], int] = {}
+# member processes call them); each (member group, tag) counts its own
+# calls so old keys of the same stream can be garbage-collected.
+_subset_counters: Dict[Tuple[Tuple[int, ...], str], int] = {}
 
 
 def _client():
@@ -73,20 +74,30 @@ def multihost_subset_allgather_bytes(payload: bytes, procs,
     its key names.  No barrier needed: gets block until each member's
     put lands."""
     procs = tuple(sorted(procs))
-    if len(procs) <= 1:
-        return [payload]
     me = jax.process_index()
-    if me not in procs:
+    if procs and me not in procs:
         raise ValueError(
             f"process {me} is not a member of the gather group {procs}")
+    if len(procs) <= 1:
+        return [payload]
     client = _client()
     gk = hashlib.sha1(",".join(map(str, procs)).encode()).hexdigest()[:10]
-    n = _subset_counters[procs] = _subset_counters.get(procs, 0) + 1
-    prefix = f"hvd_ags_{tag}_{gk}_{n}"
-    client.key_value_set(f"{prefix}/{me}",
+    ck = (procs, tag)
+    n = _subset_counters[ck] = _subset_counters.get(ck, 0) + 1
+    prefix = f"hvd_ags_{tag}_{gk}"
+    client.key_value_set(f"{prefix}_{n}/{me}",
                          base64.b64encode(payload).decode())
-    return [base64.b64decode(client.blocking_key_value_get(
-        f"{prefix}/{p}", timeout_s * 1000)) for p in procs]
+    out = [base64.b64decode(client.blocking_key_value_get(
+        f"{prefix}_{n}/{p}", timeout_s * 1000)) for p in procs]
+    # GC with lag 2 (the controller's old-round pattern): any member at
+    # call n implies every member completed call n-2's reads, so each
+    # member may safely delete its OWN n-2 key
+    if n > 2:
+        try:
+            client.key_value_delete(f"{prefix}_{n - 2}/{me}")
+        except Exception:  # noqa: BLE001 - best-effort cleanup
+            pass
+    return out
 
 
 def multihost_allgather_str(value: str, tag: str = "ag",
