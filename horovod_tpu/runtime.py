@@ -343,11 +343,18 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
                     logger.warning("jax recoverability unavailable")
                 hb = int(os.environ.get(
                     "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", "10"))
+                # init timeout gates EPOCH FORMATION only (post-init
+                # death is the heartbeat's job).  It must cover the
+                # slowest member's spawn + jax import on an
+                # oversubscribed host: with 30 s, a 1-core machine
+                # re-forming 3 workers LOG(FATAL)s on RegisterTask
+                # before the last member arrives, and every retry epoch
+                # collides the same way.
                 dist_kwargs = dict(
                     heartbeat_timeout_seconds=hb,
                     shutdown_timeout_seconds=hb,
                     initialization_timeout=int(os.environ.get(
-                        "HOROVOD_ELASTIC_INIT_TIMEOUT", "30")))
+                        "HOROVOD_ELASTIC_INIT_TIMEOUT", "120")))
             try:
                 # a prior solo epoch (job shrunk to 1 process: distributed
                 # init skipped) may have lazily created local backends;

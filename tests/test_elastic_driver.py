@@ -255,7 +255,10 @@ def test_elastic_integration_scale_up(tmp_path):
                          daemon=True)
     t.start()
     try:
-        deadline = time.monotonic() + 120
+        # generous: a fully-loaded 1-core host re-forms 3 workers in
+        # ~40-90 s (spawn + jax import each); the wall must cover two
+        # formations plus training progress
+        deadline = time.monotonic() + 240
         while time.monotonic() < deadline:
             recs = _read_records(out_base)
             if sum(1 for r in recs if r["size"] == 2) >= 4:
