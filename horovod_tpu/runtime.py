@@ -344,17 +344,19 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
                 hb = int(os.environ.get(
                     "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", "10"))
                 # init timeout gates EPOCH FORMATION only (post-init
-                # death is the heartbeat's job).  It must cover the
-                # slowest member's spawn + jax import on an
-                # oversubscribed host: with 30 s, a 1-core machine
-                # re-forming 3 workers LOG(FATAL)s on RegisterTask
-                # before the last member arrives, and every retry epoch
-                # collides the same way.
+                # death is the heartbeat's job).  Two pressures: it must
+                # cover the slowest member's spawn + jax import on an
+                # oversubscribed host (30 s is too tight for 3 workers
+                # on one core), but a member stuck in RegisterTask is
+                # UNINTERRUPTIBLE until this deadline LOG(FATAL)s it —
+                # so it must not exceed the driver's start_timeout or
+                # stuck members stay a full epoch out of phase with the
+                # driver's re-forms.
                 dist_kwargs = dict(
                     heartbeat_timeout_seconds=hb,
                     shutdown_timeout_seconds=hb,
                     initialization_timeout=int(os.environ.get(
-                        "HOROVOD_ELASTIC_INIT_TIMEOUT", "120")))
+                        "HOROVOD_ELASTIC_INIT_TIMEOUT", "60")))
             try:
                 # a prior solo epoch (job shrunk to 1 process: distributed
                 # init skipped) may have lazily created local backends;
@@ -493,6 +495,16 @@ def shutdown():
                             int(float(os.environ.get(
                                 "HOROVOD_SHUTDOWN_BARRIER_TIMEOUT",
                                 "15")) * 1000))
+                        if jax.process_index() == 0:
+                            # the barrier alone is not enough: after it,
+                            # the leader's shutdown can still destroy the
+                            # coordination service while followers'
+                            # disconnect RPCs are in flight — they then
+                            # LOG(FATAL) (process death, not a catchable
+                            # error) and an elastic re-form degrades to
+                            # respawns.  Let followers disconnect first.
+                            time.sleep(float(os.environ.get(
+                                "HOROVOD_SHUTDOWN_LEADER_LINGER", "1.5")))
                 except Exception:  # noqa: BLE001 - peers may be gone
                     logger.debug("shutdown barrier failed", exc_info=True)
                 # release the coordination-service connection so an elastic
