@@ -282,6 +282,13 @@ class ElasticDriver:
             "HOROVOD_ELASTIC_DRIVER_PORT": str(self.port),
             "HOROVOD_HOSTNAME": slot.hostname,
         })
+        # keep member and driver formation clocks in phase: a member
+        # stuck in RegisterTask is uninterruptible until its init
+        # timeout LOG(FATAL)s it, so it must die no later than the
+        # driver declares the epoch failed — otherwise it stays a full
+        # epoch behind every re-form (user-set values win)
+        env.setdefault("HOROVOD_ELASTIC_INIT_TIMEOUT",
+                       str(max(30, int(self.start_timeout))))
         proc = self._launch(slot, coord_addr, coord_port, env)
         with self._lock:
             self._workers[wid] = _Worker(wid, slot, proc, epoch)

@@ -9,7 +9,8 @@ absent: TPU pipelines feed arrays/tf.data, and the launcher tier plays
 the role of Spark's barrier-mode tasks.
 """
 
-from .keras_estimator import KerasEstimator, KerasModel  # noqa: F401
+from .keras_estimator import (  # noqa: F401
+    KerasEstimator, KerasModel, load_keras_model)
 from .lightning_estimator import (  # noqa: F401
     LightningEstimator, LightningModelWrapper)
 from .store import (  # noqa: F401
@@ -19,4 +20,5 @@ from .torch_estimator import (  # noqa: F401
 
 __all__ = ["Store", "LocalStore", "FilesystemStore", "RemoteStore",
            "TorchEstimator", "TorchModel", "KerasEstimator", "KerasModel",
-           "LightningEstimator", "LightningModelWrapper", "load_model"]
+           "LightningEstimator", "LightningModelWrapper", "load_model",
+           "load_keras_model"]

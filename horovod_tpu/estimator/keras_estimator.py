@@ -110,7 +110,32 @@ class KerasEstimator:
         fitted.set_weights(results[0]["weights"])
         history = results[0]["history"]
         if self.store is not None:
+            # SELF-CONTAINED: the model json rides along so
+            # load_keras_model() needs no live estimator (parity with
+            # the torch store checkpoints)
             self.store.save_checkpoint(
-                self.run_id, {"weights": results[0]["weights"],
+                self.run_id, {"json": payload["json"],
+                              "weights": results[0]["weights"],
                               "history": history})
         return KerasModel(fitted, history, self.run_id)
+
+
+def load_keras_model(store: Store, run_id: str,
+                     fallback_json: Optional[str] = None) -> KerasModel:
+    """Rehydrate a fitted :class:`KerasModel` from a SELF-CONTAINED store
+    checkpoint (model json + weights), with no live estimator required —
+    parity with :func:`torch_estimator.load_model` and the reference's
+    store round-trip.  Legacy (weights-only) checkpoints need
+    ``fallback_json`` (``model.to_json()`` of the matching
+    architecture)."""
+    import tensorflow as tf
+    ckpt = store.load_checkpoint(run_id)
+    json_def = ckpt.get("json", fallback_json)
+    if json_def is None:
+        raise ValueError(
+            f"checkpoint '{run_id}' predates self-contained keras "
+            f"checkpoints (no model json); pass fallback_json="
+            f"model.to_json() of the matching architecture")
+    model = tf.keras.models.model_from_json(json_def)
+    model.set_weights(ckpt["weights"])
+    return KerasModel(model, ckpt.get("history", {}), run_id)

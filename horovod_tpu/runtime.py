@@ -495,14 +495,19 @@ def shutdown():
                             int(float(os.environ.get(
                                 "HOROVOD_SHUTDOWN_BARRIER_TIMEOUT",
                                 "15")) * 1000))
-                        if jax.process_index() == 0:
+                        if (jax.process_index() == 0
+                                and _STATE.config is not None
+                                and _STATE.config.elastic):
                             # the barrier alone is not enough: after it,
                             # the leader's shutdown can still destroy the
                             # coordination service while followers'
-                            # disconnect RPCs are in flight — they then
-                            # LOG(FATAL) (process death, not a catchable
-                            # error) and an elastic re-form degrades to
-                            # respawns.  Let followers disconnect first.
+                            # disconnect RPCs are in flight — with
+                            # recoverable tasks (elastic only) that is a
+                            # LOG(FATAL) process death, not a catchable
+                            # error, and a re-form degrades to respawns.
+                            # Let followers disconnect first.  Non-elastic
+                            # jobs keep jax's default shutdown barrier and
+                            # need no linger.
                             time.sleep(float(os.environ.get(
                                 "HOROVOD_SHUTDOWN_LEADER_LINGER", "1.5")))
                 except Exception:  # noqa: BLE001 - peers may be gone

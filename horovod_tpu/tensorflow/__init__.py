@@ -43,7 +43,8 @@ from ..runtime import (Adasum, Average, ReduceOp, Sum,  # noqa: F401
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "Average", "Sum", "Adasum",
-    "allreduce", "grouped_allreduce", "allgather", "broadcast",
+    "allreduce", "grouped_allreduce", "allgather", "grouped_allgather",
+    "broadcast",
     "broadcast_variables", "broadcast_object", "allgather_object",
     "alltoall", "join",
     "barrier", "DistributedGradientTape", "DistributedOptimizer",
@@ -170,6 +171,37 @@ def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
     return list(outs)
 
 
+def _set_gather_shape(out, inp):
+    """Gathered outputs keep the input shape with an unknown leading dim
+    (the worker-count concat axis)."""
+    shape = inp.shape.as_list()
+    if shape:
+        shape[0] = None
+    out.set_shape(shape)
+    return out
+
+
+def grouped_allgather(tensors: Sequence, name=None,
+                      process_set=None) -> List:
+    """Allgather a list of tensors as one atomic fusion group
+    (reference: hvd.grouped_allgather)."""
+    nm = name or "tfgroupedallgather"
+
+    if _graph_singleproc():
+        n = _n_workers(process_set)
+        return [tf.concat([t] * n, axis=0) for t in tensors]
+
+    def _np_op(*xs):
+        outs = _api.grouped_allgather([x.numpy() for x in xs], name=nm,
+                                      process_set=process_set)
+        return [np.asarray(o) for o in outs]
+
+    outs = tf.py_function(_np_op, list(tensors),
+                          Tout=[t.dtype for t in tensors],
+                          name=f"HorovodGroupedAllgather__{_XLA_FENCE}")
+    return [_set_gather_shape(o, t) for o, t in zip(outs, tensors)]
+
+
 def allgather(tensor, name=None, process_set=None):
     nm = name or "tfallgather"
 
@@ -183,11 +215,7 @@ def allgather(tensor, name=None, process_set=None):
 
     out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype,
                          name=f"HorovodAllgather__{_XLA_FENCE}")
-    shape = tensor.shape.as_list()
-    if shape:
-        shape[0] = None
-    out.set_shape(shape)
-    return out
+    return _set_gather_shape(out, tensor)
 
 
 def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):

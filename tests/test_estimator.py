@@ -91,6 +91,11 @@ def test_keras_estimator_fit_predict(tmp_path):
     preds = fitted.predict(X)
     assert preds.shape == (64, 1)
     assert store.exists("kfit1")
+    # self-contained checkpoint: rehydrates with NO live estimator
+    from horovod_tpu.estimator import load_keras_model
+    standalone = load_keras_model(store, "kfit1")
+    np.testing.assert_allclose(standalone.predict(X), preds, atol=1e-5)
+    assert standalone.history["loss"] == losses
 
 
 def test_lightning_estimator_absence_contract(hvd):

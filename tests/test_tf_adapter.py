@@ -246,3 +246,22 @@ def test_jit_compile_multiprocess_error_is_actionable(tfhvd, monkeypatch):
     with pytest.raises(Exception) as ei:
         step(tf.constant([1.0, 2.0]))
     assert "requires_jit_compile_False_see_docs_adapters_md" in str(ei.value)
+
+
+def test_grouped_allgather(tfhvd, n_workers):
+    """hvd.grouped_allgather parity: a list gathers as one fusion group,
+    eagerly and under jit_compile (single-process trace-time lowering)."""
+    a = tf.constant([[1.0, 2.0]])
+    b = tf.constant([[3.0], [4.0]])
+    outs = tfhvd.grouped_allgather([a, b], name="tf_gag")
+    assert outs[0].shape == (n_workers, 2)
+    assert outs[1].shape == (2 * n_workers, 1)
+    np.testing.assert_allclose(outs[0].numpy()[0], [1.0, 2.0])
+
+    @tf.function(jit_compile=True)
+    def step(x, y):
+        return tfhvd.grouped_allgather([x, y])
+
+    ja, jb = step(a, b)
+    np.testing.assert_allclose(ja.numpy(), outs[0].numpy())
+    np.testing.assert_allclose(jb.numpy(), outs[1].numpy())
