@@ -220,8 +220,11 @@ def allgather_object(obj, name=None, process_set=None):
     if len(procs) <= 1:
         return [obj]
     from .utils import multihost_subset_allgather_bytes
-    blobs = multihost_subset_allgather_bytes(
-        pickle.dumps(obj), procs, tag=name or "ago")
+    # one fixed stream per group: same-call-order across members is the
+    # invariant anyway, and user names must not be able to collide with
+    # other key streams
+    blobs = multihost_subset_allgather_bytes(pickle.dumps(obj), procs,
+                                             tag="ago")
     return [pickle.loads(b) for b in blobs]
 
 

@@ -288,7 +288,7 @@ class ElasticDriver:
         # driver declares the epoch failed — otherwise it stays a full
         # epoch behind every re-form (user-set values win)
         env.setdefault("HOROVOD_ELASTIC_INIT_TIMEOUT",
-                       str(max(30, int(self.start_timeout))))
+                       str(max(5, int(self.start_timeout))))
         proc = self._launch(slot, coord_addr, coord_port, env)
         with self._lock:
             self._workers[wid] = _Worker(wid, slot, proc, epoch)

@@ -465,14 +465,44 @@ def _allgather_fn_for(ps):
     return _stacked_allgather_fn(mesh_key(ps), ps.axis)
 
 
-def allgather_array(x, ps):
+def allgather_array(x, ps, peer_rows=None):
+    """``peer_rows`` is the negotiation-agreed ``(procs, sizes)`` for
+    this array (Allgatherv, reference: the controller's tensor-size
+    gathering rides the round — see engine._negotiate); uniform sizes
+    take the plain path at zero extra cost.  Without a controller
+    (single process, or HOROVOD_TPU_CONTROLLER=0), cross-process
+    allgather requires uniform dim-0."""
     if is_stacked(x, ps):
         return _allgather_fn_for(ps)(x)
     if spans_processes(ps):
+        if peer_rows is not None:
+            procs, sizes = peer_rows
+            if any(s != sizes[0] for s in sizes):
+                return _allgather_uneven(x, ps, procs, sizes)
         return _allgather_fn_for(ps)(lift_to_workers(x, ps))
     # replicated: every worker contributes the same tensor → tile
     n = ps.size()
     return jnp.concatenate([x] * n, axis=0)
+
+
+def _allgather_uneven(x, ps, procs, sizes):
+    """Uneven (Allgatherv) payload path: pad this process's rows to
+    max(sizes), run ONE uniform allgather over the mesh, slice each
+    worker's block back to its process's true row count.  Wire cost is
+    n_workers * max(sizes) rows — the same bounded-padding trade as the
+    uneven alltoall."""
+    mx = max(sizes)
+    x = np.asarray(x)
+    if x.shape[0] < mx:
+        pad = np.zeros((mx - x.shape[0],) + x.shape[1:], x.dtype)
+        x = np.concatenate([x, pad], axis=0)
+    full = _allgather_fn_for(ps)(lift_to_workers(x, ps))
+    rows_by_proc = dict(zip(procs, sizes))
+    out = []
+    for w, d in enumerate(ps.mesh.devices.flat):
+        r = rows_by_proc[int(d.process_index)]
+        out.append(full[w * mx: w * mx + r])
+    return jnp.concatenate(out, axis=0)
 
 
 def broadcast_array(x, root_rank: int, ps):

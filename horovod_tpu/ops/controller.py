@@ -109,6 +109,10 @@ class NegotiationResult:
     # active member's published dict (reference: parameter_manager syncs
     # tuned params from rank 0 via the coordinator)
     params: Optional[dict] = None
+    # per-process auxiliary payloads published with the round (e.g.
+    # allgather row counts — the reference controller's tensor-size
+    # gathering): {process: {key: value}}
+    aux: Dict[int, dict] = dataclasses.field(default_factory=dict)
 
 
 def entry_token(entry) -> str:
@@ -119,7 +123,17 @@ def entry_token(entry) -> str:
     """
     # group ids are per-process counters; only grouped-vs-not matters on
     # the wire (group atomicity is entry-level: one entry holds the group)
-    sigs = [[s.name, s.op_type, s.reduce_op, s.dtype, list(s.shape),
+    def wire_shape(s):
+        # allgather is Allgatherv (reference: MPI_Allgatherv via the
+        # controller's size gathering): ranks may contribute different
+        # dim-0 row counts, so dim 0 is wildcarded out of the match
+        # identity — the dispatch path exchanges actual row counts
+        shape = list(s.shape)
+        if s.op_type == "allgather" and shape:
+            shape[0] = -1
+        return shape
+
+    sigs = [[s.name, s.op_type, s.reduce_op, s.dtype, wire_shape(s),
              s.process_set_id, bool(s.stacked),
              -1 if s.group_id == -1 else 0,
              s.prescale, s.postscale] for s in entry.sigs()]
@@ -252,7 +266,8 @@ class Controller:
 
     # -- the round -----------------------------------------------------------
     def negotiate(self, tokens: List[str], procs: Tuple[int, ...],
-                  params: Optional[dict] = None) -> NegotiationResult:
+                  params: Optional[dict] = None,
+                  aux: Optional[dict] = None) -> NegotiationResult:
         """Run one negotiation round over ``tokens`` with the member
         ``procs`` (sorted process indices of the collective's process set).
 
@@ -267,6 +282,14 @@ class Controller:
         decision adopts the lowest-indexed active member's (the rank-0
         sync of the reference's parameter_manager, made cycle-exact by
         riding the round itself so all members flip in the same cycle).
+
+        ``aux``, when given, is an arbitrary small per-process payload
+        published with the round and returned verbatim per process in
+        ``NegotiationResult.aux`` — the transport for data every member
+        needs about every other member this cycle (e.g. Allgatherv row
+        counts, the reference controller's tensor-size gathering).  It
+        rides hash-only fast rounds too, so it may change while the
+        cycle signature stays cached.
         """
         me = jax.process_index()
         if me not in procs:
@@ -298,6 +321,8 @@ class Controller:
                 val["js"] = join_seq
             if params is not None:
                 val["p"] = params
+            if aux:
+                val["x"] = aux
             if not cached or joined:
                 val["e"] = my_sorted
             _kv_set(client, self._key(gk, f"{seq}/a/{me}"),
@@ -317,6 +342,7 @@ class Controller:
             agreed_params = next(
                 (vals[q]["p"] for q in sorted(active) if "p" in vals[q]),
                 None)
+            aux_by_proc = {q: vals[q]["x"] for q in vals if "x" in vals[q]}
             with self._lock:
                 self.rounds += 1
 
@@ -340,7 +366,8 @@ class Controller:
                         self.full_rounds += 1
                 self._cleanup(client, gk, seq, me)
                 return NegotiationResult(counts=Counter(tokens), fast=fast,
-                                         params=agreed_params)
+                                         params=agreed_params,
+                                         aux=aux_by_proc)
 
             # mismatch (or join in progress): full request lists needed.
             with self._lock:
@@ -361,6 +388,7 @@ class Controller:
 
             result = self._decide(gk, full, active, joined_ps, vals, me)
             result.params = agreed_params
+            result.aux = aux_by_proc
             self._cleanup(client, gk, seq, me)
             return result
 

@@ -19,6 +19,7 @@ response-cache bit-vector optimization taken to its limit).
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import sys
@@ -44,7 +45,8 @@ class TensorTableEntry:
 
     __slots__ = ("name", "op_type", "reduce_op", "arrays", "process_set",
                  "prescale", "postscale", "root_rank", "splits", "stacked",
-                 "handle", "enqueue_time", "group_id", "callback")
+                 "handle", "enqueue_time", "group_id", "callback",
+                 "peer_rows")
 
     def __init__(self, name, op_type, arrays, process_set,
                  reduce_op=ReduceOp.AVERAGE, prescale=None, postscale=None,
@@ -64,6 +66,8 @@ class TensorTableEntry:
         self.handle: Optional[Handle] = None
         self.enqueue_time = 0.0
         self.callback = callback
+        # Allgatherv: per-array (procs, sizes) agreed by negotiation
+        self.peer_rows: Optional[dict] = None
 
     def sigs(self) -> List[EntrySig]:
         out = []
@@ -361,9 +365,40 @@ class CollectiveEngine:
                           "c": self.autotuner.current_cycle_time_ms(),
                           "ca": self.autotuner.current_cache_enabled(),
                           "hi": self.autotuner.current_hierarchical()}
-            res = ctl.negotiate(tokens, procs, params=params)
+            # Allgatherv row counts ride the round (reference: the
+            # controller's tensor-size gathering): dim 0 is wildcarded
+            # out of the allgather match identity, so each member
+            # publishes its actual rows per (token, array)
+            rows: dict = {}
+            digests: dict = {}
+            for e, t in zip(grp, tokens):
+                if e.op_type != "allgather":
+                    continue
+                dg = digests.setdefault(
+                    t, hashlib.sha1(t.encode()).hexdigest()[:12])
+                for i, a in enumerate(e.arrays):
+                    try:
+                        shape = a.shape
+                    except AttributeError:
+                        shape = ()
+                    if shape:
+                        rows[f"{dg}.{i}"] = int(shape[0])
+            res = ctl.negotiate(tokens, procs, params=params,
+                                aux={"rw": rows} if rows else None)
             if res.params is not None:
                 self._negotiated_params = res.params
+            if res.aux:
+                for e, t in zip(grp, tokens):
+                    if e.op_type != "allgather":
+                        continue
+                    dg = digests[t]
+                    pr = {}
+                    for i in range(len(e.arrays)):
+                        sizes = [res.aux.get(p, {}).get("rw", {}).get(
+                            f"{dg}.{i}") for p in procs]
+                        if all(v is not None for v in sizes):
+                            pr[i] = (procs, [int(v) for v in sizes])
+                    e.peer_rows = pr or None
             last_res = res
             counts = dict(res.counts)
             for e, t in zip(grp, tokens):
@@ -626,7 +661,9 @@ class CollectiveEngine:
                 e = entries[owner[si]]
                 x = arr(si)
                 if op_type == "allgather":
-                    results[si] = collectives.allgather_array(x, e.process_set)
+                    pr = (e.peer_rows or {}).get(si - base[owner[si]])
+                    results[si] = collectives.allgather_array(
+                        x, e.process_set, peer_rows=pr)
                 elif op_type == "broadcast":
                     results[si] = collectives.broadcast_array(
                         x, e.root_rank, e.process_set)

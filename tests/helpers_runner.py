@@ -318,3 +318,21 @@ def allgather_object_fn():
     r = hvd.cross_rank()
     objs = hvd.allgather_object({"rank": r, "payload": [r] * (r + 1)})
     return {"rank": r, "objs": objs}
+
+
+def uneven_allgather_fn():
+    """Reference Allgatherv semantics: processes contribute different
+    dim-0 row counts; allgather concatenates every worker's TRUE rows
+    (dim 0 is wildcarded out of the negotiation match identity)."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    x = (np.arange((r + 2) * 2, dtype=np.float32).reshape(r + 2, 2)
+         + 100 * r)
+    out = hvd.allgather(x, name="agv")
+    h = hvd.allgather_async(np.full((r + 1, 1), float(r), np.float32),
+                            name="agv2")
+    out2 = h.synchronize()
+    return {"rank": r, "out": np.asarray(out).tolist(),
+            "out2": np.asarray(out2).tolist()}

@@ -289,3 +289,18 @@ def test_allgather_object_cross_process():
     expected = [{"rank": 0, "payload": [0]}, {"rank": 1, "payload": [1, 1]}]
     for r in results:
         assert r["objs"] == expected
+
+
+def test_uneven_allgather_cross_process():
+    """Reference parity: hvd.allgather is Allgatherv — ranks may
+    contribute different dim-0 sizes (controller.cc gathers tensor
+    sizes).  Both processes receive the concatenation of every worker's
+    true rows, and the async submit stays non-blocking."""
+    results = run(helpers_runner.uneven_allgather_fn, np=2, env=_env(),
+                  port=29559)
+    expected = [[0.0, 1.0], [2.0, 3.0],
+                [100.0, 101.0], [102.0, 103.0], [104.0, 105.0]]
+    expected2 = [[0.0], [1.0], [1.0]]
+    for r in results:
+        assert r["out"] == expected
+        assert r["out2"] == expected2
