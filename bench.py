@@ -83,6 +83,58 @@ def bench_bert():
     }))
 
 
+def bench_resnet():
+    """ResNet-50 synthetic entry (HOROVOD_BENCH_MODEL=resnet): img/sec
+    through the data-parallel classifier step — BASELINE config 2, the
+    reference's pytorch_synthetic_benchmark.py.  The default metric
+    stays llama_1b so round-over-round numbers remain comparable."""
+    import optax
+    from jax.sharding import NamedSharding
+
+    from horovod_tpu import training
+    from horovod_tpu.models import resnet
+    from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    variant, img, batch, steps = (50, 224, 32, 20) if not on_cpu \
+        else (18, 32, 2, 3)
+    cfg = resnet.ResNetConfig(variant=variant, dtype=jnp.bfloat16)
+    n_chips = jax.local_device_count()
+    pmesh = ParallelMesh(MeshConfig(dp=n_chips))
+    ts = training.make_classifier_train_step(
+        lambda p, s, x, train, axis_name: resnet.forward(
+            p, s, x, cfg, train=train, axis_name=axis_name),
+        lambda rng: resnet.init(cfg, rng), pmesh,
+        optimizer=optax.sgd(0.01, momentum=0.9), sync_bn=True)
+    params, state, opt_state = ts.init_fn(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    B = batch * n_chips
+    sh = NamedSharding(ts.mesh, ts.data_spec)
+    x = jax.device_put(jnp.asarray(rng.rand(B, img, img, 3), jnp.float32),
+                       sh)
+    y = jax.device_put(jnp.asarray(rng.randint(0, 1000, B), jnp.int32), sh)
+
+    params, state, opt_state, loss, _ = ts.step_fn(
+        params, state, opt_state, x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, state, opt_state, loss, _ = ts.step_fn(
+            params, state, opt_state, x, y)
+    float(loss)
+    dt = time.perf_counter() - t0
+    img_per_sec_chip = batch * steps / dt
+    # ResNet-50 fwd ~4.09 GFLOPs/image at 224^2; train ~3x fwd
+    flops_per_img = 3 * 4.089e9 if variant == 50 else 0.0
+    mfu = (img_per_sec_chip * flops_per_img) / (detect_peak() * 1e12)
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec_per_chip",
+        "value": round(img_per_sec_chip, 1),
+        "unit": "img/s/chip",
+        "vs_baseline": round(mfu / 0.40, 3),
+    }))
+
+
 def bench_longctx():
     """Long-context entry (HOROVOD_BENCH_MODEL=longctx): training
     throughput at 8k sequence length, where the flash-attention kernel's
@@ -150,6 +202,8 @@ def main():
         return bench_bert()
     if os.environ.get("HOROVOD_BENCH_MODEL") == "longctx":
         return bench_longctx()
+    if os.environ.get("HOROVOD_BENCH_MODEL") == "resnet":
+        return bench_resnet()
 
     on_cpu = jax.devices()[0].platform == "cpu"
     # ~1B-param geometry: head_dim 128 keeps the flash kernel's score

@@ -219,12 +219,14 @@ def allgather_object(obj, name=None, process_set=None):
             f"rejects collectives from non-members")
     if len(procs) <= 1:
         return [obj]
+    import hashlib
     from .utils import multihost_subset_allgather_bytes
-    # one fixed stream per group: same-call-order across members is the
-    # invariant anyway, and user names must not be able to collide with
-    # other key streams
+    # per-name key streams (concurrent named gathers stay isolated), but
+    # the NAME IS HASHED into the tag so user strings cannot collide
+    # with internal key streams
+    tag = "ago_" + hashlib.sha1((name or "").encode()).hexdigest()[:8]
     blobs = multihost_subset_allgather_bytes(pickle.dumps(obj), procs,
-                                             tag="ago")
+                                             tag=tag)
     return [pickle.loads(b) for b in blobs]
 
 

@@ -369,33 +369,43 @@ class CollectiveEngine:
             # controller's tensor-size gathering): dim 0 is wildcarded
             # out of the allgather match identity, so each member
             # publishes its actual rows per (token, array)
+            # keys carry an occurrence index: duplicate tokens (same
+            # name submitted twice in one cycle — the Counter-based
+            # negotiation supports it) pair instance k with every peer's
+            # instance k, matching the counts-based dispatch order
             rows: dict = {}
             digests: dict = {}
+            occ: dict = {}
             for e, t in zip(grp, tokens):
                 if e.op_type != "allgather":
                     continue
                 dg = digests.setdefault(
                     t, hashlib.sha1(t.encode()).hexdigest()[:12])
+                k = occ.get(t, 0)
+                occ[t] = k + 1
                 for i, a in enumerate(e.arrays):
                     try:
                         shape = a.shape
                     except AttributeError:
                         shape = ()
                     if shape:
-                        rows[f"{dg}.{i}"] = int(shape[0])
+                        rows[f"{dg}.{k}.{i}"] = int(shape[0])
             res = ctl.negotiate(tokens, procs, params=params,
                                 aux={"rw": rows} if rows else None)
             if res.params is not None:
                 self._negotiated_params = res.params
             if res.aux:
+                occ = {}
                 for e, t in zip(grp, tokens):
                     if e.op_type != "allgather":
                         continue
                     dg = digests[t]
+                    k = occ.get(t, 0)
+                    occ[t] = k + 1
                     pr = {}
                     for i in range(len(e.arrays)):
                         sizes = [res.aux.get(p, {}).get("rw", {}).get(
-                            f"{dg}.{i}") for p in procs]
+                            f"{dg}.{k}.{i}") for p in procs]
                         if all(v is not None for v in sizes):
                             pr[i] = (procs, [int(v) for v in sizes])
                     e.peer_rows = pr or None
@@ -444,7 +454,12 @@ class CollectiveEngine:
                 f"broadcast, barrier)")
         table = runtime._state().process_set_table
         ps = table.get(sigs[0][5])
-        arrays = [jnp.zeros(tuple(s[4]), dtype=s[3]) for s in sigs]
+        # numpy zeros, NOT jnp: numpy honors 64-bit dtypes regardless of
+        # the x64 mode, so the synthesized sigs read the token's true
+        # dtype and this process enters the same x64 dispatch scope (and
+        # traces the same SPMD program) as the peers that submitted it
+        import numpy as _np
+        arrays = [_np.zeros(tuple(s[4]), dtype=s[3]) for s in sigs]
         entry = TensorTableEntry(
             name=sigs[0][0].rsplit(".", 1)[0] if len(sigs) > 1
             else sigs[0][0],
@@ -521,6 +536,20 @@ class CollectiveEngine:
                 # cycle's agreed dispatch set (requeued entries stay open)
                 self.timeline.negotiate_end(e.name)
 
+        # dtype-exact contract (reference: MPI/NCCL ops are exact per
+        # dtype): 64-bit tensors must come back 64-bit, but JAX's x64
+        # mode is off by default and silently downcasts at the lift.
+        # Scope x64 to cycles that actually carry 64-bit data — the
+        # jitted collective fns re-trace per aval, so 32-bit steady
+        # state pays nothing.
+        import contextlib
+        x64 = (jax.enable_x64(True)
+               if any(str(s.dtype) in ("int64", "uint64", "float64")
+                      for s in sigs) else contextlib.nullcontext())
+        with x64:
+            self._execute_planned(entries, sigs, owner, base)
+
+    def _execute_planned(self, entries, sigs, owner, base):
         use_cache = self._cache_enabled()
         threshold = self._fusion_threshold()
         if threshold != self._last_threshold:

@@ -336,3 +336,21 @@ def uneven_allgather_fn():
     out2 = h.synchronize()
     return {"rank": r, "out": np.asarray(out).tolist(),
             "out2": np.asarray(out2).tolist()}
+
+
+def join_uneven_f64_fn():
+    """join() with a 64-bit collective outstanding: the joined process's
+    zero synthesis must carry the token's TRUE dtype (float64) so both
+    processes enter the same x64 dispatch scope and trace the same
+    program."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    sums = []
+    for i in range(2 if r == 0 else 1):
+        out = hvd.allreduce(np.full((3,), float(r + 1), np.float64),
+                            name="g64", op=hvd.Sum)
+        sums.append(np.asarray(out).tolist())
+    last = hvd.join()
+    return {"rank": r, "sums": sums, "last": last}

@@ -305,3 +305,29 @@ def test_alltoall_uneven_bounded_wire_cost(hvd, monkeypatch):
              for i in range(8)]) if splits[j] else np.zeros((0, 2))
         np.testing.assert_allclose(np.asarray(out[j]),
                                    expected.reshape(-1, 2))
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "float16",
+                                   "bfloat16", "int32", "int64", "uint8"])
+def test_allreduce_dtype_sweep(hvd, n_workers, dtype):
+    """Reference test strategy (SURVEY §4): every op x dtype.  Sum of
+    identical replicated contributions = n * x for every wire dtype."""
+    import jax.numpy as jnp
+    x = np.ones((4,), np.float64).astype(dtype)
+    out = hvd.allreduce(x, op=hvd.Sum, name=f"dt_sum_{dtype}")
+    assert str(jnp.asarray(out).dtype) == dtype
+    np.testing.assert_allclose(
+        np.asarray(out).astype(np.float64), float(n_workers) * np.ones(4))
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float16", "bfloat16",
+                                   "int32", "int64", "uint8"])
+def test_allgather_broadcast_dtype_sweep(hvd, n_workers, dtype):
+    import jax.numpy as jnp
+    x = (np.arange(6, dtype=np.float64).reshape(3, 2) + 1).astype(dtype)
+    g = hvd.allgather(x, name=f"dt_ag_{dtype}")
+    assert np.asarray(g).shape == (3 * n_workers, 2)
+    assert str(jnp.asarray(g).dtype) == dtype
+    b = hvd.broadcast(x, 0, name=f"dt_bc_{dtype}")
+    np.testing.assert_array_equal(np.asarray(b).astype(np.float64),
+                                  np.asarray(x).astype(np.float64))

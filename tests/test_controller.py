@@ -304,3 +304,16 @@ def test_uneven_allgather_cross_process():
     for r in results:
         assert r["out"] == expected
         assert r["out2"] == expected2
+
+
+def test_join_with_float64_collective():
+    """x64-exact synthesis: a joined process zero-fills a float64 token
+    with float64 (not a silently-downcast float32), so the two
+    processes execute the same SPMD program."""
+    results = run(helpers_runner.join_uneven_f64_fn, np=2, env=_env(),
+                  port=29561)
+    by_rank = {r["rank"]: r for r in results}
+    assert by_rank[0]["sums"][0] == [3.0, 3.0, 3.0]
+    assert by_rank[1]["sums"] == [[3.0, 3.0, 3.0]]
+    assert by_rank[0]["sums"][1] == [1.0, 1.0, 1.0]  # zero from joined
+    assert by_rank[0]["last"] == 0

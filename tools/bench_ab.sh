@@ -25,3 +25,4 @@ run HOROVOD_BENCH_FUSED_XENT=1 HOROVOD_BENCH_OPT=lp
 run HOROVOD_BENCH_FUSED_XENT=1 HOROVOD_BENCH_OPT=lp HOROVOD_BENCH_REMAT_SKIP=1
 run HOROVOD_BENCH_MODEL=bert
 run HOROVOD_BENCH_MODEL=longctx
+run HOROVOD_BENCH_MODEL=resnet
