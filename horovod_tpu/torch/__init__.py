@@ -44,7 +44,7 @@ __all__ = [
     "Min", "Max",
     "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
     "grouped_allreduce", "grouped_allreduce_async", "allgather",
-    "allgather_async", "grouped_allgather", "reducescatter",
+    "allgather_async", "grouped_allgather", "reducescatter", "grouped_reducescatter",
     "broadcast", "broadcast_async", "broadcast_",
     "broadcast_async_", "alltoall", "alltoall_async", "synchronize",
     "poll", "join", "barrier", "broadcast_object", "allgather_object",
@@ -161,13 +161,9 @@ def grouped_allgather(tensors: Sequence[torch.Tensor], name=None,
             .to(t.dtype) for o, t in zip(outs, tensors)]
 
 
-def reducescatter(tensor: torch.Tensor, op=None, name=None,
-                  process_set=None) -> torch.Tensor:
-    """Reference: hvd.reducescatter — reduce then keep this worker's
-    slice of dim 0."""
-    ps = _api._ps(process_set)
-    res = _api.reducescatter(_to_np(tensor), op=op, name=name,
-                             process_set=process_set)
+def _rs_own_slice(res, tensor: torch.Tensor, ps) -> torch.Tensor:
+    """Extract this worker's row from a (possibly stacked) reducescatter
+    result and convert back to torch."""
     if getattr(res, "ndim", 0) == tensor.dim() + 1:
         # stacked per-worker result: take this worker's row from its own
         # addressable shard (the full array spans other hosts)
@@ -191,6 +187,28 @@ def reducescatter(tensor: torch.Tensor, op=None, name=None,
     else:
         a = np.asarray(res)
     return torch.from_numpy(np.array(a, copy=True)).to(tensor.dtype)
+
+
+def reducescatter(tensor: torch.Tensor, op=None, name=None,
+                  process_set=None) -> torch.Tensor:
+    """Reference: hvd.reducescatter — reduce then keep this worker's
+    slice of dim 0."""
+    ps = _api._ps(process_set)
+    res = _api.reducescatter(_to_np(tensor), op=op, name=name,
+                             process_set=process_set)
+    return _rs_own_slice(res, tensor, ps)
+
+
+def grouped_reducescatter(tensors: Sequence[torch.Tensor], op=None,
+                          name=None, process_set=None
+                          ) -> List[torch.Tensor]:
+    """Reference: hvd.grouped_reducescatter — one atomic fusion group
+    (a single engine entry, all-or-nothing in the cycle plan)."""
+    ps = _api._ps(process_set)
+    outs = _api.grouped_reducescatter([_to_np(t) for t in tensors],
+                                      op=op, name=name,
+                                      process_set=process_set)
+    return [_rs_own_slice(o, t, ps) for o, t in zip(outs, tensors)]
 
 
 def allgather_async(tensor: torch.Tensor, name=None,

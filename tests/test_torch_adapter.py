@@ -329,3 +329,16 @@ def test_torch_reducescatter_two_process():
     # rank1 keeps [6, 9]
     assert by_rank[0]["out"] == [0.0, 3.0]
     assert by_rank[1]["out"] == [6.0, 9.0]
+
+
+def test_grouped_reducescatter(thvd, n_workers):
+    """hvd.grouped_reducescatter parity: one atomic group, each tensor
+    reduced then sliced to this worker's rows."""
+    import torch
+    a = torch.ones(n_workers * 2, 3)
+    b = torch.full((n_workers, 1), 2.0)
+    outs = thvd.grouped_reducescatter([a, b], op=thvd.Sum, name="grs")
+    assert outs[0].shape == (2, 3)
+    assert outs[1].shape == (1,) or outs[1].shape == (1, 1)
+    assert float(outs[0][0, 0]) == float(n_workers)
+    assert float(outs[1].reshape(-1)[0]) == 2.0 * n_workers
