@@ -354,3 +354,32 @@ def join_uneven_f64_fn():
         sums.append(np.asarray(out).tolist())
     last = hvd.join()
     return {"rank": r, "sums": sums, "last": last}
+
+
+def four_process_fn():
+    """4-process controller exercise: global reduction, an overlapping
+    {0,2} subset group negotiated independently, ragged allgather across
+    4 contributors, and uneven join order."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    out = hvd.allreduce(np.full((2,), float(r + 1), np.float32),
+                        name="g4", op=hvd.Sum)
+    ps02 = hvd.add_process_set([0, 2])  # all processes register it
+    sub = None
+    if r in (0, 2):
+        sub = np.asarray(hvd.allreduce(
+            np.full((2,), float(r + 1), np.float32), name="sub02",
+            op=hvd.Sum, process_set=ps02)).tolist()
+    ag = hvd.allgather(
+        np.full((r + 1, 1), float(r), np.float32), name="ag4")
+    # processes finish at different times: ranks 1..3 join early
+    extra = None
+    if r == 0:
+        extra = float(np.asarray(hvd.allreduce(
+            np.ones((2,), np.float32), name="tail", op=hvd.Sum))[0])
+    last = hvd.join()
+    return {"rank": r, "sum": np.asarray(out).tolist(), "sub": sub,
+            "ag": np.asarray(ag).reshape(-1).tolist(), "extra": extra,
+            "last": last}

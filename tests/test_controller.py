@@ -317,3 +317,22 @@ def test_join_with_float64_collective():
     assert by_rank[1]["sums"] == [[3.0, 3.0, 3.0]]
     assert by_rank[0]["sums"][1] == [1.0, 1.0, 1.0]  # zero from joined
     assert by_rank[0]["last"] == 0
+
+
+def test_four_process_controller():
+    """Scale the cross-process protocol past np=2: global + overlapping
+    subset groups, 4-way ragged allgather, and a 3-early-joiner join —
+    all on one round-trip ordering (reference: test/parallel at -np 4)."""
+    results = run(helpers_runner.four_process_fn, np=4, env=_env(),
+                  port=29563)
+    assert len(results) == 4
+    expected_ag = [0.0] + [1.0] * 2 + [2.0] * 3 + [3.0] * 4
+    for r in results:
+        assert r["sum"] == [10.0, 10.0]            # 1+2+3+4
+        assert r["ag"] == expected_ag
+        assert r["last"] == 0                      # rank 0 joined last
+    by_rank = {r["rank"]: r for r in results}
+    assert by_rank[0]["sub"] == [4.0, 4.0]         # 1+3
+    assert by_rank[2]["sub"] == [4.0, 4.0]
+    assert by_rank[1]["sub"] is None
+    assert by_rank[0]["extra"] == 1.0              # zeros from 3 joined
