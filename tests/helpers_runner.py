@@ -383,3 +383,39 @@ def four_process_fn():
     return {"rank": r, "sum": np.asarray(out).tolist(), "sub": sub,
             "ag": np.asarray(ag).reshape(-1).tolist(), "extra": extra,
             "last": last}
+
+
+def mixed_op_storm_fn():
+    """Cross-process storm: a seeded mixed sequence of allreduce /
+    ragged allgather / broadcast (same ORDER on both processes,
+    rank-dependent values and ragged sizes) — the protocol must keep
+    every cycle's dispatch agreed and every result exact."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    rng = np.random.RandomState(7)     # same op sequence on all ranks
+    ok = 0
+    for i in range(30):
+        kind = rng.randint(3)
+        if kind == 0:
+            n = int(rng.randint(1, 6))
+            out = hvd.allreduce(np.full((n,), float(r + 1), np.float32),
+                                name=f"ar{i}", op=hvd.Sum)
+            assert np.allclose(np.asarray(out), 3.0), (i, out)
+        elif kind == 1:
+            d = int(rng.randint(1, 4))
+            rows = d + r                        # ragged per rank
+            out = hvd.allgather(
+                np.full((rows, 2), float(r), np.float32), name=f"ag{i}")
+            exp = [0.0] * d + [1.0] * (d + 1)
+            got = np.asarray(out)[:, 0].tolist()
+            assert got == exp, (i, got, exp)
+        else:
+            out = hvd.broadcast(
+                np.full((3,), float(r + 5), np.float32), 1, name=f"bc{i}")
+            assert np.allclose(np.asarray(out), 6.0), (i, out)
+        ok += 1
+    st = hvd.runtime._state().engine.stats()["negotiation"]
+    return {"rank": r, "ok": ok, "rounds": st["rounds"],
+            "fast": st["fast_rounds"]}

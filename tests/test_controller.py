@@ -336,3 +336,15 @@ def test_four_process_controller():
     assert by_rank[2]["sub"] == [4.0, 4.0]
     assert by_rank[1]["sub"] is None
     assert by_rank[0]["extra"] == 1.0              # zeros from 3 joined
+
+
+def test_mixed_op_storm_cross_process():
+    """30 mixed collectives (allreduce / RAGGED allgather / broadcast)
+    in one seeded order across 2 processes: every cycle's dispatch must
+    agree and every value must be exact; the steady-state fast path must
+    engage at least once across repeated signatures."""
+    results = run(helpers_runner.mixed_op_storm_fn, np=2, env=_env(),
+                  port=29565)
+    for r in results:
+        assert r["ok"] == 30
+        assert r["rounds"] >= 30
