@@ -47,7 +47,8 @@ __all__ = [
     "broadcast",
     "broadcast_variables", "broadcast_object", "allgather_object",
     "alltoall", "join",
-    "barrier", "DistributedGradientTape", "DistributedOptimizer",
+    "barrier", "rank_op", "size_op", "local_rank_op", "local_size_op",
+    "DistributedGradientTape", "DistributedOptimizer",
     "Compression", "ProcessSet", "add_process_set", "remove_process_set",
 ]
 
@@ -248,6 +249,23 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
     out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype,
                          name=f"HorovodAlltoall__{_XLA_FENCE}")
     return out
+
+
+def rank_op(name=None):
+    """Graph-mode rank (reference: hvd.tensorflow rank_op)."""
+    return tf.constant(rank(), name=name or "horovod_rank")
+
+
+def size_op(name=None):
+    return tf.constant(size(), name=name or "horovod_size")
+
+
+def local_rank_op(name=None):
+    return tf.constant(local_rank(), name=name or "horovod_local_rank")
+
+
+def local_size_op(name=None):
+    return tf.constant(local_size(), name=name or "horovod_local_size")
 
 
 def join(device: int = -1) -> int:

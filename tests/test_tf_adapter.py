@@ -265,3 +265,17 @@ def test_grouped_allgather(tfhvd, n_workers):
     ja, jb = step(a, b)
     np.testing.assert_allclose(ja.numpy(), outs[0].numpy())
     np.testing.assert_allclose(jb.numpy(), outs[1].numpy())
+
+
+def test_graph_mode_topology_ops(tfhvd, n_workers):
+    """rank_op/size_op/local_*_op parity (reference: graph-mode ops)."""
+
+    @tf.function
+    def f():
+        return (tfhvd.rank_op(), tfhvd.size_op(),
+                tfhvd.local_rank_op(), tfhvd.local_size_op())
+
+    r, s, lr, ls = f()
+    assert int(s) == n_workers
+    assert int(r) == 0 and int(lr) == 0
+    assert int(ls) == n_workers
