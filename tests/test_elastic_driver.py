@@ -351,3 +351,66 @@ def test_elastic_integration_worker_failure_recovers(tmp_path):
         last_steps[r["rank"]] = max(last_steps.get(r["rank"], -1), r["step"])
     # the job reached the final step after recovery
     assert max(last_steps.values()) == 9, last_steps
+
+
+def test_elastic_integration_scale_down(tmp_path):
+    """3 localhost workers → hostfile SHRINKS to 2 → the removed worker
+    is told to leave, the job re-forms at size 2, and training runs to
+    completion (reference: discovery-driven downscale, the preemption
+    shape on TPU slices)."""
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("localhost:3\n")
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER_SCRIPT)
+    out_base = tmp_path / "out"
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        "TEST_TOTAL_STEPS": "14",
+        "TEST_OUT": str(out_base),
+        "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+        "HOROVOD_CYCLE_TIME": "0.2",
+    }
+    driver = ElasticDriver(
+        discovery.HostDiscoveryScript(f"cat {hostfile}"),
+        [sys.executable, str(worker_py)],
+        min_np=2, port=free_port(), discovery_interval=0.3,
+        start_timeout=60.0, blacklist_threshold=8, env=env, verbose=False)
+
+    rc = {}
+    t = threading.Thread(target=lambda: rc.update(code=driver.run()),
+                         daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            recs = _read_records(out_base)
+            if sum(1 for r in recs if r["size"] == 3) >= 6:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"no size-3 progress; records={recs}")
+
+        hostfile.write_text("localhost:2\n")
+
+        while time.monotonic() < deadline:
+            recs = _read_records(out_base)
+            if sum(1 for r in recs if r["size"] == 2) >= 2:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"never re-formed at size 2; records={recs}")
+
+        t.join(timeout=180)
+        assert not t.is_alive(), "driver did not finish"
+        assert rc.get("code") == 0
+    finally:
+        driver._terminate_all()
+        driver._server.close()
+
+    recs = _read_records(out_base)
+    # the job finished all steps, and the post-shrink steps ran at size 2
+    assert max(r["step"] for r in recs) == 13
+    assert {r["size"] for r in recs if r["step"] >= 12} == {2}
