@@ -248,7 +248,7 @@ def test_elastic_integration_scale_up(tmp_path):
         discovery.HostDiscoveryScript(f"cat {hostfile}"),
         [sys.executable, str(worker_py)],
         min_np=2, port=free_port(), discovery_interval=0.3,
-        start_timeout=60.0, blacklist_threshold=8, env=env, verbose=False)
+        start_timeout=120.0, blacklist_threshold=8, env=env, verbose=False)
 
     rc = {}
     t = threading.Thread(target=lambda: rc.update(code=driver.run()),
@@ -256,9 +256,11 @@ def test_elastic_integration_scale_up(tmp_path):
     t.start()
     try:
         # generous: a fully-loaded 1-core host re-forms 3 workers in
-        # ~40-90 s (spawn + jax import each); the wall must cover two
-        # formations plus training progress
-        deadline = time.monotonic() + 240
+        # ~40-90 s (spawn + jax import each) with tens of seconds of
+        # member skew, so the formation window (start_timeout, which
+        # also sets the members' register deadline) must cover the
+        # skew and the wall must cover two formations plus progress
+        deadline = time.monotonic() + 360
         while time.monotonic() < deadline:
             recs = _read_records(out_base)
             if sum(1 for r in recs if r["size"] == 2) >= 4:
@@ -377,14 +379,14 @@ def test_elastic_integration_scale_down(tmp_path):
         discovery.HostDiscoveryScript(f"cat {hostfile}"),
         [sys.executable, str(worker_py)],
         min_np=2, port=free_port(), discovery_interval=0.3,
-        start_timeout=60.0, blacklist_threshold=8, env=env, verbose=False)
+        start_timeout=120.0, blacklist_threshold=8, env=env, verbose=False)
 
     rc = {}
     t = threading.Thread(target=lambda: rc.update(code=driver.run()),
                          daemon=True)
     t.start()
     try:
-        deadline = time.monotonic() + 240
+        deadline = time.monotonic() + 360
         while time.monotonic() < deadline:
             recs = _read_records(out_base)
             if sum(1 for r in recs if r["size"] == 3) >= 6:
