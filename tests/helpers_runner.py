@@ -419,3 +419,21 @@ def mixed_op_storm_fn():
     st = hvd.runtime._state().engine.stats()["negotiation"]
     return {"rank": r, "ok": ok, "rounds": st["rounds"],
             "fast": st["fast_rounds"]}
+
+
+def autotune_leader_join_fn():
+    """Leader-join edge for negotiated autotune: after rank 0 (the only
+    parameter publisher) joins, followers keep the last agreed
+    parameters — no follower's untrained tuner becomes authoritative."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    for i in range(2 if r == 0 else 4):
+        out = hvd.allreduce(np.ones((16,), np.float32), name="t",
+                            op=hvd.Sum)
+        assert np.allclose(np.asarray(out), 2.0) or r == 1, out
+    last = hvd.join()
+    st = hvd.runtime._state().engine.stats()["autotune"]
+    return {"rank": r, "last": last, "neg": st["negotiated"],
+            "thr": st["fusion_threshold_bytes"]}

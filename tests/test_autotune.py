@@ -239,3 +239,30 @@ def test_cache_dim_pinned_when_capacity_zero():
     assert all(p[2] == 0.0 for p in pm._grid)
     _feed(pm, lambda thr, cyc: 1e6)
     assert pm.tuned and pm.current_cache_enabled() is False
+
+
+def test_negotiated_autotune_survives_leader_join():
+    """After the publishing leader joins, followers keep the last agreed
+    parameters (frozen, not replaced by an untrained tuner's view) and
+    the job completes."""
+    import helpers_runner
+    from horovod_tpu.runner import run
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    results = run(
+        helpers_runner.autotune_leader_join_fn, np=2,
+        env={
+            "HOROVOD_TPU_FORCE_PLATFORM": "cpu",
+            "PYTHONPATH": REPO + ":" + os.path.join(REPO, "tests"),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "HOROVOD_CYCLE_TIME": "0.2",
+            "HOROVOD_AUTOTUNE": "1",
+            "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "0",
+            "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "1",
+            "HOROVOD_AUTOTUNE_RETUNE_DROP": "0",
+        },
+        port=29567)
+    by_rank = {r["rank"]: r for r in results}
+    assert by_rank[1]["neg"]                  # params were negotiated
+    assert by_rank[0]["last"] == 1            # rank 1 joined last
+    assert by_rank[1]["thr"] > 0
