@@ -290,6 +290,16 @@ def _device_probe_guard(timeout_s: float) -> None:
 
     if os.environ.get("HOROVOD_BENCH_SKIP_PROBE") == "1":
         return
+    # report the failure against the metric+unit this run would have
+    # produced (same HOROVOD_BENCH_MODEL mapping main() dispatches on)
+    metric, unit = {
+        "bert": ("bert_base_finetune_sequences_per_sec_per_chip",
+                 "sequences/s/chip"),
+        "longctx": ("llama_longctx8k_train_tokens_per_sec_per_chip",
+                    "tokens/s/chip"),
+        "resnet": ("resnet50_train_img_per_sec_per_chip", "img/s/chip"),
+    }.get(os.environ.get("HOROVOD_BENCH_MODEL", ""),
+          ("llama_1b_train_tokens_per_sec_per_chip", "tokens/s/chip"))
     # honor HOROVOD_TPU_FORCE_PLATFORM like runner/run_task.py — the
     # axon sitecustomize overrides JAX_PLATFORMS programmatically, so a
     # CPU-forced bench must not send its probe to the TPU claim queue
@@ -307,9 +317,9 @@ def _device_probe_guard(timeout_s: float) -> None:
         out, _ = probe.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
         print(json.dumps({
-            "metric": "llama_1b_train_tokens_per_sec_per_chip",
+            "metric": metric,
             "value": 0.0,
-            "unit": "tokens/s/chip",
+            "unit": unit,
             "vs_baseline": 0.0,
             "error": f"device init did not complete within {timeout_s:.0f}s "
                      "(wedged TPU tunnel? see BENCH_NOTE_r03.md); probe "
@@ -318,9 +328,9 @@ def _device_probe_guard(timeout_s: float) -> None:
         sys.exit(1)
     if b"ok" not in out:
         print(json.dumps({
-            "metric": "llama_1b_train_tokens_per_sec_per_chip",
+            "metric": metric,
             "value": 0.0,
-            "unit": "tokens/s/chip",
+            "unit": unit,
             "vs_baseline": 0.0,
             "error": f"device probe exited rc={probe.returncode}",
         }))

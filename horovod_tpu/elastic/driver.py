@@ -26,6 +26,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..runner import spawn
+from ..runner import secret as _secret
 from ..runner.hosts import HostInfo, assign_slots
 from ..runner.rpc import JsonRpcServer, json_request
 from . import registration
@@ -94,6 +95,10 @@ class ElasticDriver:
         self._reset_count = 0
         self._job_done = False   # a worker's train fn returned successfully
         self._last_progress = time.monotonic()
+        # mint the per-job control-plane secret BEFORE the server starts:
+        # workers inherit it through the spawn env, and every RPC in both
+        # directions is HMAC-verified (upstream runner request signing)
+        os.environ.setdefault(_secret.SECRET_ENV, _secret.make_secret_key())
         self._server = JsonRpcServer({
             "assignment": self._handle_assignment,
             "result": self._handle_result,

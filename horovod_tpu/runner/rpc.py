@@ -4,6 +4,11 @@ Reference parity: ``horovod/runner/http/http_server.py`` (the launcher's
 HTTP KV rendezvous store) and ``horovod/runner/common/service/*`` (driver/
 task services over sockets).  One mechanism covers both here: a threaded
 HTTP server dispatching POSTed JSON bodies to named handlers.
+
+Requests are HMAC-signed with the per-job secret (``secret.py``, parity
+with upstream's request signing in ``runner/common/service``): when a
+secret is configured — always, under the launcher/elastic driver — the
+server rejects unsigned or tampered POSTs with 403 before dispatch.
 """
 
 from __future__ import annotations
@@ -15,16 +20,27 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
+from . import secret as _secret
+
 logger = logging.getLogger("horovod_tpu")
+
+_ENV = object()  # sentinel: resolve the secret from the environment
 
 
 class JsonRpcServer:
     """HTTP server mapping POST /<name> with a JSON body to
-    ``handlers[name](payload) -> response dict``."""
+    ``handlers[name](payload) -> response dict``.
+
+    ``secret`` defaults to the job secret from ``HOROVOD_SECRET_KEY``;
+    pass ``None`` explicitly to run unauthenticated (unit tests only).
+    """
 
     def __init__(self, handlers: Dict[str, Callable],
-                 port: int = 0, host: str = "0.0.0.0"):
+                 port: int = 0, host: str = "0.0.0.0",
+                 secret=_ENV):
         self._handlers = dict(handlers)
+        self._secret = (_secret.get_secret_key()
+                        if secret is _ENV else secret)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -35,8 +51,18 @@ class JsonRpcServer:
                     self.send_error(404, f"no handler: {name}")
                     return
                 length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) or b"{}"
+                if outer._secret is not None and not _secret.verify(
+                        outer._secret, name, raw,
+                        self.headers.get(_secret.SIGNATURE_HEADER),
+                        self.headers.get(_secret.TIMESTAMP_HEADER)):
+                    logger.warning(
+                        "rejected unauthenticated rpc POST /%s", name)
+                    self.send_error(
+                        403, "missing or invalid request signature")
+                    return
                 try:
-                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    payload = json.loads(raw)
                     resp = fn(payload) or {}
                     body = json.dumps(resp).encode()
                 except Exception as e:  # noqa: BLE001 - report to caller
@@ -65,11 +91,19 @@ class JsonRpcServer:
 
 def json_request(addr: str, port: int, name: str,
                  payload: Optional[dict] = None,
-                 timeout: float = 30.0) -> dict:
-    """POST ``payload`` to http://addr:port/<name>; returns the JSON reply."""
+                 timeout: float = 30.0, secret=_ENV) -> dict:
+    """POST ``payload`` to http://addr:port/<name>; returns the JSON reply.
+
+    The body is HMAC-signed with the job secret when one is configured
+    (``HOROVOD_SECRET_KEY``); ``secret=None`` sends unsigned.
+    """
+    if secret is _ENV:
+        secret = _secret.get_secret_key()
+    body = json.dumps(payload or {}).encode()
+    headers = {"Content-Type": "application/json"}
+    if secret is not None:
+        headers.update(_secret.sign_headers(secret, name, body))
     req = urllib.request.Request(
-        f"http://{addr}:{port}/{name}",
-        data=json.dumps(payload or {}).encode(),
-        headers={"Content-Type": "application/json"})
+        f"http://{addr}:{port}/{name}", data=body, headers=headers)
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         return json.loads(resp.read() or b"{}")

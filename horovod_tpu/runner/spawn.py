@@ -18,6 +18,7 @@ import sys
 import threading
 from typing import Dict, List, Optional, Sequence
 
+from . import secret as _secret
 from .hosts import SlotAssignment
 
 LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
@@ -30,6 +31,21 @@ def is_local(hostname: str) -> bool:
     return (hostname in LOCAL_NAMES
             or hostname == socket.gethostname()
             or hostname == socket.getfqdn())
+
+
+def ensure_job_secret(base_env: Optional[Dict[str, str]] = None) -> str:
+    """The job's control-plane secret, minting one on first launch.
+
+    Looks in ``base_env`` then ``os.environ``; a freshly minted key is
+    published to ``os.environ`` so launcher-side RPC (and later spawns)
+    sign with the same key the workers receive.
+    """
+    key = ((base_env or {}).get(_secret.SECRET_ENV)
+           or os.environ.get(_secret.SECRET_ENV))
+    if not key:
+        key = _secret.make_secret_key()
+    os.environ[_secret.SECRET_ENV] = key
+    return key
 
 
 def worker_env(slot: SlotAssignment, coordinator_addr: str,
@@ -59,10 +75,17 @@ def remote_command(slot: SlotAssignment, command: Sequence[str],
     forwarded = {k: v for k, v in env.items()
                  if k.startswith(("HOROVOD_", "JAX_", "XLA_", "TPU_",
                                   "PYTHONPATH", "LIBTPU"))}
+    # the job secret must NOT ride the ssh argv (visible in ps/procfs on
+    # both hosts); it is delivered on the remote shell's stdin instead —
+    # see the `read` prefix below and the stdin write in spawn_workers
+    has_secret = forwarded.pop(_secret.SECRET_ENV, None) is not None
     exports = " ".join(
         f"{k}={shlex.quote(v)}" for k, v in sorted(forwarded.items()))
     remote = f"cd {shlex.quote(cwd)} && env {exports} " + " ".join(
         shlex.quote(c) for c in command)
+    if has_secret:
+        remote = (f"IFS= read -r {_secret.SECRET_ENV} && "
+                  f"export {_secret.SECRET_ENV} && " + remote)
     return ["ssh", *SSH_OPTS, slot.hostname, remote]
 
 
@@ -94,16 +117,31 @@ def spawn_workers(slots: List[SlotAssignment], command: Sequence[str],
                   ) -> List[WorkerProcess]:
     procs: List[WorkerProcess] = []
     cwd = os.getcwd()
+    # one control-plane secret per job (upstream mints in the launcher and
+    # distributes via the env): published launcher-side too so this
+    # process's RPC signs with the same key the workers verify against
+    secret_key = ensure_job_secret(base_env)
     for slot in slots:
         env = worker_env(slot, coordinator_addr, coordinator_port, base_env)
+        env.setdefault(_secret.SECRET_ENV, secret_key)
         if is_local(slot.hostname):
-            cmd, popen_env = list(command), env
+            cmd, popen_env, stdin_data = list(command), env, None
         else:
             cmd, popen_env = remote_command(slot, command, env, cwd), None
+            # secret via stdin, never argv (see remote_command)
+            stdin_data = (env[_secret.SECRET_ENV] + "\n").encode()
         popen = subprocess.Popen(
             cmd, env=popen_env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
+            stdin=subprocess.PIPE if stdin_data else subprocess.DEVNULL,
             start_new_session=True)
+        if stdin_data:
+            try:
+                popen.stdin.write(stdin_data)
+                popen.stdin.flush()
+            except OSError:
+                pass  # worker died at exec; the reaper reports it
+            popen.stdin.close()
         proc = WorkerProcess(slot, popen)
         out_file = (open(f"{output_filename}.{slot.rank}", "w")
                     if output_filename else None)

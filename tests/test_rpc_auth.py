@@ -1,0 +1,177 @@
+"""Control-plane RPC authentication (HMAC request signing).
+
+Mirrors upstream's runner service signing tests (SURVEY.md §2.2 runner
+row; ``horovod/runner/common/util/secret.py`` + request verification in
+``runner/common/service/*``): unsigned or tampered POSTs to driver/worker
+endpoints must be rejected before dispatch; correctly signed requests go
+through; the signature binds endpoint + timestamp (no cross-endpoint or
+stale replay); the secret travels via the spawn environment — on stdin,
+never the ssh argv, for remote hosts.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from horovod_tpu.runner import secret as hsecret
+from horovod_tpu.runner import spawn
+from horovod_tpu.runner.hosts import HostInfo, assign_slots
+from horovod_tpu.runner.rpc import JsonRpcServer, json_request
+
+
+def _raw_post(port, name, body: bytes, headers=None):
+    req = urllib.request.Request(
+        f"http://localhost:{port}/{name}", data=body,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def test_sign_verify_roundtrip():
+    key = hsecret.make_secret_key().encode()
+    body = b'{"x": 1}'
+    ts = str(int(time.time()))
+    sig = hsecret.sign(key, "result", ts, body)
+    assert hsecret.verify(key, "result", body, sig, ts)
+    # tampered body / wrong endpoint / unsigned / garbage sig
+    assert not hsecret.verify(key, "result", b'{"x": 2}', sig, ts)
+    assert not hsecret.verify(key, "request_reform", body, sig, ts)
+    assert not hsecret.verify(key, "result", body, None, ts)
+    assert not hsecret.verify(key, "result", body, "00" * 32, ts)
+    # stale timestamp (outside the freshness window)
+    old = str(int(time.time() - hsecret.ts_tolerance() - 60))
+    assert not hsecret.verify(key, "result", body,
+                              hsecret.sign(key, "result", old, body), old)
+
+
+def test_unsigned_post_rejected():
+    key = hsecret.make_secret_key().encode()
+    calls = []
+    srv = JsonRpcServer({"result": lambda p: calls.append(p) or {"ok": True}},
+                        secret=key)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _raw_post(srv.port, "result", b'{"status": "FAILURE"}')
+        assert ei.value.code == 403
+        assert calls == []  # handler never dispatched
+    finally:
+        srv.close()
+
+
+def test_bad_signature_rejected():
+    key = hsecret.make_secret_key().encode()
+    calls = []
+    srv = JsonRpcServer({"hosts_updated": lambda p: calls.append(p) or {}},
+                        secret=key)
+    try:
+        body = b'{"timestamp": 0}'
+        ts = str(int(time.time()))
+        # signed with a different job's key
+        bad = hsecret.sign(b"some-other-key", "hosts_updated", ts, body)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _raw_post(srv.port, "hosts_updated", body,
+                      {hsecret.SIGNATURE_HEADER: bad,
+                       hsecret.TIMESTAMP_HEADER: ts})
+        assert ei.value.code == 403
+        # valid signature for a DIFFERENT body
+        sig = hsecret.sign(key, "hosts_updated", ts, b'{"timestamp": 1}')
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _raw_post(srv.port, "hosts_updated", body,
+                      {hsecret.SIGNATURE_HEADER: sig,
+                       hsecret.TIMESTAMP_HEADER: ts})
+        assert ei.value.code == 403
+        assert calls == []
+    finally:
+        srv.close()
+
+
+def test_cross_endpoint_replay_rejected():
+    """A request captured for one endpoint must not verify on another."""
+    key = hsecret.make_secret_key().encode()
+    fired = []
+    srv = JsonRpcServer({"running": lambda p: {"ok": True},
+                         "request_reform":
+                             lambda p: fired.append(p) or {"ok": True}},
+                        secret=key)
+    try:
+        body = b'{"worker_id": 0}'
+        headers = hsecret.sign_headers(key, "running", body)
+        assert _raw_post(srv.port, "running", body, headers) == {"ok": True}
+        # replay the same signed request at a more damaging endpoint
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _raw_post(srv.port, "request_reform", body, headers)
+        assert ei.value.code == 403
+        assert fired == []
+    finally:
+        srv.close()
+
+
+def test_signed_request_dispatches():
+    key = hsecret.make_secret_key().encode()
+    srv = JsonRpcServer({"echo": lambda p: {"got": p["x"]}}, secret=key)
+    try:
+        reply = json_request("localhost", srv.port, "echo", {"x": 7},
+                             secret=key)
+        assert reply == {"got": 7}
+    finally:
+        srv.close()
+
+
+def test_secret_resolved_from_env(monkeypatch):
+    key = hsecret.make_secret_key()
+    monkeypatch.setenv(hsecret.SECRET_ENV, key)
+    # both sides default to the env secret — the elastic driver/worker path
+    srv = JsonRpcServer({"echo": lambda p: {"got": p["x"]}})
+    try:
+        assert json_request("localhost", srv.port, "echo",
+                            {"x": 3}) == {"got": 3}
+        # an outsider without the key is still rejected
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _raw_post(srv.port, "echo", b'{"x": 3}')
+        assert ei.value.code == 403
+    finally:
+        srv.close()
+
+
+def test_no_secret_backcompat():
+    srv = JsonRpcServer({"echo": lambda p: {"ok": True}}, secret=None)
+    try:
+        assert _raw_post(srv.port, "echo", b"{}") == {"ok": True}
+    finally:
+        srv.close()
+
+
+def test_ensure_job_secret_mints_once(monkeypatch):
+    # setenv-to-empty (== unconfigured) so monkeypatch restores cleanly
+    monkeypatch.setenv(hsecret.SECRET_ENV, "")
+    minted = spawn.ensure_job_secret()
+    assert minted
+    import os
+    assert os.environ[hsecret.SECRET_ENV] == minted  # launcher-side publish
+    assert spawn.ensure_job_secret() == minted       # stable per job
+    # an explicit base_env key wins (elastic driver re-spawn path)
+    assert spawn.ensure_job_secret({hsecret.SECRET_ENV: "abc"}) == "abc"
+
+
+def test_worker_env_is_side_effect_free(monkeypatch):
+    monkeypatch.setenv(hsecret.SECRET_ENV, "")
+    slot = assign_slots([HostInfo("localhost", 1)], 1)[0]
+    spawn.worker_env(slot, "localhost", 12345, base_env={})
+    import os
+    assert os.environ[hsecret.SECRET_ENV] == ""  # no mutation
+
+
+def test_remote_command_keeps_secret_off_argv(monkeypatch):
+    key = hsecret.make_secret_key()
+    slot = assign_slots([HostInfo("remotehost", 1)], 1)[0]
+    env = spawn.worker_env(slot, "remotehost", 12345, base_env={})
+    env[hsecret.SECRET_ENV] = key
+    cmd = spawn.remote_command(slot, ["python", "train.py"], env, "/work")
+    line = " ".join(cmd)
+    assert key not in line  # never visible in ps/procfs
+    # the remote shell imports it from stdin instead
+    assert f"IFS= read -r {hsecret.SECRET_ENV}" in line
+    assert f"export {hsecret.SECRET_ENV}" in line
