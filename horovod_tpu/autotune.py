@@ -108,7 +108,7 @@ class ParameterManager:
     actually moves it.
     """
 
-    def __init__(self, cfg):
+    def __init__(self, cfg, hier_available: bool = True):
         self.cfg = cfg
         self.warmup_remaining = cfg.autotune_warmup_samples
         self.steps_per_sample = cfg.autotune_steps_per_sample
@@ -126,9 +126,14 @@ class ParameterManager:
                                   | {float(cfg.cycle_time_ms)})
         # cache_capacity <= 0 hard-disables ResponseCache.get/put, so the
         # cache dimension would be inert — pin it off instead of letting
-        # the GP converge to a value that cannot take effect
+        # the GP converge to a value that cannot take effect; same for the
+        # hierarchical flag when the process set has no valid
+        # (groups, group_size) factorization (single host / prime sizes)
         cache_flags = _BIN if cfg.cache_capacity > 0 else (0.0,)
-        self._grid = _make_grid(self._cycle_grid, cache_flags=cache_flags)
+        hier_flags = _BIN if hier_available else (
+            1.0 if getattr(cfg, "hierarchical_allreduce", False) else 0.0,)
+        self._grid = _make_grid(self._cycle_grid, cache_flags=cache_flags,
+                                hier_flags=hier_flags)
         self._current = (math.log2(cfg.fusion_threshold_bytes),
                          float(self._cycle_grid.index(
                              float(cfg.cycle_time_ms))),

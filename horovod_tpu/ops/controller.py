@@ -22,6 +22,15 @@ only negotiates when it has pending entries (or has joined), so an idle
 cluster costs zero control-plane traffic, unlike the reference's
 every-cycle bit-vector allreduce.
 
+Transport cost is O(N) per process per round (the bar set by the
+reference's one-Gatherv-one-Bcast cycle): each member does ONE
+``key_value_set`` plus ``key_value_dir_get`` polls that return every
+peer's key in a single RPC — never a per-peer get.  Leave markers are
+likewise read with one dir-get at a bounded interval while waiting, not
+per poll tick.  ``stats()`` exposes the KV-op counters so tests pin the
+bound; round keys are deleted as rounds age out and at shutdown, so a
+long-lived coordination service hosting many incarnations does not leak.
+
 Rounds are scoped per **member group** (the sorted processes owning the
 entry's process set), mirroring the reference's per-process-set
 controllers over sub-communicators: a collective on a subset process set
@@ -185,6 +194,10 @@ class Controller:
         self._join_seq: Optional[int] = None
         self._left = False
         self._poll_s = 0.25
+        # leave markers are checked while waiting at this interval (one
+        # dir-get each time), after a short grace so fast rounds pay zero
+        self._left_check_grace_s = 0.5
+        self._left_check_s = 2.0
         self._forced_off = False
         if cfg is not None:
             self._forced_off = not getattr(cfg, "controller_enabled", True)
@@ -199,6 +212,12 @@ class Controller:
         self.full_rounds = 0
         self.tokens_deferred = 0
         self.cache_evictions = 0
+        # KV transport op counters (prove the O(N)-per-round bound)
+        self.kv_sets = 0
+        self.kv_dir_gets = 0
+        self.kv_left_gets = 0
+        self.kv_blocking_gets = 0   # legacy per-peer fallback only
+        self.kv_deletes = 0
 
     # -- lifecycle -----------------------------------------------------------
     @property
@@ -222,6 +241,8 @@ class Controller:
         self._left = True
         try:
             if jax.process_count() > 1:
+                with self._lock:
+                    self.kv_sets += 1
                 _kv_set(_client(),
                         f"{_KEY_PREFIX}/{self.namespace}/left/"
                         f"{jax.process_index()}", "1")
@@ -244,6 +265,11 @@ class Controller:
                 "cached_cycles": len(self._hash_cache),
                 "cache_capacity": self._cache_capacity,
                 "cache_evictions": self.cache_evictions,
+                "kv_sets": self.kv_sets,
+                "kv_dir_gets": self.kv_dir_gets,
+                "kv_left_gets": self.kv_left_gets,
+                "kv_blocking_gets": self.kv_blocking_gets,
+                "kv_deletes": self.kv_deletes,
             }
 
     # -- steady-state cache (LRU set; caller must hold self._lock) -----------
@@ -327,13 +353,14 @@ class Controller:
                 val["e"] = my_sorted
             _kv_set(client, self._key(gk, f"{seq}/a/{me}"),
                     json.dumps(val, separators=(",", ":")))
+            with self._lock:
+                self.kv_sets += 1
 
             vals: Dict[int, dict] = {me: val}
-            for q in procs:
-                if q != me:
-                    vals[q] = json.loads(
-                        self._peer_get(client, gk, seq, "a", q, procs,
-                                       tokens))
+            for q, raw in self._gather_round(
+                    client, gk, seq, "a", set(procs) - {me}, procs,
+                    tokens).items():
+                vals[q] = json.loads(raw)
 
             joined_ps = sorted(q for q in vals if vals[q].get("j"))
             active = [q for q in procs if q not in joined_ps]
@@ -376,15 +403,19 @@ class Controller:
             if "e" not in val:
                 _kv_set(client, self._key(gk, f"{seq}/b/{me}"),
                         json.dumps(my_sorted, separators=(",", ":")))
+                with self._lock:
+                    self.kv_sets += 1
+            need_b = set()
             for q in procs:
                 if "e" in vals[q]:
                     full[q] = vals[q]["e"]
                 elif q == me:
                     full[q] = my_sorted
                 else:
-                    full[q] = json.loads(
-                        self._peer_get(client, gk, seq, "b", q, procs,
-                                       tokens))
+                    need_b.add(q)
+            for q, raw in self._gather_round(
+                    client, gk, seq, "b", need_b, procs, tokens).items():
+                full[q] = json.loads(raw)
 
             result = self._decide(gk, full, active, joined_ps, vals, me)
             result.params = agreed_params
@@ -484,14 +515,113 @@ class Controller:
         return counts, missing, deferred
 
     # -- transport -----------------------------------------------------------
+    def _check_left(self, client, procs: Tuple[int, ...], seq: int,
+                    waiting_for) -> None:
+        """ONE dir-get over the leave markers (not a get per peer)."""
+        me = jax.process_index()
+        with self._lock:
+            self.kv_left_gets += 1
+        try:
+            entries = client.key_value_dir_get(
+                f"{_KEY_PREFIX}/{self.namespace}/left/")
+        except Exception:  # noqa: BLE001 - none present
+            return
+        for k, _ in entries:
+            try:
+                p = int(k.rsplit("/", 1)[1])
+            except ValueError:
+                continue
+            if p in procs and p != me:
+                raise HorovodInternalError(
+                    f"process {p} left the job while negotiation round "
+                    f"{seq} was waiting for {sorted(waiting_for)} (peer "
+                    f"shutdown or failure)")
+
+    def _gather_round(self, client, gk: str, seq: int, phase: str,
+                      need: set, procs: Tuple[int, ...],
+                      pending_tokens: List[str]) -> Dict[int, str]:
+        """Collect the round keys of ``need`` members.
+
+        One ``key_value_dir_get`` returns every published peer key in a
+        single RPC, so a round costs O(N) cluster-wide instead of the
+        O(N²) of per-peer polled gets (reference bar: controller.cc's one
+        Gatherv + one Bcast per cycle).  Polling backs off exponentially
+        to ``_poll_s``; leave markers are checked with one dir-get at a
+        bounded interval, after a grace that fast rounds never reach.
+        Surfaces stall diagnosis instead of hanging (reference:
+        stall_inspector names missing ranks).
+        """
+        out: Dict[int, str] = {}
+        if not need:
+            return out
+        need = set(need)
+        if not hasattr(client, "key_value_dir_get"):
+            for q in sorted(need):
+                out[q] = self._peer_get(client, gk, seq, phase, q, procs,
+                                        pending_tokens)
+            return out
+        dirkey = self._key(gk, f"{seq}/{phase}/")
+        t0 = time.monotonic()
+        warned = False
+        delay = 0.001
+        next_left_check = self._left_check_grace_s
+        while True:
+            with self._lock:
+                self.kv_dir_gets += 1
+            try:
+                entries = client.key_value_dir_get(dirkey)
+            except Exception:  # noqa: BLE001 - nothing published yet
+                entries = []
+            for k, v in entries:
+                try:
+                    q = int(k.rsplit("/", 1)[1])
+                except ValueError:
+                    continue
+                if q in need:
+                    out[q] = v
+                    need.discard(q)
+            if not need:
+                return out
+            waited = time.monotonic() - t0
+            if waited >= next_left_check:
+                self._check_left(client, procs, seq, need)
+                next_left_check = waited + self._left_check_s
+            if not warned and waited > self._peer_wait_warn_s:
+                warned = True
+                names = sorted({n for t in pending_tokens
+                                for n in token_names(t)})
+                if self.stall is not None:
+                    for n in names:
+                        self.stall.record_missing(n, sorted(need))
+                logger.warning(
+                    "Negotiation round %d has waited %.0fs for processes "
+                    "%s to announce their ready tensors. Pending here: %s. "
+                    "One or more processes likely diverged (stopped "
+                    "submitting the same collectives).", seq, waited,
+                    sorted(need), names)
+            if (self._peer_wait_abort_s > 0
+                    and waited > self._peer_wait_abort_s):
+                names = sorted({n for t in pending_tokens
+                                for n in token_names(t)})
+                raise StallError(
+                    f"negotiation round {seq} waited {waited:.0f}s for "
+                    f"processes {sorted(need)} (> "
+                    f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
+                    f"{self._peer_wait_abort_s:.0f}); pending tensors "
+                    f"here: {names}; aborting")
+            time.sleep(delay)
+            delay = min(delay * 2, self._poll_s)
+
     def _peer_get(self, client, gk: str, seq: int, phase: str, q: int,
                   procs: Tuple[int, ...], pending_tokens: List[str]) -> str:
-        """Poll for a peer's round key, surfacing diagnosis instead of a
-        silent hang (reference: stall_inspector names missing ranks)."""
+        """Per-peer polled get — legacy fallback for coordination clients
+        without ``key_value_dir_get`` only."""
         key = self._key(gk, f"{seq}/{phase}/{q}")
         t0 = time.monotonic()
         warned = False
         while True:
+            with self._lock:
+                self.kv_blocking_gets += 1
             try:
                 return client.blocking_key_value_get(
                     key, int(self._poll_s * 1000))
@@ -502,6 +632,8 @@ class Controller:
             for p in procs:
                 if p == me:
                     continue
+                with self._lock:
+                    self.kv_blocking_gets += 1
                 try:
                     client.blocking_key_value_get(
                         f"{_KEY_PREFIX}/{self.namespace}/left/{p}", 1)
@@ -538,7 +670,49 @@ class Controller:
         if old < 0:
             return
         for phase in ("a", "b"):
+            with self._lock:
+                self.kv_deletes += 1
             try:
                 client.key_value_delete(self._key(gk, f"{old}/{phase}/{me}"))
             except Exception:  # noqa: BLE001 - may not exist
                 pass
+
+    def cleanup_keys(self):
+        """Shutdown-clean the coordination service (reference: controller
+        teardown discipline).  Every process deletes the round keys it
+        owns (the trailing ``_cleanup`` window per group); the process
+        that observes ALL leave markers present subtree-deletes the whole
+        incarnation namespace — leave markers stay visible to any peer
+        still mid-round until the very last departure, yet a long-lived
+        coordination service hosting many incarnations ends each
+        ``init → work → shutdown`` cycle with zero ``hvdctl/`` keys."""
+        try:
+            client = _client()
+        except Exception:  # noqa: BLE001 - coordination service gone
+            return
+        me = jax.process_index()
+        with self._lock:
+            seqs = dict(self._seq)
+        for gk, next_seq in seqs.items():
+            for s in range(max(0, next_seq - 4), next_seq):
+                for phase in ("a", "b"):
+                    with self._lock:
+                        self.kv_deletes += 1
+                    try:
+                        client.key_value_delete(
+                            self._key(gk, f"{s}/{phase}/{me}"))
+                    except Exception:  # noqa: BLE001 - may not exist
+                        pass
+        # last one out turns off the lights
+        try:
+            n = jax.process_count()
+            with self._lock:
+                self.kv_left_gets += 1
+            left = client.key_value_dir_get(
+                f"{_KEY_PREFIX}/{self.namespace}/left/")
+            if len(left) >= n:
+                with self._lock:
+                    self.kv_deletes += 1
+                client.key_value_delete(f"{_KEY_PREFIX}/{self.namespace}/")
+        except Exception:  # noqa: BLE001 - best effort
+            logger.debug("namespace cleanup skipped", exc_info=True)

@@ -189,6 +189,10 @@ class CollectiveEngine:
             for e in self._queue:
                 e.handle._fail(HorovodInternalError("engine shut down"))
             self._queue.clear()
+        if self._controller is not None:
+            # rounds have stopped: drop this process's outstanding keys
+            # (and, for the last process out, the whole namespace)
+            self._controller.cleanup_keys()
 
     # -- submission ---------------------------------------------------------
     def auto_name(self, prefix: str) -> str:

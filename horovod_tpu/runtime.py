@@ -427,7 +427,11 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
 
         if cfg.autotune:
             from .autotune import ParameterManager
-            _STATE.autotuner = ParameterManager(cfg)
+            # hierarchical collectives need a valid (groups, group_size)
+            # factorization of the global set; without one the GP's hier
+            # dimension would be inert and waste its sample budget
+            _STATE.autotuner = ParameterManager(
+                cfg, hier_available=global_ps.hier_shape() is not None)
 
         # The background collective engine (reference: BackgroundThreadLoop)
         # with its cross-process negotiation controller (controller.cc).

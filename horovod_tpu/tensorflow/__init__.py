@@ -238,6 +238,33 @@ def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):
 def alltoall(tensor, splits=None, name=None, process_set=None):
     nm = name or "tfalltoall"
 
+    static_uniform = splits is None
+    if splits is not None and not tf.is_tensor(splits):
+        sp = np.asarray(splits)
+        n_ = _n_workers(process_set)
+        # same validation the engine applies at dispatch (api.py), so
+        # the answer cannot depend on the compilation mode
+        if sp.ndim != 1 or sp.shape[0] != n_:
+            raise ValueError(
+                f"splits must have one entry per worker ({n_}), got "
+                f"{sp.shape[0] if sp.ndim == 1 else sp.shape}")
+        static_uniform = bool(sp.size) and bool(np.all(sp == sp[0]))
+    if _graph_singleproc() and static_uniform:
+        # replicated input, single process: worker j's result is n copies
+        # of chunk j, stacked over the local workers — exactly the eager
+        # engine's replicated branch (ops/collectives.py alltoall_array,
+        # which chunks by dim0 // n regardless of uniform splits) — as
+        # pure TF ops, XLA-compilable under jit_compile=True.  Uneven or
+        # tensor-valued splits keep the engine path, as does a dynamic
+        # leading dimension (the chunking is shape-dependent).
+        n = _n_workers(process_set)
+        if tensor.shape.rank and tensor.shape[0] is not None:
+            rows = int(tensor.shape[0]) // n
+            per_worker = [
+                tf.concat([tensor[j * rows:(j + 1) * rows]] * n, axis=0)
+                for j in range(n)]
+            return tf.stack(per_worker, axis=0)
+
     def _np_op(x):
         res = _api.alltoall(x.numpy(), splits=splits, name=nm,
                             process_set=process_set)

@@ -437,3 +437,56 @@ def autotune_leader_join_fn():
     st = hvd.runtime._state().engine.stats()["autotune"]
     return {"rank": r, "last": last, "neg": st["negotiated"],
             "thr": st["fusion_threshold_bytes"]}
+
+
+def kv_ops_per_round_fn():
+    """VERDICT r4 #3: negotiation transport cost.  After warmup, each
+    steady-state round must cost ONE key_value_set plus dir-get polls —
+    never a per-peer blocking get (the O(N^2) pattern this replaces)."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.cross_rank()
+    for i in range(3):                        # warmup (incl. first compile)
+        hvd.allreduce(np.ones((4,), np.float32), name="w", op=hvd.Sum)
+    before = hvd.runtime._state().engine.stats()["negotiation"]
+    for i in range(10):
+        out = hvd.allreduce(np.full((4,), float(r + 1), np.float32),
+                            name="g", op=hvd.Sum)
+        assert np.allclose(np.asarray(out), 10.0), out  # 1+2+3+4
+    after = hvd.runtime._state().engine.stats()["negotiation"]
+    diff = {k: after[k] - before[k]
+            for k in ("rounds", "kv_sets", "kv_dir_gets", "kv_left_gets",
+                      "kv_blocking_gets")}
+    return {"rank": r, **diff}
+
+
+def controller_shutdown_clean_fn():
+    """VERDICT r4 #9: an init -> negotiate -> leave -> cleanup cycle
+    leaves ZERO keys for the controller's namespace on the coordination
+    service (the last process out deletes the namespace subtree)."""
+    import json
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops.controller import Controller
+    from jax._src import distributed
+
+    r = hvd.cross_rank()
+    client = distributed.global_state.client
+    ctl = Controller(namespace="cleantest")
+    tok = json.dumps(
+        {"s": [["t", "allreduce", "sum", "float32", [2], 0, False, -1,
+                1.0, 1.0]], "r": -1, "sp": None},
+        separators=(",", ":"), sort_keys=True)
+    for _ in range(6):                   # enough rounds to age keys out
+        res = ctl.negotiate([tok], (0, 1))
+        assert res.counts[tok] == 1
+    # keys from recent rounds ARE still present before cleanup
+    pre = client.key_value_dir_get("hvdctl/cleantest/")
+    ctl.leave()
+    client.wait_at_barrier("cleantest_left", 20000)
+    ctl.cleanup_keys()
+    client.wait_at_barrier("cleantest_clean", 20000)
+    leftover = client.key_value_dir_get("hvdctl/cleantest/")
+    return {"rank": r, "pre": len(pre),
+            "leftover": [k for k, _ in leftover]}

@@ -348,3 +348,36 @@ def test_mixed_op_storm_cross_process():
     for r in results:
         assert r["ok"] == 30
         assert r["rounds"] >= 30
+
+
+def test_negotiation_kv_ops_per_round_bounded():
+    """VERDICT r4 #3: rounds are O(N) per process — in a 4-process job,
+    10 steady-state rounds cost exactly 10 key_value_sets, ZERO per-peer
+    blocking gets, and a bounded number of dir-get polls (each returning
+    all peers in one RPC).  The old transport cost (N-1) polled gets per
+    round plus (N-1) leave-marker gets per tick."""
+    results = run(helpers_runner.kv_ops_per_round_fn, np=4, env=_env(),
+                  port=29567)
+    assert len(results) == 4
+    for r in results:
+        assert r["rounds"] == 10, r
+        assert r["kv_sets"] == 10, r                 # ONE publish per round
+        assert r["kv_blocking_gets"] == 0, r         # never per-peer gets
+        assert r["kv_dir_gets"] >= 10, r             # at least one poll each
+        # bounded polling: exponential backoff means even heavy scheduler
+        # skew on a loaded 1-core host stays well under this
+        assert r["kv_dir_gets"] <= 10 * 40, r
+        # leave markers are only consulted after the 0.5s grace — rare in
+        # lockstep rounds, and one dir-get each time, never per peer
+        assert r["kv_left_gets"] <= 20, r
+
+
+def test_controller_keys_cleaned_at_shutdown():
+    """VERDICT r4 #9: after leave() + cleanup_keys() on every process, no
+    hvdctl/ keys for the incarnation survive on the coordination service
+    (the last process out subtree-deletes the namespace)."""
+    results = run(helpers_runner.controller_shutdown_clean_fn, np=2,
+                  env=_env(), port=29569)
+    for r in results:
+        assert r["pre"] >= 1          # rounds really published keys
+        assert r["leftover"] == [], r
