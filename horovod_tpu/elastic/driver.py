@@ -13,6 +13,19 @@ at ``coordinator_addr:coordinator_port(epoch)`` when workers re-call
 ``jax.distributed.initialize`` (runtime.init pulls the epoch assignment via
 ``fetch_assignment``).  The driver only has to hand out consistent
 assignments and bump the epoch.
+
+Two additions beyond the reference:
+
+* **Epoch release gate** — a fresh epoch's assignment is withheld until
+  every member has polled for it once.  Fresh spawns poll only after their
+  (slow) jax import, so the gate collapses coordination-service
+  registration skew from tens of seconds to one poll interval; survivors'
+  registration clocks no longer expire while newcomers are importing.
+* **Lifecycle events** — ``epoch_applied`` / ``epoch_released`` /
+  ``worker_running`` / ``epoch_formed`` / ``worker_exit`` / ``job_done`` /
+  ``below_min`` are observable via :meth:`ElasticDriver.add_listener` and
+  :meth:`ElasticDriver.wait_event`, so tests and tooling synchronize on
+  the exact transition they need instead of wall-clock windows.
 """
 
 from __future__ import annotations
@@ -95,6 +108,28 @@ class ElasticDriver:
         self._reset_count = 0
         self._job_done = False   # a worker's train fn returned successfully
         self._last_progress = time.monotonic()
+        # epoch release gate: a fresh epoch's assignment is withheld until
+        # every member has polled for it once (fresh spawns poll only after
+        # their jax import finishes), so all members enter coordination-
+        # service registration within one poll interval of each other
+        # instead of skewed by tens of seconds of import time.  Without
+        # this, survivors' registration clocks expire while newcomers are
+        # still importing, tearing down otherwise healthy formations.
+        self._gate_members: set = set()
+        self._gate_polled: set = set()
+        self._gate_deadline = 0.0
+        self._gate_open = True
+        # observable lifecycle: (event, info) log + condition for waiters
+        # (tests and tooling wait on precise events instead of wall-clock
+        # windows); callbacks in _listeners fire on every event
+        self._listeners: list = []
+        self._event_cv = threading.Condition()
+        # bounded log with a global base index: a long-lived driver with
+        # periodic churn must not grow memory forever; waiters use global
+        # indices so trimming never shifts what "since" means
+        self._events: list = []
+        self._events_base = 0
+        self._events_cap = 4096
         # mint the per-job control-plane secret BEFORE the server starts:
         # workers inherit it through the spawn env, and every RPC in both
         # directions is HMAC-verified (upstream runner request signing)
@@ -107,18 +142,84 @@ class ElasticDriver:
             "request_reform": self._handle_request_reform,
         }, port=self.port)
 
+    # --- lifecycle events --------------------------------------------------
+
+    def add_listener(self, callback):
+        """Register ``callback(event: str, info: dict)`` fired on every
+        lifecycle event (``epoch_applied``, ``epoch_released``,
+        ``worker_running``, ``epoch_formed``, ``worker_exit``,
+        ``job_done``, ``below_min``)."""
+        self._listeners.append(callback)
+
+    def _emit(self, event: str, **info):
+        for cb in list(self._listeners):
+            try:
+                cb(event, info)
+            except Exception:  # noqa: BLE001 - observer must not kill driver
+                logger.debug("lifecycle listener failed", exc_info=True)
+        with self._event_cv:
+            self._events.append((event, info))
+            if len(self._events) > self._events_cap:
+                drop = len(self._events) - self._events_cap
+                del self._events[:drop]
+                self._events_base += drop
+            self._event_cv.notify_all()
+
+    def wait_event(self, event: str, timeout: float, match=None,
+                   since: int = 0) -> tuple:
+        """Block until an ``event`` with ``match(info)`` true has been
+        emitted at log index >= ``since``; returns ``(index, info)``.
+        Raises TimeoutError with the full event log on expiry."""
+        deadline = time.monotonic() + timeout
+        with self._event_cv:
+            while True:
+                lo = max(since - self._events_base, 0)
+                for i in range(lo, len(self._events)):
+                    ev, info = self._events[i]
+                    if ev == event and (match is None or match(info)):
+                        return self._events_base + i, info
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    tail = self._events[lo:][-50:]
+                    raise TimeoutError(
+                        f"no {event!r} event within {timeout}s; "
+                        f"log tail={tail}")
+                self._event_cv.wait(remaining)
+
     # --- rpc handlers ------------------------------------------------------
 
     def _handle_assignment(self, payload):
         wid = int(payload["worker_id"])
         min_epoch = int(payload.get("min_epoch", 0))
+        release = None
         with self._lock:
-            if self._epoch >= min_epoch:
-                asg = self._assignment.get(wid)
-                if asg is not None:
-                    return dict(asg, ready=True, epoch=self._epoch)
+            if self._epoch < min_epoch:
+                return {"ready": False, "retry_after": 0.2}
+            asg = self._assignment.get(wid)
+            if asg is None:
                 return {"removed": True}
-            return {"ready": False, "retry_after": 0.2}
+            if not self._gate_open:
+                self._gate_polled.add(wid)
+                if self._gate_polled >= self._gate_members:
+                    self._gate_open = True
+                    release = "all_polled"
+                elif time.monotonic() > self._gate_deadline:
+                    # straggler fallback: a member that died pre-poll is
+                    # re-formed by the reaper anyway; don't hold the rest
+                    # hostage past the formation window
+                    self._gate_open = True
+                    release = "deadline"
+                else:
+                    return {"ready": False, "retry_after": 0.2}
+                # registration starts at release, not at epoch apply —
+                # restart the formation clock so the stall window measures
+                # rendezvous, not the imports the gate just absorbed
+                self._last_progress = time.monotonic()
+            reply = dict(asg, ready=True, epoch=self._epoch)
+            epoch = self._epoch
+        if release is not None:
+            self._emit("epoch_released", epoch=epoch, reason=release)
+        return reply
 
     def _handle_result(self, payload):
         wid = int(payload["worker_id"])
@@ -137,6 +238,7 @@ class ElasticDriver:
             # stop at the same step, so don't re-form on their way out
             with self._lock:
                 self._job_done = True
+            self._emit("job_done", worker_id=wid)
         return {"ok": True}
 
     def _handle_request_reform(self, payload):
@@ -160,6 +262,7 @@ class ElasticDriver:
     def _handle_running(self, payload):
         wid = int(payload["worker_id"])
         epoch = int(payload.get("epoch", -1))
+        formed = None
         with self._lock:
             w = self._workers.get(wid)
             # ignore a late report from a previous epoch: the worker was
@@ -167,6 +270,15 @@ class ElasticDriver:
             if w is not None and epoch == w.epoch:
                 w.started = True
                 self._last_progress = time.monotonic()
+                members = {m.worker_id: m for m in self._workers.values()
+                           if not m.expected_exit}
+                if epoch == self._epoch and all(
+                        wid_ in members and members[wid_].started
+                        for wid_ in self._assignment):
+                    formed = (epoch, len(self._assignment))
+        self._emit("worker_running", worker_id=wid, epoch=epoch)
+        if formed is not None:
+            self._emit("epoch_formed", epoch=formed[0], size=formed[1])
         return {"ok": True}
 
     def _handle_register_notification(self, payload):
@@ -270,12 +382,21 @@ class ElasticDriver:
             epoch = self._epoch
             notify = [(wid, ep) for wid, ep in self._notif.items()
                       if wid in assigned_wids]
+            # arm the release gate for this epoch: hold assignment until
+            # every member has polled once (or the formation window ends)
+            self._gate_members = set(assigned_wids)
+            self._gate_polled = set()
+            self._gate_open = not assigned_wids
+            self._gate_deadline = time.monotonic() + self.start_timeout
         if self.verbose:
             print(f"elastic: epoch {epoch} — {np_} slots on "
                   f"{list(hosts)}", file=sys.stderr)
         for wid, slot in to_spawn:
             self._spawn_worker(wid, slot, coord_addr, coord_port, epoch)
         self._notify_workers(notify, update_res)
+        self._emit("epoch_applied", epoch=epoch, size=np_,
+                   hosts=dict(hosts),
+                   spawned=[wid for wid, _ in to_spawn])
 
     def _spawn_worker(self, wid: int, slot, coord_addr, coord_port, epoch):
         env = dict(os.environ)
@@ -380,6 +501,8 @@ class ElasticDriver:
                                   file=sys.stderr)
                             with self._lock:
                                 self._hosts = dict(hosts)  # keep watching
+                            self._emit("below_min",
+                                       slots=self._total_slots(hosts))
                         else:
                             self._reset_count += 1
                             if (self.reset_limit is not None
@@ -410,6 +533,8 @@ class ElasticDriver:
                 self._workers.pop(w.worker_id, None)
                 self._notif.pop(w.worker_id, None)
             if w.expected_exit:
+                self._emit("worker_exit", worker_id=w.worker_id, rc=rc,
+                           kind="expected")
                 continue
             if rc == 0 or self.registry.state(
                     w.worker_id) == registration.SUCCESS:
@@ -418,6 +543,8 @@ class ElasticDriver:
                 # service race) must not count as a host failure
                 self.registry.record_result(
                     w.worker_id, registration.SUCCESS)
+                self._emit("worker_exit", worker_id=w.worker_id, rc=rc,
+                           kind="success")
             elif not w.started:
                 # died before completing rendezvous: jax's coordination
                 # client FATALs on stale-epoch registration timeouts and
@@ -427,6 +554,8 @@ class ElasticDriver:
                             "(rc=%d); respawning", w.worker_id,
                             w.slot.hostname, rc)
                 respawn_needed = True
+                self._emit("worker_exit", worker_id=w.worker_id, rc=rc,
+                           kind="churn")
             else:
                 self.registry.record_result(
                     w.worker_id, registration.FAILURE, w.slot.hostname)
@@ -434,6 +563,8 @@ class ElasticDriver:
                                w.worker_id, w.slot.hostname, rc)
                 respawn_needed = True
                 counted_failure = True
+                self._emit("worker_exit", worker_id=w.worker_id, rc=rc,
+                           kind="failure")
 
         with self._lock:
             n_live = sum(1 for w in self._workers.values()

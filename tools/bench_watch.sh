@@ -17,8 +17,10 @@ MAX_PENDING=${MAX_PENDING:-2}
 SLEEP=${SLEEP:-300}
 mkdir -p "$PROBE_DIR"
 
-# wait for any already-running sweep to finish before watching
-while pgrep -f "bench_ab.sh" | grep -qv $$; do sleep 60; done
+# wait for any already-running sweep to finish before watching (pgrep -f
+# matches the sweep script's own processes; this watcher's cmdline does
+# not contain "bench_ab.sh", so no self-match to filter)
+while pgrep -f "tools/bench_ab.sh" > /dev/null; do sleep 60; done
 
 launch_probe() {
   local tag="$PROBE_DIR/probe_$(date +%s)"
