@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import logging
 import os
+import queue
 import socket
 import sys
 import threading
@@ -130,6 +131,12 @@ class ElasticDriver:
         self._events: list = []
         self._events_base = 0
         self._events_cap = 4096
+        # listener callbacks run on a dedicated dispatch thread, never in
+        # the hot control-plane paths (_emit fires inside RPC handlers and
+        # under _reform_lock; a slow observer must not delay an assignment
+        # reply or stall the reform path)
+        self._listener_q: "queue.Queue" = queue.Queue()
+        self._listener_thread: Optional[threading.Thread] = None
         # mint the per-job control-plane secret BEFORE the server starts:
         # workers inherit it through the spawn env, and every RPC in both
         # directions is HMAC-verified (upstream runner request signing)
@@ -148,15 +155,30 @@ class ElasticDriver:
         """Register ``callback(event: str, info: dict)`` fired on every
         lifecycle event (``epoch_applied``, ``epoch_released``,
         ``worker_running``, ``epoch_formed``, ``worker_exit``,
-        ``job_done``, ``below_min``)."""
+        ``job_done``, ``below_min``).  Callbacks run on a dedicated
+        dispatch thread in emission order; a slow callback delays later
+        callbacks, never the driver."""
         self._listeners.append(callback)
+        if self._listener_thread is None:
+            self._listener_thread = threading.Thread(
+                target=self._listener_loop, name="hvd-elastic-events",
+                daemon=True)
+            self._listener_thread.start()
+
+    def _listener_loop(self):
+        while True:
+            event, info = self._listener_q.get()
+            for cb in list(self._listeners):
+                try:
+                    cb(event, info)
+                except Exception:  # noqa: BLE001 - observer must not
+                    # kill the dispatch thread
+                    logger.debug("lifecycle listener failed",
+                                 exc_info=True)
 
     def _emit(self, event: str, **info):
-        for cb in list(self._listeners):
-            try:
-                cb(event, info)
-            except Exception:  # noqa: BLE001 - observer must not kill driver
-                logger.debug("lifecycle listener failed", exc_info=True)
+        if self._listeners:
+            self._listener_q.put((event, info))
         with self._event_cv:
             self._events.append((event, info))
             if len(self._events) > self._events_cap:

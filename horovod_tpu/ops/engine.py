@@ -299,7 +299,11 @@ class CollectiveEngine:
                 self.stall.check()
             return
         try:
-            self._run_cycle(entries)
+            # top-level framework span: one per drained batch, nesting the
+            # NEGOTIATE range and the per-bucket dispatch annotations
+            with jax.profiler.TraceAnnotation(
+                    f"hvd.cycle[{len(entries)}]"):
+                self._run_cycle(entries)
         except Exception as exc:  # noqa: BLE001
             # fail the drained entries' handles so synchronize() raises
             # instead of hanging (the dispatch path fails per-bucket; this
@@ -516,7 +520,14 @@ class CollectiveEngine:
         if self.timeline:
             self.timeline.cycle_mark(self._cycle_count)
         if self._controller is not None and self._controller.enabled:
-            entries, _res = self._negotiate(entries)
+            # framework span inside any active jax.profiler capture: the
+            # whole cycle runs on the engine thread, so the negotiation
+            # range interleaves with the XLA collective ops it gates in
+            # ONE Perfetto view (SURVEY §5.1 rebuild note; the Chrome-trace
+            # timeline keeps the per-tensor lifecycle spans)
+            with jax.profiler.TraceAnnotation(
+                    f"hvd.NEGOTIATE[{len(entries)}]"):
+                entries, _res = self._negotiate(entries)
             if not entries:
                 if self.stall:
                     self.stall.check()

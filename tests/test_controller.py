@@ -381,3 +381,15 @@ def test_controller_keys_cleaned_at_shutdown():
     for r in results:
         assert r["pre"] >= 1          # rounds really published keys
         assert r["leftover"] == [], r
+
+
+def test_profiler_trace_contains_framework_spans(tmp_path):
+    """VERDICT r4 #5: one jax.profiler capture holds the framework spans
+    (hvd.NEGOTIATE / hvd.cycle) AND the fused-dispatch annotation, so
+    framework phases correlate with XLA ops in a single Perfetto view."""
+    results = run(helpers_runner.profiler_merged_trace_fn, np=2,
+                  env=_env({"TEST_PROF_DIR": str(tmp_path)}), port=29571)
+    for r in results:
+        assert r["negotiate"], r
+        assert r["cycle"], r
+        assert r["dispatch"], r

@@ -202,6 +202,10 @@ def test_lifecycle_events_formed_and_listener(nospawn):
     nospawn._handle_running({"worker_id": 1, "epoch": 0})
     i, info = nospawn.wait_event("epoch_formed", timeout=1)
     assert info == {"epoch": 0, "size": 2}
+    # callbacks are delivered on the dispatch thread: drain-wait briefly
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline and "epoch_formed" not in seen:
+        time.sleep(0.01)
     assert "epoch_applied" in seen and "epoch_formed" in seen
     # a stale-epoch running report never forms a fresh epoch
     nospawn._apply_hosts({"localhost": 2}, HostUpdateResult.MIXED)

@@ -52,15 +52,21 @@ while true; do
   if [ -n "$(chip_free)" ]; then
     echo "$(date -u +%T) chip answered — running full A/B sweep" \
       >> "$PROBE_DIR/watch.log"
-    bash tools/bench_ab.sh >> bench_ab_r05.log 2>&1
+    # capture THIS sweep's output separately: the success check must see
+    # only fresh rows, never value lines accumulated from earlier runs
+    SWEEP_OUT=$(mktemp)
+    bash tools/bench_ab.sh > "$SWEEP_OUT" 2>&1
+    cat "$SWEEP_OUT" >> bench_ab_r05.log
     # success = at least one variant emitted a real JSON line (error
     # lines carry an "error" key; real runs never do, whatever the value)
-    if grep '^{' bench_ab_r05.log | grep -v '"error"' \
+    if grep '^{' "$SWEEP_OUT" | grep -v '"error"' \
         | grep -q '"value"'; then
+      rm -f "$SWEEP_OUT"
       echo "$(date -u +%T) sweep produced numbers — watcher done" \
         >> "$PROBE_DIR/watch.log"
       exit 0
     fi
+    rm -f "$SWEEP_OUT"
     # sweep ran but still failed (lock re-wedged mid-claim).  Consume
     # ONLY the stale ok markers: a probe that printed ok has already
     # exited, so removing its files is safe — probes still pending keep
