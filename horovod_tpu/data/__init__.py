@@ -1,5 +1,8 @@
-"""Data-loading utilities (reference: ``horovod/data/``)."""
+"""Data-loading utilities (reference: ``horovod/data/`` + the Spark
+store's parquet materialization / petastorm read-back)."""
 
 from .data_loader import AsyncDataLoaderMixin, BaseDataLoader, ShardedLoader  # noqa: F401,E501
+from .parquet import ParquetDataset, ParquetLoader, write_parquet  # noqa: F401,E501
 
-__all__ = ["BaseDataLoader", "AsyncDataLoaderMixin", "ShardedLoader"]
+__all__ = ["BaseDataLoader", "AsyncDataLoaderMixin", "ShardedLoader",
+           "ParquetDataset", "ParquetLoader", "write_parquet"]

@@ -134,8 +134,11 @@ class ElasticDriver:
         # listener callbacks run on a dedicated dispatch thread, never in
         # the hot control-plane paths (_emit fires inside RPC handlers and
         # under _reform_lock; a slow observer must not delay an assignment
-        # reply or stall the reform path)
-        self._listener_q: "queue.Queue" = queue.Queue()
+        # reply or stall the reform path).  Bounded like _events: a
+        # BLOCKED observer degrades to dropped-oldest delivery, never to
+        # unbounded driver memory
+        self._listener_q: "queue.Queue" = queue.Queue(
+            maxsize=self._events_cap)
         self._listener_thread: Optional[threading.Thread] = None
         # mint the per-job control-plane secret BEFORE the server starts:
         # workers inherit it through the spawn env, and every RPC in both
@@ -158,16 +161,20 @@ class ElasticDriver:
         ``job_done``, ``below_min``).  Callbacks run on a dedicated
         dispatch thread in emission order; a slow callback delays later
         callbacks, never the driver."""
-        self._listeners.append(callback)
-        if self._listener_thread is None:
-            self._listener_thread = threading.Thread(
-                target=self._listener_loop, name="hvd-elastic-events",
-                daemon=True)
-            self._listener_thread.start()
+        with self._lock:   # exactly one dispatch thread, ever
+            self._listeners.append(callback)
+            if self._listener_thread is None:
+                self._listener_thread = threading.Thread(
+                    target=self._listener_loop, name="hvd-elastic-events",
+                    daemon=True)
+                self._listener_thread.start()
 
     def _listener_loop(self):
         while True:
             event, info = self._listener_q.get()
+            if event is None:   # flush marker: info is an Event to set
+                info.set()
+                continue
             for cb in list(self._listeners):
                 try:
                     cb(event, info)
@@ -176,9 +183,27 @@ class ElasticDriver:
                     logger.debug("lifecycle listener failed",
                                  exc_info=True)
 
+    def flush_listeners(self, timeout: float = 10.0) -> bool:
+        """Block until every event emitted so far has been delivered to
+        the callbacks (the dispatch thread is asynchronous; terminal
+        events like ``job_done`` would otherwise race driver exit)."""
+        if self._listener_thread is None:
+            return True
+        done = threading.Event()
+        self._listener_q.put((None, done))
+        return done.wait(timeout)
+
     def _emit(self, event: str, **info):
         if self._listeners:
-            self._listener_q.put((event, info))
+            while True:
+                try:
+                    self._listener_q.put_nowait((event, info))
+                    break
+                except queue.Full:   # drop-oldest, keep the fresh event
+                    try:
+                        self._listener_q.get_nowait()
+                    except queue.Empty:
+                        pass
         with self._event_cv:
             self._events.append((event, info))
             if len(self._events) > self._events_cap:
@@ -491,6 +516,9 @@ class ElasticDriver:
         try:
             return self._monitor()
         finally:
+            # deliver any queued terminal events (job_done/worker_exit)
+            # before the daemon dispatch thread dies with the process
+            self.flush_listeners()
             self._server.close()
 
     def _monitor(self) -> int:

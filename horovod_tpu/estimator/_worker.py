@@ -28,7 +28,8 @@ def run_data_parallel_training(model, optimizer,
                                loss_of_batch: Callable,
                                X, y, epochs: int, batch_size: int,
                                seed: int, shuffle: bool = True,
-                               validation: float = 0.0
+                               validation: float = 0.0,
+                               pre_sharded: bool = False
                                ) -> Dict[str, List[float]]:
     """Train ``model`` data-parallel; returns per-epoch histories:
     ``{"loss": [...], "val_loss": [...]}`` (``val_loss`` empty when
@@ -37,6 +38,10 @@ def run_data_parallel_training(model, optimizer,
     ``loss_of_batch(model, xb, yb, step_idx) -> scalar torch loss``
     (``step_idx`` is the within-epoch batch index — Lightning's
     ``training_step`` contract receives it).
+
+    ``pre_sharded=True`` means ``X``/``y`` are already THIS worker's
+    shard (the on-disk data plane reads ``rank::nproc`` rows itself);
+    otherwise the global arrays are strided here.
     """
     import numpy as np
     import torch
@@ -49,8 +54,12 @@ def run_data_parallel_training(model, optimizer,
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
     hvd.broadcast_optimizer_state(opt, root_rank=0)
 
-    Xs = torch.from_numpy(np.ascontiguousarray(X[rank::nproc]))
-    ys = torch.from_numpy(np.ascontiguousarray(y[rank::nproc]))
+    if pre_sharded:
+        Xs = torch.from_numpy(np.ascontiguousarray(X))
+        ys = torch.from_numpy(np.ascontiguousarray(y))
+    else:
+        Xs = torch.from_numpy(np.ascontiguousarray(X[rank::nproc]))
+        ys = torch.from_numpy(np.ascontiguousarray(y[rank::nproc]))
     Xv = yv = None
     if validation > 0.0:
         n_val = int(len(Xs) * validation)

@@ -32,8 +32,15 @@ def _train_on_worker(model_bytes, compile_kwargs, X, y, epochs,
         tf.keras.optimizers.get(dict(compile_kwargs["optimizer"])))
     model.compile(optimizer=opt, loss=compile_kwargs["loss"],
                   metrics=compile_kwargs.get("metrics"))
+    if y is None:
+        # on-disk data plane: the payload carried only the dataset
+        # handle; read THIS worker's strided shard (identical rows to
+        # the in-memory X[rank::nproc] branch below)
+        Xs, ys = X.read_xy(rank, nproc)
+    else:
+        Xs, ys = X[rank::nproc], y[rank::nproc]
     hist = model.fit(
-        X[rank::nproc], y[rank::nproc], epochs=epochs,
+        Xs, ys, epochs=epochs,
         batch_size=batch_size, verbose=0,
         validation_split=validation or 0.0,
         callbacks=[khvd.BroadcastGlobalVariablesCallback(0),
@@ -90,10 +97,24 @@ class KerasEstimator:
                 f"validation must be a fraction in [0, 1), got {validation}")
         self.validation = validation
 
-    def fit(self, X: np.ndarray, y: np.ndarray) -> KerasModel:
+    def fit(self, X, y: Optional[np.ndarray] = None) -> KerasModel:
+        """``fit(X, y)`` on in-memory arrays, or ``fit(ParquetDataset)``
+        on an on-disk dataset (only the handle rides the payload; each
+        worker reads its own shard — the Spark store data flow)."""
         import tensorflow as tf
+        from ..data.parquet import ParquetDataset
         from ..runner import run
 
+        if isinstance(X, ParquetDataset):
+            if y is not None:
+                raise ValueError("fit(dataset) takes no y — the label "
+                                 "column lives in the dataset")
+            data_args = (X, None)
+        else:
+            if y is None:
+                raise TypeError("fit(X, y) needs y for array inputs "
+                                "(only fit(ParquetDataset) omits it)")
+            data_args = (np.asarray(X), np.asarray(y))
         opt_cfg = tf.keras.optimizers.serialize(
             tf.keras.optimizers.get(self.optimizer))
         payload = {"json": self.model.to_json(),
@@ -102,7 +123,7 @@ class KerasEstimator:
             _train_on_worker,
             args=(payload, {"optimizer": opt_cfg, "loss": self.loss,
                             "metrics": self.metrics},
-                  np.asarray(X), np.asarray(y), self.epochs,
+                  *data_args, self.epochs,
                   self.batch_size, self.seed, self.validation),
             np=self.num_proc, env=self.env, port=self.port,
             verbose=bool(self.verbose))

@@ -8,9 +8,12 @@ and returns a fitted model wrapper with ``predict``.
 
 TPU-native redesign: the data plane is this framework's own launcher
 (``runner.run`` — fresh workers per fit, the reference's Spark-task
-model) with the torch adapter's ``DistributedOptimizer`` inside; inputs
-are arrays rather than Spark DataFrames (Petastorm conversion is out of
-scope — TPU pipelines feed arrays/tf.data).
+model) with the torch adapter's ``DistributedOptimizer`` inside.
+Inputs are in-memory arrays (``fit(X, y)``) or an on-disk
+:class:`~horovod_tpu.data.ParquetDataset` (``fit(dataset)``) — the
+disk form reproduces the reference's Store/Petastorm flow: only the
+dataset handle rides the payload and each worker streams its own
+shard.
 """
 
 from __future__ import annotations
@@ -34,10 +37,20 @@ def _train_on_worker(model_bytes, opt_factory, loss_fn, X, y, epochs,
     rank = hvd.cross_rank()
     model = torch.load(io.BytesIO(model_bytes), weights_only=False)
     from ._worker import run_data_parallel_training
+    pre_sharded = False
+    if y is None:
+        # on-disk data plane (reference: the Spark store's parquet
+        # materialization + per-worker petastorm read-back): the payload
+        # carried only the dataset handle; stream THIS worker's strided
+        # shard from disk — identical rows to the in-memory
+        # X[rank::nproc], so loss histories match exactly
+        X, y = X.read_xy(rank, hvd.cross_size())
+        pre_sharded = True
     hist = run_data_parallel_training(
         model, opt_factory(model.parameters()),
         lambda m, xb, yb, _s: loss_fn(m(xb), yb),
-        X, y, epochs, batch_size, seed, shuffle, validation)
+        X, y, epochs, batch_size, seed, shuffle, validation,
+        pre_sharded=pre_sharded)
     buf = io.BytesIO()
     torch.save(model.state_dict(), buf)
     return {"state_dict": buf.getvalue() if rank == 0 else None,
@@ -100,17 +113,32 @@ class TorchEstimator:
                 f"validation must be a fraction in [0, 1), got {validation}")
         self.validation = validation
 
-    def fit(self, X: np.ndarray, y: np.ndarray) -> TorchModel:
+    def fit(self, X, y: Optional[np.ndarray] = None) -> TorchModel:
+        """Train on in-memory arrays ``fit(X, y)`` or on an on-disk
+        dataset ``fit(ParquetDataset(path))`` — the disk form ships only
+        the dataset handle to the workers; each reads its own shard
+        (reference: Spark estimator + store/petastorm data flow)."""
         import io
         import torch
+        from ..data.parquet import ParquetDataset
         from ..runner import run
 
+        if isinstance(X, ParquetDataset):
+            if y is not None:
+                raise ValueError("fit(dataset) takes no y — the label "
+                                 "column lives in the dataset")
+            data_args = (X, None)
+        else:
+            if y is None:
+                raise TypeError("fit(X, y) needs y for array inputs "
+                                "(only fit(ParquetDataset) omits it)")
+            data_args = (np.asarray(X), np.asarray(y))
         buf = io.BytesIO()
         torch.save(self.model, buf)
         results = run(
             _train_on_worker,
             args=(buf.getvalue(), self.optimizer, self.loss,
-                  np.asarray(X), np.asarray(y), self.epochs,
+                  *data_args, self.epochs,
                   self.batch_size, self.seed, self.shuffle,
                   self.validation),
             np=self.num_proc, env=self.env, port=self.port,

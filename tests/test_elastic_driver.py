@@ -515,3 +515,14 @@ def test_elastic_integration_scale_down(tmp_path, cpu_load):
     # the job finished all steps, and the post-shrink steps ran at size 2
     assert max(r["step"] for r in recs) == 13
     assert {r["size"] for r in recs if r["step"] >= 12} == {2}
+
+
+def test_flush_listeners_delivers_terminal_events(nospawn):
+    """Events queued to the async dispatch thread must be deliverable
+    before driver exit (run() flushes in its finally)."""
+    seen = []
+    nospawn.add_listener(lambda ev, info: seen.append(ev))
+    nospawn._apply_hosts({"localhost": 1}, HostUpdateResult.ADDED)
+    nospawn._handle_result({"worker_id": 0, "status": "SUCCESS"})
+    assert nospawn.flush_listeners(timeout=5)
+    assert "job_done" in seen
