@@ -33,7 +33,6 @@ from __future__ import annotations
 import logging
 import os
 import queue
-import socket
 import sys
 import threading
 import time
@@ -78,7 +77,8 @@ class ElasticDriver:
                  start_timeout: float = 600.0,
                  reset_limit: Optional[int] = None,
                  env: Optional[Dict[str, str]] = None,
-                 verbose: bool = False):
+                 verbose: bool = False,
+                 network_interface: Optional[str] = None):
         self.discovery = discovery
         self.command = list(command)
         self.min_np = min_np
@@ -89,6 +89,7 @@ class ElasticDriver:
         self.reset_limit = reset_limit
         self.extra_env = dict(env or {})
         self.verbose = verbose
+        self.network_interface = network_interface
         self.registry = registration.WorkerStateRegistry(blacklist_threshold)
 
         self._lock = threading.Lock()
@@ -355,12 +356,27 @@ class ElasticDriver:
     def _total_slots(self, hosts: Dict[str, int]) -> int:
         return sum(hosts.values())
 
-    def _epoch_coordinator(self, slots) -> tuple:
-        first = slots[0].hostname
-        addr = socket.gethostname() if spawn.is_local(first) else first
+    def _resolve_addrs(self, slots) -> tuple:
+        """(coordinator addr, {hostname: driver RPC addr}) for an epoch.
+
+        NIC-aware (``--network-interface`` / HOROVOD_NETWORK_INTERFACE /
+        route toward the first remote host — multi-NIC TPU VMs can't
+        trust ``gethostname()``).  Called BEFORE ``self._lock`` is
+        taken: route lookups can hit DNS, and a slow resolver must not
+        stall the RPC handlers; one lookup per distinct hostname."""
+        from ..runner.network import coordinator_addr, local_service_addr
+        coord = coordinator_addr([s.hostname for s in slots],
+                                 spawn.is_local,
+                                 interface=self.network_interface)
+        driver_addrs = {h: local_service_addr(
+            h, spawn.is_local, interface=self.network_interface)
+            for h in {s.hostname for s in slots}}
+        return coord, driver_addrs
+
+    def _epoch_port(self) -> int:
         # fresh port per epoch so a re-forming coordination service never
         # collides with a half-closed predecessor
-        return addr, self.port + 1 + (self._epoch % 512)
+        return self.port + 1 + (self._epoch % 512)
 
     def _apply_hosts(self, hosts: Dict[str, int], update_res: int):
         """Recompute assignments for a new host set and reconcile workers.
@@ -378,6 +394,8 @@ class ElasticDriver:
             np_ = min(np_, self.max_np)
         host_infos = [HostInfo(h, s) for h, s in hosts.items()]
         slots = assign_slots(host_infos, np_)
+        # address resolution (possible DNS) stays OUTSIDE self._lock
+        coord_addr, driver_addrs = self._resolve_addrs(slots)
         with self._lock:
             self._epoch += 1
             self._hosts = dict(hosts)
@@ -385,7 +403,7 @@ class ElasticDriver:
             # are tolerated until start_timeout from THIS re-form, not
             # from the last 'running' report hours ago
             self._last_progress = time.monotonic()
-            coord_addr, coord_port = self._epoch_coordinator(slots)
+            coord_port = self._epoch_port()
             # keep existing workers on their host where possible: workers
             # are pinned to (hostname, local slot index).  A worker whose
             # process has already died must NOT be re-pinned — the new
@@ -439,19 +457,23 @@ class ElasticDriver:
             print(f"elastic: epoch {epoch} — {np_} slots on "
                   f"{list(hosts)}", file=sys.stderr)
         for wid, slot in to_spawn:
-            self._spawn_worker(wid, slot, coord_addr, coord_port, epoch)
+            self._spawn_worker(wid, slot, coord_addr, coord_port, epoch,
+                               driver_addrs[slot.hostname])
         self._notify_workers(notify, update_res)
         self._emit("epoch_applied", epoch=epoch, size=np_,
                    hosts=dict(hosts),
                    spawned=[wid for wid, _ in to_spawn])
 
-    def _spawn_worker(self, wid: int, slot, coord_addr, coord_port, epoch):
+    def _spawn_worker(self, wid: int, slot, coord_addr, coord_port, epoch,
+                      driver_addr: str):
         env = dict(os.environ)
         env.update(self.extra_env)
         env.update({
             "HOROVOD_ELASTIC": "1",
             "HOROVOD_ELASTIC_WORKER_ID": str(wid),
-            "HOROVOD_ELASTIC_DRIVER_ADDR": socket.gethostname(),
+            # the RPC server runs on this machine; driver_addr was
+            # resolved (NIC-aware, once per host) by _resolve_addrs
+            "HOROVOD_ELASTIC_DRIVER_ADDR": driver_addr,
             "HOROVOD_ELASTIC_DRIVER_PORT": str(self.port),
             "HOROVOD_HOSTNAME": slot.hostname,
         })
@@ -676,5 +698,6 @@ def run_elastic_launcher(args) -> int:
     driver = ElasticDriver(
         discovery, args.command, min_np=min_np, max_np=args.max_np,
         port=args.port, start_timeout=args.start_timeout,
-        verbose=args.verbose)
+        verbose=args.verbose,
+        network_interface=args.network_interface)
     return driver.run()

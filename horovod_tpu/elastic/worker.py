@@ -146,14 +146,18 @@ class WorkerNotificationManager:
         self._registered = False
 
     def init(self):
-        """Register this worker's listener address with the driver."""
+        """Register this worker's listener address with the driver —
+        the address the DRIVER's host can route back to (NIC-aware,
+        same selection as the launcher's coordinator address)."""
+        from ..runner.network import local_service_addr
+        from ..runner.spawn import is_local
         ep = _driver_endpoint()
         wid = worker_id()
         if ep is None or wid is None or self._registered:
             return
         json_request(ep[0], ep[1], "register_notification",
                      {"worker_id": wid,
-                      "addr": socket.gethostname(),
+                      "addr": local_service_addr(ep[0], is_local),
                       "port": self._server.port})
         self._registered = True
 

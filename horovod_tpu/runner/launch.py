@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import argparse
 import os
-import socket
 import sys
 from typing import List, Optional
 
@@ -41,6 +40,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="do not prefix worker output with [rank]<host>")
     p.add_argument("--start-timeout", type=float, default=600.0,
                    help="seconds to wait for the job to finish rendezvous")
+    p.add_argument("--network-interface", default=None,
+                   help="local interface whose address remote workers "
+                        "dial for the coordination service (reference: "
+                        "horovodrun --network-interface; default: "
+                        "HOROVOD_NETWORK_INTERFACE env, else the "
+                        "route toward the first remote host)")
     p.add_argument("--verbose", "-v", action="store_true")
     # elastic (reference: --min-np/--max-np/--host-discovery-script)
     p.add_argument("--min-np", type=int, default=None)
@@ -112,11 +117,10 @@ def check_build(out=None) -> int:
     return 0
 
 
-def _coordinator_addr(hosts) -> str:
-    first = hosts[0].hostname
-    if spawn.is_local(first):
-        return socket.gethostname()
-    return first
+def _coordinator_addr(hosts, interface: Optional[str] = None) -> str:
+    from .network import coordinator_addr
+    return coordinator_addr([h.hostname for h in hosts], spawn.is_local,
+                            interface=interface)
 
 
 def run_launcher(args: argparse.Namespace) -> int:
@@ -127,7 +131,7 @@ def run_launcher(args: argparse.Namespace) -> int:
         return run_elastic_launcher(args)
     hosts = effective_hosts(args.hosts, args.hostfile, args.np)
     slots = assign_slots(hosts, args.np)
-    addr = _coordinator_addr(hosts)
+    addr = _coordinator_addr(hosts, args.network_interface)
     if args.verbose:
         for s in slots:
             print(f"hvdrun: rank {s.rank} -> {s.hostname} "

@@ -526,3 +526,24 @@ def test_flush_listeners_delivers_terminal_events(nospawn):
     nospawn._handle_result({"worker_id": 0, "status": "SUCCESS"})
     assert nospawn.flush_listeners(timeout=5)
     assert "job_done" in seen
+
+
+def test_driver_network_interface_flows_to_workers():
+    """--network-interface reaches both the coordinator address and the
+    driver RPC address handed to spawned workers."""
+    captured = {}
+
+    class _CaptureDriver(_NoSpawnDriver):
+        def _launch(self, slot, coord_addr, coord_port, env):
+            captured["coord"] = coord_addr
+            captured["driver"] = env["HOROVOD_ELASTIC_DRIVER_ADDR"]
+            return super()._launch(slot, coord_addr, coord_port, env)
+
+    d = _CaptureDriver(
+        discovery.FixedHostDiscovery({"localhost": 1}), ["true"],
+        min_np=1, port=free_port(), network_interface="lo")
+    try:
+        d._apply_hosts({"localhost": 1}, HostUpdateResult.ADDED)
+    finally:
+        d._server.close()
+    assert captured == {"coord": "127.0.0.1", "driver": "127.0.0.1"}

@@ -170,3 +170,80 @@ def test_check_build_flag(capsys):
     assert "Available features" in out
     assert "[X] JAX" in out
     assert "Torch adapter" in out
+
+
+# --- network-interface selection (reference: runner/util/network.py) --------
+
+def test_list_interfaces_has_loopback():
+    from horovod_tpu.runner import network
+    ifaces = network.list_interfaces()
+    assert ifaces.get("lo") == "127.0.0.1"
+
+
+def test_resolve_interface_names_candidates():
+    from horovod_tpu.runner import network
+    assert network.resolve_interface("lo") == "127.0.0.1"
+    with pytest.raises(ValueError, match="lo"):
+        network.resolve_interface("no-such-if0")
+
+
+def test_routable_source_addr_route_lookup():
+    from horovod_tpu.runner import network
+    # loopback routes from loopback; no packets are sent either way
+    assert network.routable_source_addr("127.0.0.1") == "127.0.0.1"
+    assert network.routable_source_addr("definitely-not-a-host.invalid") \
+        is None
+
+
+def test_coordinator_addr_selection_order(monkeypatch):
+    from horovod_tpu.runner import network
+    from horovod_tpu.runner.spawn import is_local
+
+    # remote first host: the hostfile name is the service address
+    assert network.coordinator_addr(
+        ["nodeA", "localhost"], is_local) == "nodeA"
+    # local-only job: hostname (loopback routing)
+    import socket as s
+    assert network.coordinator_addr(
+        ["localhost"], is_local) == s.gethostname()
+    # explicit interface beats detection
+    assert network.coordinator_addr(
+        ["localhost", "nodeB"], is_local, interface="lo") == "127.0.0.1"
+    # env contract form
+    monkeypatch.setenv("HOROVOD_NETWORK_INTERFACE", "lo")
+    assert network.coordinator_addr(
+        ["localhost", "nodeB"], is_local) == "127.0.0.1"
+    monkeypatch.delenv("HOROVOD_NETWORK_INTERFACE")
+    # local first host + remote workers: source-route toward the remote
+    monkeypatch.setattr(network, "routable_source_addr",
+                        lambda h, port=1: "10.0.0.7")
+    assert network.coordinator_addr(
+        ["localhost", "nodeB"], is_local) == "10.0.0.7"
+    # detection failure falls back to hostname
+    monkeypatch.setattr(network, "routable_source_addr",
+                        lambda h, port=1: None)
+    assert network.coordinator_addr(
+        ["localhost", "nodeB"], is_local) == s.gethostname()
+
+
+def test_local_service_addr(monkeypatch):
+    from horovod_tpu.runner import network
+    from horovod_tpu.runner.spawn import is_local
+    import socket as s
+    assert network.local_service_addr("localhost", is_local) \
+        == s.gethostname()
+    assert network.local_service_addr("nodeB", is_local,
+                                      interface="lo") == "127.0.0.1"
+    monkeypatch.setattr(network, "routable_source_addr",
+                        lambda h, port=1: "10.0.0.9")
+    assert network.local_service_addr("nodeB", is_local) == "10.0.0.9"
+
+
+def test_parse_args_network_interface():
+    a = parse_args(["-np", "2", "--network-interface", "eth1",
+                    "python", "x.py"])
+    assert a.network_interface == "eth1"
+    from horovod_tpu.runner.launch import _coordinator_addr
+    from horovod_tpu.runner.hosts import HostInfo
+    assert _coordinator_addr([HostInfo("localhost", 2)],
+                             interface="lo") == "127.0.0.1"
