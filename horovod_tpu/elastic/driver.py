@@ -477,6 +477,11 @@ class ElasticDriver:
             "HOROVOD_ELASTIC_DRIVER_PORT": str(self.port),
             "HOROVOD_HOSTNAME": slot.hostname,
         })
+        if self.network_interface:
+            # workers resolve their notification endpoint with the same
+            # interface selection as the driver (docs/env.md contract)
+            from ..runner.network import ENV_INTERFACE
+            env.setdefault(ENV_INTERFACE, self.network_interface)
         # keep member and driver formation clocks in phase: a member
         # stuck in RegisterTask is uninterruptible until its init
         # timeout LOG(FATAL)s it, so it must die no later than the

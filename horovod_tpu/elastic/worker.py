@@ -155,9 +155,18 @@ class WorkerNotificationManager:
         wid = worker_id()
         if ep is None or wid is None or self._registered:
             return
+        try:
+            addr = local_service_addr(ep[0], is_local)
+        except ValueError:
+            # HOROVOD_NETWORK_INTERFACE names a NIC this host doesn't
+            # have: degrade to hostname registration instead of dying
+            # at startup (the launcher-side interface list may not
+            # match every worker host)
+            logger.warning("notification endpoint interface resolution "
+                           "failed; registering hostname", exc_info=True)
+            addr = socket.gethostname()
         json_request(ep[0], ep[1], "register_notification",
-                     {"worker_id": wid,
-                      "addr": local_service_addr(ep[0], is_local),
+                     {"worker_id": wid, "addr": addr,
                       "port": self._server.port})
         self._registered = True
 
