@@ -233,8 +233,15 @@ def bench_llama8b_dp():
                                               "rehearse_8b.py")],
                 capture_output=True, text=True, timeout=1800, env=env)
             line = next((ln for ln in proc.stdout.splitlines()
-                         if ln.startswith("{")), "{}")
-            reh = json.loads(line)
+                         if ln.startswith("{")), None)
+            if line is None:
+                # crashed before emitting: carry the diagnosis in the
+                # metric line — it may be all that gets collected
+                reh = {"ok": False,
+                       "error": f"no JSON line, rc={proc.returncode}",
+                       "stderr_tail": proc.stderr[-400:]}
+            else:
+                reh = json.loads(line)
         except (subprocess.TimeoutExpired, ValueError) as exc:
             # the metric line must come out even when the rehearsal
             # hangs or emits garbage (same posture as the probe guard)
@@ -258,10 +265,10 @@ def bench_llama8b_dp():
             vocab_parallel=tp > 1)
         seq, steps = 256, 3
     else:
-        tp = 4
-        cfg = dataclasses.replace(
-            llama.llama3_8b(), vocab_parallel=True, loss_chunk=1024,
-            remat=True, remat_policy="full", max_seq_len=4096)
+        # the SAME configuration the rehearsal lowers (shared helper —
+        # rehearsal and measurement cannot drift apart)
+        tp = llama.LLAMA8B_TP
+        cfg = llama.llama3_8b_train_cfg(seq=4096)
         seq, steps = 4096, 10
     dp_full = n // tp
 

@@ -479,9 +479,13 @@ class ElasticDriver:
         })
         if self.network_interface:
             # workers resolve their notification endpoint with the same
-            # interface selection as the driver (docs/env.md contract)
+            # interface selection as the driver (docs/env.md contract);
+            # the explicit flag OVERRIDES an inherited env var — only a
+            # user-supplied worker env (extra_env) may pin a different
+            # interface for workers
             from ..runner.network import ENV_INTERFACE
-            env.setdefault(ENV_INTERFACE, self.network_interface)
+            if ENV_INTERFACE not in self.extra_env:
+                env[ENV_INTERFACE] = self.network_interface
         # keep member and driver formation clocks in phase: a member
         # stuck in RegisterTask is uninterruptible until its init
         # timeout LOG(FATAL)s it, so it must die no later than the

@@ -159,12 +159,16 @@ class WorkerNotificationManager:
             addr = local_service_addr(ep[0], is_local)
         except ValueError:
             # HOROVOD_NETWORK_INTERFACE names a NIC this host doesn't
-            # have: degrade to hostname registration instead of dying
-            # at startup (the launcher-side interface list may not
-            # match every worker host)
+            # have: degrade to the route-based source address toward
+            # the driver (the same multi-NIC-safe detection the
+            # no-interface path uses), not to a possibly-unroutable
+            # hostname; die only if even route lookup fails
+            from ..runner.network import routable_source_addr
             logger.warning("notification endpoint interface resolution "
-                           "failed; registering hostname", exc_info=True)
-            addr = socket.gethostname()
+                           "failed; using route-based detection",
+                           exc_info=True)
+            addr = (routable_source_addr(ep[0])
+                    or socket.gethostname())
         json_request(ep[0], ep[1], "register_notification",
                      {"worker_id": wid, "addr": addr,
                       "port": self._server.port})

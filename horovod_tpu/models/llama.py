@@ -87,6 +87,22 @@ def llama3_8b() -> LlamaConfig:
     return LlamaConfig()
 
 
+# BASELINE config-4 mesh: dp16 x tp4 = 64 chips (v5p-128)
+LLAMA8B_TP = 4
+LLAMA8B_DP = 16
+
+
+def llama3_8b_train_cfg(seq: int = 4096) -> LlamaConfig:
+    """The exact config-4 TRAINING configuration, shared by the bench
+    mode (``bench.py`` llama8b_dp) and the AOT rehearsal
+    (``tools/rehearse_8b.py``) so 'the rehearsal rehearses the measured
+    step' can never drift: vocab-parallel embedding/head, chunk-1024
+    cross-entropy, full remat."""
+    return dataclasses.replace(
+        llama3_8b(), vocab_parallel=True, loss_chunk=1024, remat=True,
+        remat_policy="full", max_seq_len=seq)
+
+
 def tiny(vocab: int = 256, seq: int = 128) -> LlamaConfig:
     """Test-scale config: same code paths, toy sizes."""
     return LlamaConfig(vocab_size=vocab, d_model=64, n_layers=2, n_heads=4,

@@ -1,0 +1,28 @@
+"""Packaging: the wheel/sdist pipeline the CI matrix (tools/ci.sh)
+fronts (reference: the superbuild's setup.py + CI wheel matrix,
+SURVEY.md §2.1 "Build system")."""
+
+import os
+import subprocess
+import sys
+import zipfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_wheel_builds_and_carries_the_package(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "build", "--wheel", "--no-isolation",
+         "--outdir", str(tmp_path), REPO],
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-3000:]
+    wheels = [f for f in os.listdir(tmp_path) if f.endswith(".whl")]
+    assert len(wheels) == 1, wheels
+    with zipfile.ZipFile(tmp_path / wheels[0]) as zf:
+        names = zf.namelist()
+    # the package, its native extension, and the console entry point
+    assert any(n == "horovod_tpu/__init__.py" for n in names)
+    assert any(n.startswith("horovod_tpu/native/_hvd_core") for n in names)
+    assert any(n.startswith("horovod_tpu/runner/") for n in names)
+    meta = [n for n in names if n.endswith("entry_points.txt")]
+    assert meta, names

@@ -388,6 +388,11 @@ def test_bench_llama8b_dp_mode_rehearsal_fallback():
         "HOROVOD_BENCH_MODEL": "llama8b_dp",
         "HOROVOD_TPU_FORCE_PLATFORM": "cpu",
         "HOROVOD_BENCH_SKIP_PROBE": "1",
+        # small seq: the asserted contract (chips==64, n_params>7e9) is
+        # seq-independent, and the full-seq trace is already covered by
+        # test_llama3_8b_aot_rehearsal_subprocess; this also keeps the
+        # outer timeout comfortably above bench.py's inner 1800s budget
+        "REHEARSE_SEQ": "512",
         "PYTHONPATH": repo,
     })
     out = subprocess.run(

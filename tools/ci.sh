@@ -1,0 +1,38 @@
+#!/bin/bash
+# Build/test matrix (reference: the superbuild's framework x feature CI
+# matrix, SURVEY.md §2.1 "Build system" + §4 test strategy).
+#
+#   bash tools/ci.sh [--quick]
+#
+# Stages:
+#   1. package: wheel + sdist build (no isolation - deps are baked in)
+#   2. native:  build the C++ core in place, run its parity tests
+#   3. purepy:  the HOROVOD_TPU_NATIVE_CORE=0 fallback paths
+#   4. noctl:   single-process semantics with the controller disabled
+#   5. full:    the whole suite (skipped with --quick)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== 1/5 package: wheel + sdist =="
+rm -rf dist/
+python -m build --no-isolation --outdir dist/ . > /tmp/ci_build.log 2>&1 \
+  || { tail -30 /tmp/ci_build.log; exit 1; }
+ls -l dist/
+
+echo "== 2/5 native core build + parity tests =="
+python setup.py build_ext --inplace > /tmp/ci_native.log 2>&1 \
+  || { tail -30 /tmp/ci_native.log; exit 1; }
+python -m pytest tests/test_native_core.py -q
+
+echo "== 3/5 pure-python fallback (native core disabled) =="
+HOROVOD_TPU_NATIVE_CORE=0 python -m pytest \
+  tests/test_basics.py tests/test_fusion.py -q
+
+echo "== 4/5 controller disabled (single-process semantics) =="
+HOROVOD_TPU_CONTROLLER=0 python -m pytest tests/test_basics.py -q
+
+if [ "${1:-}" != "--quick" ]; then
+  echo "== 5/5 full suite =="
+  python -m pytest tests/ -q
+fi
+echo "CI matrix: all stages green"

@@ -24,8 +24,6 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-import dataclasses  # noqa: E402
-
 import jax.numpy as jnp  # noqa: E402
 
 
@@ -54,12 +52,12 @@ def main():
     from horovod_tpu.optim.precision import adamw_lp
     from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
 
-    dp, tp = 16, 4                       # 64 chips = v5p-128
+    dp, tp = llama.LLAMA8B_DP, llama.LLAMA8B_TP   # 64 chips = v5p-128
     seq = int(os.environ.get("REHEARSE_SEQ", "4096"))
     per_dp_batch = 1
-    cfg = dataclasses.replace(
-        llama.llama3_8b(), vocab_parallel=True, loss_chunk=1024,
-        remat=True, remat_policy="full", max_seq_len=seq)
+    # the SAME configuration bench.py's llama8b_dp mode measures
+    # (shared helper — rehearsal and measurement cannot drift apart)
+    cfg = llama.llama3_8b_train_cfg(seq=seq)
     pmesh = ParallelMesh(MeshConfig(dp=dp, tp=tp))
     ts = training.make_llama_train_step(
         cfg, pmesh, optimizer=adamw_lp(3e-4), zero1=True)
