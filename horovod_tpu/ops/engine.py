@@ -224,7 +224,12 @@ class CollectiveEngine:
             return self._group_counter
 
     def submit(self, entry: TensorTableEntry) -> Handle:
-        entry.handle = Handle(entry.name, single=len(entry.arrays) == 1)
+        # a grouped entry ALWAYS resolves to a list, even with one
+        # member — grouped_* callers zip the result against their input
+        # list, and a bare array would be iterated element-wise
+        entry.handle = Handle(
+            entry.name, single=(len(entry.arrays) == 1
+                                and entry.group_id == -1))
         entry.enqueue_time = time.monotonic()
         if self._controller is not None and self._controller.joined:
             entry.handle._fail(HorovodInternalError(
@@ -476,7 +481,9 @@ class CollectiveEngine:
             prescale=sigs[0][8], postscale=sigs[0][9],
             root_rank=fields["r"], splits=fields["sp"], stacked=False,
             group_id=self.next_group_id() if len(sigs) > 1 else -1)
-        entry.handle = Handle(entry.name, single=len(arrays) == 1)
+        entry.handle = Handle(
+            entry.name, single=(len(arrays) == 1
+                                and entry.group_id == -1))
         entry.enqueue_time = time.monotonic()
         if self.timeline:
             self.timeline.negotiate_start(entry.name, op_type)

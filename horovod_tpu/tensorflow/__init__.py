@@ -147,28 +147,59 @@ def allreduce(tensor, average=None, name=None, op=None,
 
 
 def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
-                      process_set=None) -> List:
+                      compression=Compression.none, prescale_factor=1.0,
+                      postscale_factor=1.0, process_set=None) -> List:
+    """Allreduce a list of tensors as ONE atomic fusion group: one
+    negotiated round and one (or few) fused dispatches instead of a
+    synchronous engine round-trip per tensor (reference:
+    hvd.grouped_allreduce / group_table.cc)."""
     if average is not None and op is not None:
         raise ValueError("The average and op arguments cannot both be set")
     rop = op if op is not None else (
         Average if (average is None or average) else Sum)
     nm = name or "tfgrouped"
 
+    if compression is not Compression.none:
+        wire_dt = (tf.bfloat16 if compression is Compression.bf16
+                   else tf.float16)
+        comp = [t.dtype in (tf.float32, tf.float64) for t in tensors]
+        wires = [tf.cast(t, wire_dt) if c else t
+                 for t, c in zip(tensors, comp)]
+        outs = grouped_allreduce(
+            wires, op=rop, name=nm, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=process_set)
+        return [tf.cast(o, t.dtype) if c else o
+                for o, t, c in zip(outs, tensors, comp)]
+
     if _graph_singleproc():
         n = _n_workers(process_set)
-        return [_replicated_reduce(t, rop, n) for t in tensors]
+        return [_scaled(_replicated_reduce(
+            _scaled(t, prescale_factor), rop, n), postscale_factor)
+            for t in tensors]
 
     def _np_op(*xs):
         outs = _api.grouped_allreduce([x.numpy() for x in xs],
                                       name=nm, op=rop,
+                                      prescale_factor=prescale_factor,
+                                      postscale_factor=postscale_factor,
                                       process_set=process_set)
         return [np.asarray(o) for o in outs]
 
     outs = tf.py_function(_np_op, list(tensors),
                           Tout=[t.dtype for t in tensors],
                           name=f"HorovodGroupedAllreduce__{_XLA_FENCE}")
+    outs = _as_output_list(outs, len(tensors))
     for o, t in zip(outs, tensors):
         o.set_shape(t.shape)
+    return outs
+
+
+def _as_output_list(outs, n: int) -> List:
+    """``tf.py_function`` with a single-element ``Tout`` returns a bare
+    tensor, not a 1-list — zipping it against the inputs would iterate
+    its ELEMENTS.  Normalize to a list of ``n`` tensors."""
+    if n == 1 and not isinstance(outs, (list, tuple)):
+        return [outs]
     return list(outs)
 
 
@@ -200,6 +231,7 @@ def grouped_allgather(tensors: Sequence, name=None,
     outs = tf.py_function(_np_op, list(tensors),
                           Tout=[t.dtype for t in tensors],
                           name=f"HorovodGroupedAllgather__{_XLA_FENCE}")
+    outs = _as_output_list(outs, len(tensors))
     return [_set_gather_shape(o, t) for o, t in zip(outs, tensors)]
 
 
@@ -373,19 +405,27 @@ class DistributedGradientTape:
                 return [None if g is None else tf.zeros_like(g)
                         for g in grads]
             grads, self._acc = self._acc, None
-        out = []
+        # ONE grouped submission for every dense gradient: a single
+        # negotiated round + fused dispatch instead of a synchronous
+        # engine round-trip per gradient (the TF frontend's former
+        # per-op latency tax)
+        dense_idx, dense = [], []
         for i, g in enumerate(grads):
             if g is None:
-                out.append(None)
                 continue
             if isinstance(g, tf.IndexedSlices):
                 g = tf.convert_to_tensor(g)  # sparse-as-dense (reference)
-            out.append(allreduce(
-                g, op=self._op, name=f"tape.grad{i}",
-                compression=self._compression,
-                prescale_factor=self._prescale,
-                postscale_factor=self._postscale,
-                process_set=self._process_set))
+            dense_idx.append(i)
+            dense.append(g)
+        reduced = grouped_allreduce(
+            dense, op=self._op, name="tape.grads",
+            compression=self._compression,
+            prescale_factor=self._prescale,
+            postscale_factor=self._postscale,
+            process_set=self._process_set) if dense else []
+        out: List = [None] * len(grads)
+        for i, r in zip(dense_idx, reduced):
+            out[i] = r
         return out
 
 
@@ -401,17 +441,22 @@ def DistributedOptimizer(optimizer, name=None,
     class _Dist(base):  # noqa: D401 - dynamic wrapper
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             gv = list(grads_and_vars)
-            reduced = []
-            for i, (g, v) in enumerate(gv):
+            # one grouped round for all dense gradients (see
+            # DistributedGradientTape.gradient)
+            dense_idx, dense = [], []
+            for i, (g, _v) in enumerate(gv):
                 if g is None:
-                    reduced.append((g, v))
                     continue
                 if isinstance(g, tf.IndexedSlices):
                     g = tf.convert_to_tensor(g)
-                g = allreduce(g, op=op, name=f"opt.grad{i}",
-                              compression=compression,
-                              process_set=process_set)
-                reduced.append((g, v))
+                dense_idx.append(i)
+                dense.append(g)
+            outs = grouped_allreduce(
+                dense, op=op, name="opt.grads", compression=compression,
+                process_set=process_set) if dense else []
+            reduced = list(gv)
+            for i, r in zip(dense_idx, outs):
+                reduced[i] = (r, reduced[i][1])
             return base.apply_gradients(self, reduced, *args, **kwargs)
 
     _Dist.__name__ = base.__name__
