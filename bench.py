@@ -335,8 +335,9 @@ def main():
     #   HOROVOD_BENCH_REMAT_SKIP  last-k layers un-remat'd
     #   HOROVOD_BENCH_OPT=lp      bf16-moment AdamW
     #   HOROVOD_BENCH_FUSED_XENT  fused Pallas cross-entropy kernel
-    #     (hardware-unmeasured: the tunnel re-wedged mid-sweep before
-    #      its variants; stays opt-in until a measured win)
+    #     (hardware-measured round 5: 16,148 t/s with the default knobs
+    #      vs 16,518 for the chunked-XLA loss — no win at this 1B
+    #      geometry, stays opt-in; see BENCH_NOTE_r05.md)
     cfg = llama.LlamaConfig(
         vocab_size=32768, d_model=2048, n_layers=16, n_heads=16,
         n_kv_heads=8, d_ff=8192, max_seq_len=1024, remat=True,

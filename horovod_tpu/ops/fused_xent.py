@@ -15,9 +15,11 @@ Kernel shapes: ``h [N, D]`` (N = B·T flattened tokens), ``W [V, D]``
 outputs (m, l, target-logit) accumulate across revisited output blocks
 — TPU Pallas executes the grid sequentially, so the innermost vocab
 steps form an online-softmax recurrence exactly like flash attention's
-kv loop.  Per-token vectors are laid out blocked ``[nr, br]`` (full
+kv loop.  Per-token vectors are laid out blocked ``[nr, 1, br]`` (full
 blocks, no 128-lane padding — the same trick as the flash kernel's
-blocked lse).
+blocked lse; the singleton middle axis makes each ``(1, 1, br)`` block's
+trailing dims equal the array's, which Mosaic's block-shape rule
+requires when the sublane dim is not a multiple of 8).
 
 Backward recomputes score tiles from the saved logsumexp: ``dh`` loops
 vocab blocks per row block, ``dW`` loops row blocks per vocab block;
@@ -37,9 +39,37 @@ from jax import lax
 
 try:
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
     _HAS_PALLAS = True
 except Exception:  # noqa: BLE001
     _HAS_PALLAS = False
+
+
+_VMEM_CAP = 100 * 1024 * 1024  # leave headroom below the 128MB VMEM
+
+
+def _vmem_budget(br: int, bv: int, d: int) -> int:
+    """Upper bound on the kernels' scoped-VMEM working set in bytes.
+
+    The dW kernel dominates: fp32 ``[bv, D]`` embedding and cotangent
+    blocks, double-buffered, plus the ``[br, D]`` activation block and
+    the ``[br, bv]`` score/softmax tiles — ~22MB at bv=512, D=2048
+    (matches the Mosaic allocator's report) and linear in D."""
+    return (4 * bv * d * 4        # w + dw blocks, double-buffered, fp32
+            + 2 * br * d * 4      # h block (compute dtype <= fp32)
+            + 4 * br * bv * 4     # s/p tiles and their temporaries
+            + 8 * 1024 * 1024)    # margin for Mosaic's own scratch
+
+
+def _compiler_params(br: int, bv: int, d: int):
+    """Mosaic's default 16MB scoped-vmem budget rejects the dW kernel's
+    working set; grant what the shapes need (capped below VMEM size —
+    supported() rejects shapes over the cap).  Interpret mode (CPU
+    tests) takes no compiler params."""
+    if _INTERPRET:
+        return None
+    grant = max(32 * 1024 * 1024, min(_vmem_budget(br, bv, d), _VMEM_CAP))
+    return pltpu.CompilerParams(vmem_limit_bytes=grant)
 
 from .flash_attention import _sds
 
@@ -92,10 +122,15 @@ def supported(h, w, targets) -> bool:
     V = w.shape[0]
     if w.shape[1] != D or targets.shape[:2] != h.shape[:2]:
         return False
-    if D % 128 or D > 8192:
+    if D % 128:
         return False
     br, bv = _blocks(N, V)
-    return br is not None and bv is not None
+    if br is None or bv is None:
+        return False
+    # shapes whose kernel working set cannot fit VMEM (large D: the
+    # budget passes 100MB between D=8192 and D=16384) must take the
+    # chunked-XLA loss instead of failing Mosaic compilation
+    return _vmem_budget(br, bv, D) <= _VMEM_CAP
 
 
 # ---------------------------------------------------------------- forward
@@ -114,19 +149,19 @@ def _fwd_kernel(h_ref, w_ref, y_ref, m_ref, l_ref, tgt_ref, *, bv):
 
     s = lax.dot_general(h, wj, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32)  # [br, bv]
-    m = m_ref[0]                                     # [br]
-    l = l_ref[0]
+    m = m_ref[0, 0]                                  # [br]
+    l = l_ref[0, 0]
     m_new = jnp.maximum(m, s.max(axis=-1))
     corr = jnp.exp(m - m_new)
     l_new = l * corr + jnp.exp(s - m_new[:, None]).sum(axis=-1)
-    m_ref[0] = m_new
-    l_ref[0] = l_new
+    m_ref[0, 0] = m_new
+    l_ref[0, 0] = l_new
 
     # target logit: rows whose label falls inside this vocab block
-    local = y_ref[0] - j * bv                        # [br]
+    local = y_ref[0, 0] - j * bv                     # [br]
     cols = lax.broadcasted_iota(jnp.int32, (br, bv), 1)
     hit = cols == local[:, None]
-    tgt_ref[0] = tgt_ref[0] + jnp.where(hit, s, 0.0).sum(axis=-1)
+    tgt_ref[0, 0] = tgt_ref[0, 0] + jnp.where(hit, s, 0.0).sum(axis=-1)
 
 
 def _xent_fwd(h, w, y_blocked, br, bv):
@@ -140,21 +175,22 @@ def _xent_fwd(h, w, y_blocked, br, bv):
         in_specs=[
             pl.BlockSpec((br, D), lambda r, j: (r, 0)),
             pl.BlockSpec((bv, D), lambda r, j: (j, 0)),
-            pl.BlockSpec((1, br), lambda r, j: (r, 0)),
+            pl.BlockSpec((1, 1, br), lambda r, j: (r, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, br), lambda r, j: (r, 0)),
-            pl.BlockSpec((1, br), lambda r, j: (r, 0)),
-            pl.BlockSpec((1, br), lambda r, j: (r, 0)),
+            pl.BlockSpec((1, 1, br), lambda r, j: (r, 0, 0)),
+            pl.BlockSpec((1, 1, br), lambda r, j: (r, 0, 0)),
+            pl.BlockSpec((1, 1, br), lambda r, j: (r, 0, 0)),
         ],
         out_shape=[
-            _sds((nr, br), jnp.float32, h, w),
-            _sds((nr, br), jnp.float32, h, w),
-            _sds((nr, br), jnp.float32, h, w),
+            _sds((nr, 1, br), jnp.float32, h, w),
+            _sds((nr, 1, br), jnp.float32, h, w),
+            _sds((nr, 1, br), jnp.float32, h, w),
         ],
         interpret=_INTERPRET,
+        compiler_params=_compiler_params(br, bv, D),
     )(h, w, y_blocked)
-    lse = m + jnp.log(l)                             # [nr, br]
+    lse = m + jnp.log(l)                             # [nr, 1, br]
     return lse, tgt
 
 
@@ -172,8 +208,8 @@ def _dh_kernel(h_ref, w_ref, y_ref, lse_ref, dh_ref, *, bv):
 
     s = lax.dot_general(h, wj, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32)
-    p = jnp.exp(s - lse_ref[0][:, None])             # softmax tile
-    local = y_ref[0] - j * bv
+    p = jnp.exp(s - lse_ref[0, 0][:, None])          # softmax tile
+    local = y_ref[0, 0] - j * bv
     cols = lax.broadcasted_iota(jnp.int32, (br, bv), 1)
     p = jnp.where(cols == local[:, None], p - 1.0, p)
     dh_ref[...] = dh_ref[...] + lax.dot_general(
@@ -194,8 +230,8 @@ def _dw_kernel(h_ref, w_ref, y_ref, lse_ref, dw_ref, *, bv):
 
     s = lax.dot_general(h, wj, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32)
-    p = jnp.exp(s - lse_ref[0][:, None])
-    local = y_ref[0] - j * bv
+    p = jnp.exp(s - lse_ref[0, 0][:, None])
+    local = y_ref[0, 0] - j * bv
     cols = lax.broadcasted_iota(jnp.int32, (br, bv), 1)
     p = jnp.where(cols == local[:, None], p - 1.0, p)
     dw_ref[...] = dw_ref[...] + lax.dot_general(
@@ -215,12 +251,13 @@ def _xent_bwd_kernels(h, w, y_blocked, lse, br, bv):
         in_specs=[
             pl.BlockSpec((br, D), lambda r, j: (r, 0)),
             pl.BlockSpec((bv, D), lambda r, j: (j, 0)),
-            pl.BlockSpec((1, br), lambda r, j: (r, 0)),
-            pl.BlockSpec((1, br), lambda r, j: (r, 0)),
+            pl.BlockSpec((1, 1, br), lambda r, j: (r, 0, 0)),
+            pl.BlockSpec((1, 1, br), lambda r, j: (r, 0, 0)),
         ],
         out_specs=pl.BlockSpec((br, D), lambda r, j: (r, 0)),
         out_shape=_sds((N, D), jnp.float32, h, w),
         interpret=_INTERPRET,
+        compiler_params=_compiler_params(br, bv, D),
     )(h, w, y_blocked, lse)
 
     dw32 = pl.pallas_call(
@@ -229,12 +266,13 @@ def _xent_bwd_kernels(h, w, y_blocked, lse, br, bv):
         in_specs=[
             pl.BlockSpec((br, D), lambda j, r: (r, 0)),
             pl.BlockSpec((bv, D), lambda j, r: (j, 0)),
-            pl.BlockSpec((1, br), lambda j, r: (r, 0)),
-            pl.BlockSpec((1, br), lambda j, r: (r, 0)),
+            pl.BlockSpec((1, 1, br), lambda j, r: (r, 0, 0)),
+            pl.BlockSpec((1, 1, br), lambda j, r: (r, 0, 0)),
         ],
         out_specs=pl.BlockSpec((bv, D), lambda j, r: (j, 0)),
         out_shape=_sds((V, D), jnp.float32, h, w),
         interpret=_INTERPRET,
+        compiler_params=_compiler_params(br, bv, D),
     )(h, w, y_blocked, lse)
     return dh32, dw32
 
@@ -288,5 +326,5 @@ def fused_xent_mean(h, w_embed, targets):
     N = B * T
     br, bv = _blocks(N, w_embed.shape[0])
     h2 = h.reshape(N, D)
-    y = targets.reshape(N // br, br).astype(jnp.int32)
+    y = targets.reshape(N // br, 1, br).astype(jnp.int32)
     return _xent_sum(h2, w_embed, y, br, bv) / N

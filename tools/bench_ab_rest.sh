@@ -1,0 +1,16 @@
+#!/bin/bash
+# Round-5 remainder sweep: only the variants the 00:59 sweep did not get
+# to before the re-wedge (BENCH_NOTE_r05.md).  Same discipline as
+# bench_ab.sh: serial, no timeout wrappers, never kill a mid-claim client.
+set -u
+cd "$(dirname "$0")/.."
+run() {
+  echo "=== $* ==="
+  env "$@" python bench.py 2>&1 | grep -E '^\{' || echo FAILED
+}
+run HOROVOD_BENCH_FUSED_XENT=1 HOROVOD_BENCH_LOSS_CHUNK=0 HOROVOD_BENCH_OPT=std HOROVOD_BENCH_REMAT_SKIP=0
+run HOROVOD_BENCH_FUSED_XENT=1
+run HOROVOD_BENCH_FUSED_XENT=1 HOROVOD_BENCH_REMAT_SKIP=1
+run HOROVOD_BENCH_MODEL=bert
+run HOROVOD_BENCH_MODEL=longctx
+run HOROVOD_BENCH_MODEL=resnet
