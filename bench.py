@@ -37,6 +37,52 @@ def detect_peak() -> float:
     return PEAK_TFLOPS.get(gen, PEAK_TFLOPS["v5e"])
 
 
+def _env_batch(default: int) -> int:
+    """HOROVOD_BENCH_BATCH: per-chip batch override for the secondary
+    bench modes (the reference's synthetic benchmarks expose
+    --batch-size the same way; TPU conv/attention utilization is
+    batch-hungry, so the A/B sweep tunes this per mode)."""
+    import os
+    return int(os.environ.get("HOROVOD_BENCH_BATCH", default))
+
+
+def _env_scan() -> int:
+    """HOROVOD_BENCH_SCAN: drive K train steps per device dispatch via
+    ``lax.scan`` (1 = eager loop, the default).  Steps whose compute
+    time is tens of ms are otherwise dominated by the axon tunnel's
+    per-dispatch RPC latency, which measures the relay, not the chip;
+    multi-step scan is how real long-running TPU loops amortize host
+    dispatch anyway."""
+    import os
+    return max(1, int(os.environ.get("HOROVOD_BENCH_SCAN", "1")))
+
+
+def _scan_wrap(step_fn, n_carry: int, loss_idx: int, k: int):
+    """jit(scan) of ``k`` chained ``step_fn`` calls.
+
+    ``step_fn``'s first ``n_carry`` outputs feed its first ``n_carry``
+    inputs on the next step; remaining inputs repeat (synthetic data).
+    Returns a callable with step_fn's signature yielding
+    (carry..., last_loss)."""
+    from jax import lax
+
+    def multi(carry, *inputs):
+        def body(c, _):
+            out = step_fn(*c, *inputs)
+            return tuple(out[:n_carry]), out[loss_idx]
+        c2, losses = lax.scan(body, carry, None, length=k)
+        return c2, losses[-1]
+
+    jitted = jax.jit(multi, donate_argnums=(0,))
+
+    def run(*args):
+        carry, rest = tuple(args[:n_carry]), args[n_carry:]
+        c2, loss = jitted(carry, *rest)
+        return (*c2, loss)
+
+    return run
+
+
 def bench_bert():
     """Secondary bench entry (HOROVOD_BENCH_MODEL=bert): BERT fine-tune
     throughput, BASELINE config 3.  The default metric stays llama_1b so
@@ -46,10 +92,17 @@ def bench_bert():
 
     from horovod_tpu.models import bert
 
+    import os
+
     on_cpu = jax.devices()[0].platform == "cpu"
     cfg = bert.bert_base(num_labels=4) if not on_cpu else bert.tiny()
-    batch, seq, steps = (32, 128, 20) if not on_cpu else (4, 32, 3)
-    cfg = dataclasses.replace(cfg, max_seq_len=max(cfg.max_seq_len, seq))
+    batch, seq, steps = (_env_batch(32), 128, 20) if not on_cpu \
+        else (4, 32, 3)
+    cfg = dataclasses.replace(
+        cfg, max_seq_len=max(cfg.max_seq_len, seq),
+        # fine-tune activations at seq 128 fit HBM comfortably — remat
+        # would spend ~1/3 more FLOPs for memory we don't need
+        remat=os.environ.get("HOROVOD_BENCH_REMAT", "1") != "0")
     n_chips = jax.local_device_count()
     mesh = jax.make_mesh((n_chips,), ("dp",))
     params = bert.init_params(cfg, jax.random.PRNGKey(0))
@@ -57,6 +110,9 @@ def bench_bert():
     opt_state = jax.jit(opt.init)(params)
     step = bert.make_dp_finetune_step(cfg, mesh, "dp", opt,
                                       reduce_grads=True)
+    k = _env_scan()
+    if k > 1:
+        step = _scan_wrap(step, 2, 2, k)
 
     rng = np.random.RandomState(0)
     sh = NamedSharding(mesh, P("dp"))
@@ -67,12 +123,13 @@ def bench_bert():
         rng.randint(0, cfg.num_labels, (batch * n_chips,)), jnp.int32), sh)
     params, opt_state, loss = step(params, opt_state, toks, labs)
     float(loss)
+    outer = max(1, steps // k)
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for _ in range(outer):
         params, opt_state, loss = step(params, opt_state, toks, labs)
     float(loss)
     dt = time.perf_counter() - t0
-    seq_per_sec_chip = batch * steps / dt
+    seq_per_sec_chip = batch * outer * k / dt
     mfu = (seq_per_sec_chip * seq * 6 * bert.count_params(cfg)
            ) / (detect_peak() * 1e12)
     print(json.dumps({
@@ -96,8 +153,8 @@ def bench_resnet():
     from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
 
     on_cpu = jax.devices()[0].platform == "cpu"
-    variant, img, batch, steps = (50, 224, 32, 20) if not on_cpu \
-        else (18, 32, 2, 3)
+    variant, img, batch, steps = (50, 224, _env_batch(32), 20) \
+        if not on_cpu else (18, 32, 2, 3)
     cfg = resnet.ResNetConfig(variant=variant, dtype=jnp.bfloat16)
     n_chips = jax.local_device_count()
     pmesh = ParallelMesh(MeshConfig(dp=n_chips))
@@ -114,16 +171,19 @@ def bench_resnet():
                        sh)
     y = jax.device_put(jnp.asarray(rng.randint(0, 1000, B), jnp.int32), sh)
 
-    params, state, opt_state, loss, _ = ts.step_fn(
-        params, state, opt_state, x, y)
+    k = _env_scan()
+    sf = ts.step_fn if k == 1 else _scan_wrap(ts.step_fn, 3, 3, k)
+    out = sf(params, state, opt_state, x, y)
+    params, state, opt_state, loss = out[0], out[1], out[2], out[3]
     float(loss)
+    outer = max(1, steps // k)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        params, state, opt_state, loss, _ = ts.step_fn(
-            params, state, opt_state, x, y)
+    for _ in range(outer):
+        out = sf(params, state, opt_state, x, y)
+        params, state, opt_state, loss = out[0], out[1], out[2], out[3]
     float(loss)
     dt = time.perf_counter() - t0
-    img_per_sec_chip = batch * steps / dt
+    img_per_sec_chip = batch * outer * k / dt
     # ResNet-50 fwd ~4.09 GFLOPs/image at 224^2; train ~3x fwd
     flops_per_img = 3 * 4.089e9 if variant == 50 else 0.0
     mfu = (img_per_sec_chip * flops_per_img) / (detect_peak() * 1e12)
@@ -140,6 +200,8 @@ def bench_longctx():
     throughput at 8k sequence length, where the flash-attention kernel's
     O(T·blk) memory is what makes the step fit at all.  The default
     metric stays llama_1b for round-over-round comparability."""
+    import os
+
     import optax
 
     from horovod_tpu import training
@@ -149,9 +211,12 @@ def bench_longctx():
     on_cpu = jax.devices()[0].platform == "cpu"
     cfg = llama.LlamaConfig(
         vocab_size=32768, d_model=1024, n_layers=8, n_heads=16,
-        n_kv_heads=8, d_ff=4096, max_seq_len=8192, remat=True,
+        n_kv_heads=8, d_ff=4096, max_seq_len=8192,
+        # ~100M params: 8k-seq activations fit HBM without remat, so
+        # recompute is an A/B knob here rather than a necessity
+        remat=os.environ.get("HOROVOD_BENCH_REMAT", "1") != "0",
         remat_policy="full", loss_chunk=1024)
-    batch, seq, steps = 1, 8192, 10
+    batch, seq, steps = _env_batch(1), 8192, 10
     if on_cpu:
         cfg = dataclasses.replace(cfg, d_model=256, n_layers=2, n_heads=8,
                                   n_kv_heads=4, d_ff=1024, vocab_size=4096,
@@ -168,14 +233,17 @@ def bench_longctx():
     toks = jax.device_put(jnp.asarray(
         rng.randint(0, cfg.vocab_size, (batch * n_chips, seq)), jnp.int32),
         sh)
-    params, opt_state, loss = ts.step_fn(params, opt_state, toks, toks)
+    k = _env_scan()
+    sf = ts.step_fn if k == 1 else _scan_wrap(ts.step_fn, 2, 2, k)
+    params, opt_state, loss = sf(params, opt_state, toks, toks)
     float(loss)
+    outer = max(1, steps // k)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = ts.step_fn(params, opt_state, toks, toks)
+    for _ in range(outer):
+        params, opt_state, loss = sf(params, opt_state, toks, toks)
     float(loss)
     dt = time.perf_counter() - t0
-    tok_per_sec_chip = batch * seq * steps / dt
+    tok_per_sec_chip = batch * seq * outer * k / dt
     # attention FLOPs matter at 8k: 6·N·params + 12·L·H·Dh·T per token
     n_params = llama.count_params(cfg)
     attn_flops_tok = 12 * cfg.n_layers * cfg.d_model * seq / 2
@@ -329,8 +397,9 @@ def main():
     # matmuls at the MXU's full 128-wide contraction; full remat trades
     # recompute FLOPs for the HBM that lets adamw master state fit.
     # Env knobs (defaults = the round-5 measured A/B winner on the real
-    # v5e chip, BENCH_NOTE_r05.md: chunk-1024 xent + bf16-moment AdamW +
-    # last-2-layers un-remat'd -> 16,518 t/s vs 15,895 at old defaults):
+    # v5e chip, BENCH_NOTE_r05.md: chunk-2048 xent + bf16-moment AdamW +
+    # last-2-layers un-remat'd -> 16,569 t/s, confirmed twice, vs 16,518
+    # at chunk-1024 and 15,895 at the r2-era defaults):
     #   HOROVOD_BENCH_LOSS_CHUNK  chunked vocab cross-entropy
     #   HOROVOD_BENCH_REMAT_SKIP  last-k layers un-remat'd
     #   HOROVOD_BENCH_OPT=lp      bf16-moment AdamW
@@ -342,11 +411,11 @@ def main():
         vocab_size=32768, d_model=2048, n_layers=16, n_heads=16,
         n_kv_heads=8, d_ff=8192, max_seq_len=1024, remat=True,
         remat_policy="full",
-        loss_chunk=int(os.environ.get("HOROVOD_BENCH_LOSS_CHUNK", "1024")),
+        loss_chunk=int(os.environ.get("HOROVOD_BENCH_LOSS_CHUNK", "2048")),
         remat_skip_layers=int(
             os.environ.get("HOROVOD_BENCH_REMAT_SKIP", "2")),
         fused_xent=os.environ.get("HOROVOD_BENCH_FUSED_XENT") == "1")
-    batch, seq, steps = 8, 1024, 30
+    batch, seq, steps = _env_batch(8), 1024, 30
     if on_cpu:  # keep the CPU fallback path quick
         cfg = dataclasses.replace(
             cfg, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4,
@@ -374,17 +443,20 @@ def main():
         sh)
 
     # warmup (compile)
-    params, opt_state, loss = ts.step_fn(params, opt_state, toks, tgts)
+    k = _env_scan()
+    sf = ts.step_fn if k == 1 else _scan_wrap(ts.step_fn, 2, 2, k)
+    params, opt_state, loss = sf(params, opt_state, toks, tgts)
     float(loss)  # device→host transfer is the reliable sync point
 
+    outer = max(1, steps // k)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = ts.step_fn(params, opt_state, toks, tgts)
+    for _ in range(outer):
+        params, opt_state, loss = sf(params, opt_state, toks, tgts)
     float(loss)
     dt = time.perf_counter() - t0
 
     tokens_per_step = batch * n_chips * seq
-    tok_per_sec = tokens_per_step * steps / dt
+    tok_per_sec = tokens_per_step * outer * k / dt
     tok_per_sec_chip = tok_per_sec / n_chips
 
     # model FLOPs: ~6 * params * tokens per train step (fwd+bwd)
