@@ -410,7 +410,9 @@ def main():
     cfg = llama.LlamaConfig(
         vocab_size=32768, d_model=2048, n_layers=16, n_heads=16,
         n_kv_heads=8, d_ff=8192, max_seq_len=1024, remat=True,
-        remat_policy="full",
+        # "dots" saves matmul outputs and recomputes only elementwise in
+        # the backward pass (A/B knob; "full" = max memory savings)
+        remat_policy=os.environ.get("HOROVOD_BENCH_REMAT_POLICY", "full"),
         loss_chunk=int(os.environ.get("HOROVOD_BENCH_LOSS_CHUNK", "2048")),
         remat_skip_layers=int(
             os.environ.get("HOROVOD_BENCH_REMAT_SKIP", "2")),

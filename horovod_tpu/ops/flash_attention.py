@@ -45,8 +45,19 @@ _VMEM_BUDGET = 10 * 1024 * 1024  # soft cap for resident kernel buffers
 
 
 def _block_sizes(t_q: int, t_kv: int):
-    bq = min(512, t_q)
-    bk = min(512, t_kv)
+    """Query/key block sizes for the kernel grid.
+
+    ``HOROVOD_FLASH_BLOCK`` overrides the 512 default (the measured
+    best on v5e at the flagship geometry; tools/flash_sweep.py measures
+    candidates — the reference tuned its fusion analogs through the
+    autotuner the same way).  The override is clamped to the sequence
+    lengths; supported() still rejects non-dividing or non-128-multiple
+    results, falling back to the XLA attention path."""
+    blk = int(os.environ.get("HOROVOD_FLASH_BLOCK", "512") or 512)
+    if blk <= 0:  # 0/negative would crash the divisibility gate; use
+        blk = 512  # HOROVOD_FLASH_ATTENTION=0 to disable the kernel
+    bq = min(blk, t_q)
+    bk = min(blk, t_kv)
     return bq, bk
 
 

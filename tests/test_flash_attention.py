@@ -213,3 +213,26 @@ def test_ring_attention_kernel_path_grads_interpret(monkeypatch, hvd):
     for a, b in zip(gr, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=3e-4, rtol=1e-3)
+
+
+def test_flash_block_env_override(monkeypatch):
+    """HOROVOD_FLASH_BLOCK tunes the kernel grid (tools/flash_sweep.py
+    feeds the measured best back through it); values the sequence
+    length cannot honor make supported() fall back to XLA attention."""
+    monkeypatch.setattr(fa, "_INTERPRET", True)
+    q, k, v = make_qkv(1, 256, 2, 2, 64)
+
+    monkeypatch.setenv("HOROVOD_FLASH_BLOCK", "128")
+    assert fa._block_sizes(256, 256) == (128, 128)
+    assert fa.supported(q, k, v, True)
+    out = fa.flash_attention(q, k, v, causal=True)
+    ref = dense_reference(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), atol=2e-3, rtol=2e-3)
+
+    # 192 does not divide T=256 -> kernel unsupported, caller falls back
+    monkeypatch.setenv("HOROVOD_FLASH_BLOCK", "192")
+    assert not fa.supported(q, k, v, True)
+
+    monkeypatch.delenv("HOROVOD_FLASH_BLOCK")
+    assert fa._block_sizes(1024, 1024) == (512, 512)

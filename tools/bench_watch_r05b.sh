@@ -38,6 +38,11 @@ sweep3() {
   run HOROVOD_BENCH_MODEL=longctx HOROVOD_BENCH_REMAT=0 || return
   run HOROVOD_BENCH_MODEL=longctx HOROVOD_BENCH_BATCH=2 \
       HOROVOD_BENCH_REMAT=0 || return
+  run HOROVOD_BENCH_REMAT_POLICY=dots || return
+  run HOROVOD_BENCH_REMAT_POLICY=dots HOROVOD_BENCH_REMAT_SKIP=0 || return
+  run HOROVOD_BENCH_REMAT_POLICY=dots HOROVOD_BENCH_SCAN=10 || return
+  run HOROVOD_FLASH_BLOCK=256 || return
+  run HOROVOD_FLASH_ATTENTION=0 || return
 }
 
 launch_probe() {
