@@ -281,6 +281,34 @@ def grouped_reducescatter(tensors: Sequence, op=None, name=None,
             for i, t in enumerate(tensors)]
 
 
+def rs_own_slice_np(res, ndim_in: int, ps):
+    """This worker's row of a (possibly stacked) reducescatter result,
+    as numpy — shared by the torch/TF adapters (each converts onward to
+    its framework type).
+
+    A stacked result (ndim = input ndim + 1) indexes workers on dim 0;
+    the full array may span other hosts, so the walk goes through this
+    host's addressable shards."""
+    import numpy as np
+
+    if getattr(res, "ndim", 0) == ndim_in + 1:
+        idx = ps.rank()  # this worker's index WITHIN the set
+        if idx < 0:
+            raise ValueError(
+                "reducescatter called from a worker outside the process "
+                "set")
+        if hasattr(res, "addressable_shards"):
+            for shard in res.addressable_shards:
+                rows = shard.index[0] if shard.index else slice(None)
+                start = rows.start or 0
+                data = np.asarray(shard.data)
+                if start <= idx < start + data.shape[0]:
+                    return data[idx - start]
+            raise RuntimeError("own reducescatter shard not found")
+        return np.asarray(res)[idx]
+    return np.asarray(res)
+
+
 # ---------------------------------------------------------------------------
 # handle management / sync primitives
 # ---------------------------------------------------------------------------

@@ -53,7 +53,10 @@ def _block_sizes(t_q: int, t_kv: int):
     autotuner the same way).  The override is clamped to the sequence
     lengths; supported() still rejects non-dividing or non-128-multiple
     results, falling back to the XLA attention path."""
-    blk = int(os.environ.get("HOROVOD_FLASH_BLOCK", "512") or 512)
+    try:
+        blk = int(os.environ.get("HOROVOD_FLASH_BLOCK", "512") or 512)
+    except ValueError:
+        blk = 512
     if blk <= 0:  # 0/negative would crash the divisibility gate; use
         blk = 512  # HOROVOD_FLASH_ATTENTION=0 to disable the kernel
     bq = min(blk, t_q)

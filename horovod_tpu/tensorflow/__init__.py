@@ -44,7 +44,7 @@ __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "Average", "Sum", "Adasum",
     "allreduce", "grouped_allreduce", "allgather", "grouped_allgather",
-    "broadcast",
+    "reducescatter", "grouped_reducescatter", "broadcast",
     "broadcast_variables", "broadcast_object", "allgather_object",
     "alltoall", "join",
     "barrier", "rank_op", "size_op", "local_rank_op", "local_size_op",
@@ -249,6 +249,95 @@ def allgather(tensor, name=None, process_set=None):
     out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype,
                          name=f"HorovodAllgather__{_XLA_FENCE}")
     return _set_gather_shape(out, tensor)
+
+
+def _rs_validate(rop, tensor, n: int):
+    """Mode-independent argument validation (the engine raises the same
+    errors at submission — the answer cannot depend on eager vs graph)."""
+    if rop not in (Sum, Average):
+        raise ValueError(
+            f"reducescatter supports Sum and Average, got {rop}")
+    d0 = tensor.shape[0] if tensor.shape.rank else None
+    if d0 is not None and int(d0) % n:
+        raise ValueError(
+            f"reducescatter dim-0 {int(d0)} not divisible by {n}")
+
+
+def reducescatter(tensor, op=None, name=None, process_set=None):
+    """Reduce across workers, keep this worker's dim-0 slice
+    (reference: hvd.tensorflow reducescatter)."""
+    rop = op if op is not None else Average
+    nm = name or "tfreducescatter"
+    n = _n_workers(process_set)
+    _rs_validate(rop, tensor, n)
+
+    if _graph_singleproc() and tensor.shape.rank \
+            and tensor.shape[0] is not None:
+        # engine replicated-branch semantics (ops/collectives.py
+        # reducescatter_array): reducing n identical copies scales by n
+        # for Sum and is the identity for Average; keep OUR slice —
+        # pure TF ops, XLA-compilable under jit_compile=True
+        if n <= 1:
+            return tf.identity(tensor)
+        idx = _api._ps(process_set).rank()
+        if idx < 0:
+            raise ValueError(
+                "reducescatter called from a worker outside the process "
+                "set")
+        chunk = int(tensor.shape[0]) // n
+        out = tensor[idx * chunk:(idx + 1) * chunk]
+        return out * tf.cast(n, out.dtype) if rop == Sum else out
+
+    def _np_op(x):
+        ps = _api._ps(process_set)
+        arr = x.numpy()
+        res = _api.reducescatter(arr, op=rop, name=nm,
+                                 process_set=process_set)
+        return _api.rs_own_slice_np(res, arr.ndim, ps)
+
+    out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype,
+                         name=f"HorovodReducescatter__{_XLA_FENCE}")
+    shape = tensor.shape.as_list()
+    if shape:
+        shape[0] = (shape[0] // n) if shape[0] is not None else None
+    out.set_shape(shape)
+    return out
+
+
+def grouped_reducescatter(tensors: Sequence, op=None, name=None,
+                          process_set=None) -> List:
+    """Reducescatter a list of tensors as one atomic fusion group
+    (reference: hvd.grouped_reducescatter)."""
+    rop = op if op is not None else Average
+    nm = name or "tfgroupedreducescatter"
+    n = _n_workers(process_set)
+    for t in tensors:
+        _rs_validate(rop, t, n)
+
+    if _graph_singleproc() and all(
+            t.shape.rank and t.shape[0] is not None for t in tensors):
+        return [reducescatter(t, op=rop, name=f"{nm}.{i}",
+                              process_set=process_set)
+                for i, t in enumerate(tensors)]
+
+    def _np_op(*xs):
+        ps = _api._ps(process_set)
+        arrs = [x.numpy() for x in xs]
+        outs = _api.grouped_reducescatter(arrs, op=rop, name=nm,
+                                          process_set=process_set)
+        return [_api.rs_own_slice_np(o, a.ndim, ps)
+                for o, a in zip(outs, arrs)]
+
+    outs = tf.py_function(_np_op, list(tensors),
+                          Tout=[t.dtype for t in tensors],
+                          name=f"HorovodGroupedReducescatter__{_XLA_FENCE}")
+    outs = _as_output_list(outs, len(tensors))
+    for o, t in zip(outs, tensors):
+        shape = t.shape.as_list()
+        if shape:
+            shape[0] = (shape[0] // n) if shape[0] is not None else None
+        o.set_shape(shape)
+    return outs
 
 
 def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):

@@ -163,29 +163,9 @@ def grouped_allgather(tensors: Sequence[torch.Tensor], name=None,
 
 def _rs_own_slice(res, tensor: torch.Tensor, ps) -> torch.Tensor:
     """Extract this worker's row from a (possibly stacked) reducescatter
-    result and convert back to torch."""
-    if getattr(res, "ndim", 0) == tensor.dim() + 1:
-        # stacked per-worker result: take this worker's row from its own
-        # addressable shard (the full array spans other hosts)
-        idx = ps.rank()  # this worker's index WITHIN the set
-        if idx < 0:
-            raise ValueError(
-                "reducescatter called from a worker outside the process "
-                "set")
-        if hasattr(res, "addressable_shards"):
-            for shard in res.addressable_shards:
-                rows = shard.index[0] if shard.index else slice(None)
-                start = rows.start or 0
-                data = np.asarray(shard.data)
-                if start <= idx < start + data.shape[0]:
-                    a = data[idx - start]
-                    break
-            else:  # pragma: no cover - defensive
-                raise RuntimeError("own reducescatter shard not found")
-        else:
-            a = np.asarray(res)[idx]
-    else:
-        a = np.asarray(res)
+    result and convert back to torch (shard walk shared with the TF
+    adapter: api.rs_own_slice_np)."""
+    a = _api.rs_own_slice_np(res, tensor.dim(), ps)
     return torch.from_numpy(np.array(a, copy=True)).to(tensor.dtype)
 
 

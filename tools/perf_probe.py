@@ -52,12 +52,9 @@ def main():
     from bench import detect_peak
 
     if args.flash_block:
-        from horovod_tpu.ops import flash_attention as fa
-        blk = args.flash_block
-
-        def _block_sizes(t_q, t_kv, _b=blk):
-            return min(_b, t_q), min(_b, t_kv)
-        fa._block_sizes = _block_sizes
+        # the supported override mechanism (ops/flash_attention.py
+        # _block_sizes reads it; keeps its <=0 and parse guards)
+        os.environ["HOROVOD_FLASH_BLOCK"] = str(args.flash_block)
 
     cfg = llama.LlamaConfig(
         vocab_size=32768, d_model=2048, n_layers=16, n_heads=16,
