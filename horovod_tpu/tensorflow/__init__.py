@@ -251,6 +251,19 @@ def allgather(tensor, name=None, process_set=None):
     return _set_gather_shape(out, tensor)
 
 
+def _set_rs_shape(out, inp, n: int):
+    """Reducescatter outputs keep the input shape with dim 0 divided by
+    the worker count (unknown-rank inputs stay unknown, mirroring
+    allreduce's behavior on them)."""
+    if inp.shape.rank is None:
+        return out
+    shape = inp.shape.as_list()
+    if shape:
+        shape[0] = (shape[0] // n) if shape[0] is not None else None
+    out.set_shape(shape)
+    return out
+
+
 def _rs_validate(rop, tensor, n: int):
     """Mode-independent argument validation (the engine raises the same
     errors at submission — the answer cannot depend on eager vs graph)."""
@@ -297,11 +310,7 @@ def reducescatter(tensor, op=None, name=None, process_set=None):
 
     out = tf.py_function(_np_op, [tensor], Tout=tensor.dtype,
                          name=f"HorovodReducescatter__{_XLA_FENCE}")
-    shape = tensor.shape.as_list()
-    if shape:
-        shape[0] = (shape[0] // n) if shape[0] is not None else None
-    out.set_shape(shape)
-    return out
+    return _set_rs_shape(out, tensor, n)
 
 
 def grouped_reducescatter(tensors: Sequence, op=None, name=None,
@@ -332,12 +341,7 @@ def grouped_reducescatter(tensors: Sequence, op=None, name=None,
                           Tout=[t.dtype for t in tensors],
                           name=f"HorovodGroupedReducescatter__{_XLA_FENCE}")
     outs = _as_output_list(outs, len(tensors))
-    for o, t in zip(outs, tensors):
-        shape = t.shape.as_list()
-        if shape:
-            shape[0] = (shape[0] // n) if shape[0] is not None else None
-        o.set_shape(shape)
-    return outs
+    return [_set_rs_shape(o, t, n) for o, t in zip(outs, tensors)]
 
 
 def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):

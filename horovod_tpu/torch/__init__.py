@@ -20,6 +20,7 @@ tensors into ``common::Tensor``).
 from __future__ import annotations
 
 import io
+import time
 from contextlib import contextmanager
 from typing import Iterable, Optional, Sequence, Tuple
 
@@ -43,8 +44,11 @@ __all__ = [
     "local_size", "cross_rank", "cross_size", "Average", "Sum", "Adasum",
     "Min", "Max",
     "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
-    "grouped_allreduce", "grouped_allreduce_async", "allgather",
-    "allgather_async", "grouped_allgather", "reducescatter", "grouped_reducescatter",
+    "grouped_allreduce", "grouped_allreduce_async", "grouped_allreduce_",
+    "grouped_allreduce_async_", "allgather",
+    "allgather_async", "grouped_allgather", "reducescatter",
+    "reducescatter_async", "grouped_reducescatter",
+    "grouped_reducescatter_async",
     "broadcast", "broadcast_async", "broadcast_",
     "broadcast_async_", "alltoall", "alltoall_async", "synchronize",
     "poll", "join", "barrier", "broadcast_object", "allgather_object",
@@ -257,10 +261,117 @@ def alltoall(tensor: torch.Tensor, splits=None, name=None,
     return torch.from_numpy(np.array(a, copy=True)).to(tensor.dtype)
 
 
-allreduce_ = allreduce
-allreduce_async_ = allreduce_async
-grouped_allreduce_ = grouped_allreduce
-grouped_allreduce_async_ = grouped_allreduce_async
+def allreduce_(tensor: torch.Tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0,
+               process_set=None) -> torch.Tensor:
+    """True in-place allreduce (reference: hvd.allreduce_): the reduced
+    value is copied into ``tensor``, which is returned."""
+    out = allreduce(tensor, average=average, name=name, op=op,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    process_set=process_set)
+    tensor.data.copy_(out)
+    return tensor
+
+
+def allreduce_async_(tensor: torch.Tensor, average=None, name=None,
+                     op=None, prescale_factor=1.0, postscale_factor=1.0,
+                     process_set=None) -> TorchHandle:
+    """In-place async allreduce: ``synchronize`` copies the result into
+    ``tensor`` and returns it (reference: hvd.allreduce_async_)."""
+    h = allreduce_async(tensor, average, name, op, prescale_factor,
+                        postscale_factor, process_set)
+    orig_sync = h.synchronize
+
+    def _sync():
+        tensor.data.copy_(orig_sync())
+        return tensor
+
+    h.synchronize = _sync  # type: ignore[method-assign]
+    return h
+
+
+def grouped_allreduce_(tensors: Sequence[torch.Tensor], average=None,
+                       name=None, op=None, prescale_factor=1.0,
+                       postscale_factor=1.0, process_set=None):
+    outs = grouped_allreduce(tensors, average, name, op, prescale_factor,
+                             postscale_factor, process_set)
+    for t, o in zip(tensors, outs):
+        t.data.copy_(o)
+    return list(tensors)
+
+
+def grouped_allreduce_async_(tensors: Sequence[torch.Tensor], average=None,
+                             name=None, op=None, prescale_factor=1.0,
+                             postscale_factor=1.0,
+                             process_set=None) -> TorchHandle:
+    h = grouped_allreduce_async(tensors, average, name, op,
+                                prescale_factor, postscale_factor,
+                                process_set)
+    orig_sync = h.synchronize
+
+    def _sync():
+        for t, o in zip(tensors, orig_sync()):
+            t.data.copy_(o)
+        return list(tensors)
+
+    h.synchronize = _sync  # type: ignore[method-assign]
+    return h
+
+
+def reducescatter_async(tensor: torch.Tensor, op=None, name=None,
+                        process_set=None) -> TorchHandle:
+    """Async reducescatter (reference: hvd.reducescatter_async)."""
+    ps = _api._ps(process_set)
+    h = _api.reducescatter_async(_to_np(tensor), op=op, name=name,
+                                 process_set=process_set)
+    hd = TorchHandle(h, [tensor], single=True)
+
+    def _sync(inner=h):
+        return _rs_own_slice(inner.synchronize(), tensor, ps)
+
+    hd.synchronize = _sync  # type: ignore[method-assign]
+    return hd
+
+
+def grouped_reducescatter_async(tensors: Sequence[torch.Tensor], op=None,
+                                name=None, process_set=None) -> TorchHandle:
+    tensors = list(tensors)
+    if not tensors:  # mirror grouped_reducescatter([]) -> []
+        done = TorchHandle.__new__(TorchHandle)
+        done._likes, done._single = [], False
+        done.poll = lambda: True                  # type: ignore
+        done.wait = lambda timeout=None: True     # type: ignore
+        done.synchronize = lambda: []             # type: ignore
+        return done
+    ps = _api._ps(process_set)
+    hs = [_api.reducescatter_async(
+        _to_np(t), op=op, name=f"{name}.{i}" if name else None,
+        process_set=process_set) for i, t in enumerate(tensors)]
+    hd = TorchHandle(hs[0], tensors, single=False)
+
+    def _poll():
+        return all(h.poll() for h in hs)
+
+    def _wait(timeout=None):
+        # one shared deadline across the group — per-handle timeouts
+        # would let the total block reach len(tensors) * timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for h in hs:
+            rem = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            if not h.wait(rem):
+                return False
+        return True
+
+    def _sync():
+        return [_rs_own_slice(h.synchronize(), t, ps)
+                for h, t in zip(hs, tensors)]
+
+    hd.poll = _poll          # type: ignore[method-assign]
+    hd.wait = _wait          # type: ignore[method-assign]
+    hd.synchronize = _sync   # type: ignore[method-assign]
+    return hd
 
 
 def join(device: int = -1) -> int:

@@ -342,3 +342,51 @@ def test_grouped_reducescatter(thvd, n_workers):
     assert outs[1].shape == (1,) or outs[1].shape == (1, 1)
     assert float(outs[0][0, 0]) == float(n_workers)
     assert float(outs[1].reshape(-1)[0]) == 2.0 * n_workers
+
+
+def test_allreduce_inplace_semantics(thvd, n_workers):
+    """Reference: hvd.allreduce_ / allreduce_async_ modify the argument
+    tensor in place (the former aliases returned fresh tensors)."""
+    t = torch.ones(4)
+    out = thvd.allreduce_(t, op=thvd.Sum, name="inplace_sum")
+    assert out is t
+    assert torch.allclose(t, torch.full((4,), float(n_workers)))
+
+    t2 = torch.ones(3)
+    h = thvd.allreduce_async_(t2, op=thvd.Sum, name="inplace_async")
+    out2 = h.synchronize()
+    assert out2 is t2
+    assert torch.allclose(t2, torch.full((3,), float(n_workers)))
+
+
+def test_grouped_allreduce_inplace(thvd, n_workers):
+    ts = [torch.ones(2) * (i + 1) for i in range(3)]
+    outs = thvd.grouped_allreduce_(ts, op=thvd.Sum, name="grp_inplace")
+    for i, (t, o) in enumerate(zip(ts, outs)):
+        assert o is t
+        assert torch.allclose(t, torch.full((2,), float((i + 1) * n_workers)))
+
+    ts2 = [torch.ones(2), torch.ones(2) * 2]
+    h = thvd.grouped_allreduce_async_(ts2, op=thvd.Sum, name="grp_ia")
+    outs2 = h.synchronize()
+    for i, (t, o) in enumerate(zip(ts2, outs2)):
+        assert o is t
+        assert torch.allclose(t, torch.full((2,), float((i + 1) * n_workers)))
+
+
+def test_reducescatter_async(thvd, n_workers):
+    """hvd.reducescatter_async: handle resolves to this worker's dim-0
+    slice of the reduction."""
+    t = torch.arange(2.0 * n_workers).reshape(2 * n_workers, 1)
+    h = thvd.reducescatter_async(t, op=thvd.Sum, name="rs_async")
+    h.wait(10)
+    out = h.synchronize()
+    assert torch.allclose(out, t[:2] * n_workers)
+
+    ts = [torch.ones((n_workers, 2)) * (i + 1) for i in range(2)]
+    hg = thvd.grouped_reducescatter_async(ts, op=thvd.Sum, name="grs_a")
+    assert hg.wait(10)
+    outs = hg.synchronize()
+    for i, o in enumerate(outs):
+        assert torch.allclose(o, torch.full((1, 2),
+                                            float((i + 1) * n_workers)))
