@@ -14,6 +14,7 @@ from ..tensorflow import (DistributedOptimizer, allreduce, broadcast,  # noqa: F
                           cross_size, shutdown, Average, Sum, Adasum,
                           Compression)
 from .callbacks import (BroadcastGlobalVariablesCallback,  # noqa: F401
+                        LearningRateScheduleCallback,
                         LearningRateWarmupCallback, MetricAverageCallback)
 
 __all__ = [
@@ -21,5 +22,6 @@ __all__ = [
     "local_size", "cross_rank", "cross_size", "Average", "Sum", "Adasum",
     "DistributedOptimizer", "allreduce", "broadcast", "broadcast_variables",
     "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
-    "LearningRateWarmupCallback", "Compression",
+    "LearningRateWarmupCallback", "LearningRateScheduleCallback",
+    "Compression",
 ]

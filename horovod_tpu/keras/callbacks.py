@@ -2,10 +2,11 @@
 
 Reference parity: ``horovod/_keras/callbacks.py`` (SURVEY.md §2.2) —
 ``BroadcastGlobalVariablesCallback`` (weight sync at train start),
-``MetricAverageCallback`` (allreduce-averaged epoch metrics) and
+``MetricAverageCallback`` (allreduce-averaged epoch metrics),
 ``LearningRateWarmupCallback`` (linear LR ramp over the first epochs,
 scaling to ``size()`` workers, per the large-batch training recipe the
-reference ships).
+reference ships) and ``LearningRateScheduleCallback`` (staircase /
+smooth LR decay over an epoch range).
 """
 
 from __future__ import annotations
@@ -14,6 +15,20 @@ import numpy as np
 import tensorflow as tf
 
 keras = tf.keras
+
+
+def _set_model_lr(model, lr: float):
+    """Assign the optimizer's learning rate (shared by the LR callbacks:
+    one place to extend when an optimizer's learning_rate is a schedule
+    object rather than a variable/attribute)."""
+    opt = model.optimizer
+    lr_attr = getattr(opt, "learning_rate", None)
+    if lr_attr is None:
+        return
+    if hasattr(lr_attr, "assign"):
+        lr_attr.assign(lr)
+    else:
+        opt.learning_rate = lr
 
 
 class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
@@ -63,6 +78,61 @@ class MetricAverageCallback(keras.callbacks.Callback):
                     process_set=self.process_set)))
 
 
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """Multiply the base LR by ``multiplier`` over an epoch range
+    (reference: LearningRateScheduleCallbackImpl — the staircase /
+    exponential-decay half of the large-batch recipe, which
+    LearningRateWarmupCallback complements).
+
+    ``multiplier`` is a constant or a callable ``epoch -> factor``;
+    the schedule applies on ``[start_epoch, end_epoch)``.  With
+    ``staircase=True`` the factor updates once per epoch; otherwise it
+    updates every batch using fractional epochs (needs
+    ``steps_per_epoch``)."""
+
+    def __init__(self, initial_lr: float, multiplier, start_epoch: int = 0,
+                 end_epoch=None, staircase: bool = True,
+                 steps_per_epoch=None):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        self._batches = 0
+        if not staircase and steps_per_epoch is None:
+            raise ValueError(
+                "staircase=False requires steps_per_epoch so the "
+                "schedule can compute fractional epochs")
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+
+    def _in_range(self, epoch) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def _set_lr(self, lr: float):
+        _set_model_lr(self.model, lr)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        self._batches = 0
+        if self.staircase and self._in_range(epoch):
+            self._set_lr(self.initial_lr * self.multiplier(epoch))
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if self.staircase:
+            return
+        epoch = self.current_epoch + self._batches / self.steps_per_epoch
+        self._batches += 1
+        if self._in_range(epoch):
+            self._set_lr(self.initial_lr * self.multiplier(epoch))
+
+
 class LearningRateWarmupCallback(keras.callbacks.Callback):
     """Linearly ramp LR from the single-worker rate to ``initial_lr`` over
     ``warmup_epochs`` (reference: LearningRateWarmupCallbackImpl;
@@ -80,14 +150,7 @@ class LearningRateWarmupCallback(keras.callbacks.Callback):
         self._steps = 0
 
     def _set_lr(self, lr: float):
-        opt = self.model.optimizer
-        lr_attr = getattr(opt, "learning_rate", None)
-        if lr_attr is None:
-            return
-        if hasattr(lr_attr, "assign"):
-            lr_attr.assign(lr)
-        else:
-            opt.learning_rate = lr
+        _set_model_lr(self.model, lr)
 
     def on_epoch_begin(self, epoch, logs=None):
         self.current_epoch = epoch

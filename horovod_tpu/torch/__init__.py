@@ -338,8 +338,7 @@ def grouped_reducescatter_async(tensors: Sequence[torch.Tensor], op=None,
                                 name=None, process_set=None) -> TorchHandle:
     tensors = list(tensors)
     if not tensors:  # mirror grouped_reducescatter([]) -> []
-        done = TorchHandle.__new__(TorchHandle)
-        done._likes, done._single = [], False
+        done = TorchHandle(None, [], single=False)
         done.poll = lambda: True                  # type: ignore
         done.wait = lambda timeout=None: True     # type: ignore
         done.synchronize = lambda: []             # type: ignore
@@ -348,7 +347,8 @@ def grouped_reducescatter_async(tensors: Sequence[torch.Tensor], op=None,
     hs = [_api.reducescatter_async(
         _to_np(t), op=op, name=f"{name}.{i}" if name else None,
         process_set=process_set) for i, t in enumerate(tensors)]
-    hd = TorchHandle(hs[0], tensors, single=False)
+    # every TorchHandle method is overridden below; _inner is unused
+    hd = TorchHandle(None, tensors, single=False)
 
     def _poll():
         return all(h.poll() for h in hs)

@@ -406,3 +406,47 @@ def test_reducescatter_validation_mode_independent(tfhvd, n_workers):
 
     with pytest.raises(ValueError, match="Sum and Average"):
         step(tf.ones((n_workers, 1)))
+
+
+def test_lr_schedule_callback(tfhvd):
+    """LearningRateScheduleCallback (reference: the staircase /
+    exponential-decay half of the large-batch recipe): constant or
+    callable multiplier over [start_epoch, end_epoch)."""
+    import horovod_tpu.keras as khvd
+    model = _tiny_keras_model()
+
+    sc = khvd.LearningRateScheduleCallback(
+        initial_lr=0.08, multiplier=lambda epoch: 0.1 ** (epoch // 2),
+        start_epoch=2)
+    sc.set_model(model)
+    sc.on_epoch_begin(0)  # before start_epoch: untouched
+    lr = float(np.asarray(model.optimizer.learning_rate))
+    assert lr == pytest.approx(0.08, rel=1e-6)
+    sc.on_epoch_begin(2)
+    lr = float(np.asarray(model.optimizer.learning_rate))
+    assert lr == pytest.approx(0.08 * 0.1, rel=1e-6)
+    sc.on_epoch_begin(4)
+    lr = float(np.asarray(model.optimizer.learning_rate))
+    assert lr == pytest.approx(0.08 * 0.01, rel=1e-6)
+
+    # constant multiplier + smooth (non-staircase) fractional epochs
+    model2 = _tiny_keras_model()
+    sm = khvd.LearningRateScheduleCallback(
+        initial_lr=1.0, multiplier=lambda e: 1.0 / (1.0 + e),
+        staircase=False, steps_per_epoch=4)
+    sm.set_model(model2)
+    sm.on_epoch_begin(1)
+    sm.on_train_batch_begin(0)   # epoch 1.0
+    lr0 = float(np.asarray(model2.optimizer.learning_rate))
+    assert lr0 == pytest.approx(0.5, rel=1e-6)
+    sm.on_train_batch_begin(1)   # epoch 1.25
+    lr1 = float(np.asarray(model2.optimizer.learning_rate))
+    assert lr1 == pytest.approx(1.0 / 2.25, rel=1e-6)
+
+    # constant (non-callable) multiplier path
+    const = khvd.LearningRateScheduleCallback(initial_lr=0.5,
+                                              multiplier=0.2)
+    const.set_model(model2)
+    const.on_epoch_begin(0)
+    lr2 = float(np.asarray(model2.optimizer.learning_rate))
+    assert lr2 == pytest.approx(0.1, rel=1e-6)
