@@ -46,15 +46,15 @@ def _env_batch(default: int) -> int:
     return int(os.environ.get("HOROVOD_BENCH_BATCH", default))
 
 
-def _env_scan() -> int:
+def _env_scan(default: int = 1) -> int:
     """HOROVOD_BENCH_SCAN: drive K train steps per device dispatch via
-    ``lax.scan`` (1 = eager loop, the default).  Steps whose compute
-    time is tens of ms are otherwise dominated by the axon tunnel's
-    per-dispatch RPC latency, which measures the relay, not the chip;
-    multi-step scan is how real long-running TPU loops amortize host
-    dispatch anyway."""
+    ``lax.scan`` (1 = eager loop).  Steps whose compute time is tens of
+    ms are otherwise dominated by the axon tunnel's per-dispatch RPC
+    latency, which measures the relay, not the chip; multi-step scan is
+    how real long-running TPU loops amortize host dispatch anyway.
+    Per-mode defaults = the measured round-5 winners."""
     import os
-    return max(1, int(os.environ.get("HOROVOD_BENCH_SCAN", "1")))
+    return max(1, int(os.environ.get("HOROVOD_BENCH_SCAN", str(default))))
 
 
 def _scan_wrap(step_fn, n_carry: int, loss_idx: int, k: int):
@@ -96,7 +96,7 @@ def bench_bert():
 
     on_cpu = jax.devices()[0].platform == "cpu"
     cfg = bert.bert_base(num_labels=4) if not on_cpu else bert.tiny()
-    batch, seq, steps = (_env_batch(32), 128, 20) if not on_cpu \
+    batch, seq, steps = (_env_batch(256), 128, 40) if not on_cpu \
         else (4, 32, 3)
     cfg = dataclasses.replace(
         cfg, max_seq_len=max(cfg.max_seq_len, seq),
@@ -110,7 +110,7 @@ def bench_bert():
     opt_state = jax.jit(opt.init)(params)
     step = bert.make_dp_finetune_step(cfg, mesh, "dp", opt,
                                       reduce_grads=True)
-    k = _env_scan()
+    k = _env_scan(10) if not on_cpu else _env_scan()
     if k > 1:
         step = _scan_wrap(step, 2, 2, k)
 
@@ -153,7 +153,7 @@ def bench_resnet():
     from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
 
     on_cpu = jax.devices()[0].platform == "cpu"
-    variant, img, batch, steps = (50, 224, _env_batch(32), 20) \
+    variant, img, batch, steps = (50, 224, _env_batch(128), 40) \
         if not on_cpu else (18, 32, 2, 3)
     cfg = resnet.ResNetConfig(variant=variant, dtype=jnp.bfloat16)
     n_chips = jax.local_device_count()
@@ -171,7 +171,7 @@ def bench_resnet():
                        sh)
     y = jax.device_put(jnp.asarray(rng.randint(0, 1000, B), jnp.int32), sh)
 
-    k = _env_scan()
+    k = _env_scan(10) if not on_cpu else _env_scan()
     sf = ts.step_fn if k == 1 else _scan_wrap(ts.step_fn, 3, 3, k)
     out = sf(params, state, opt_state, x, y)
     params, state, opt_state, loss = out[0], out[1], out[2], out[3]

@@ -100,7 +100,6 @@ class LearningRateScheduleCallback(keras.callbacks.Callback):
         self.staircase = staircase
         self.steps_per_epoch = steps_per_epoch
         self.current_epoch = 0
-        self._batches = 0
         if not staircase and steps_per_epoch is None:
             raise ValueError(
                 "staircase=False requires steps_per_epoch so the "
@@ -120,15 +119,14 @@ class LearningRateScheduleCallback(keras.callbacks.Callback):
 
     def on_epoch_begin(self, epoch, logs=None):
         self.current_epoch = epoch
-        self._batches = 0
         if self.staircase and self._in_range(epoch):
             self._set_lr(self.initial_lr * self.multiplier(epoch))
 
     def on_train_batch_begin(self, batch, logs=None):
         if self.staircase:
             return
-        epoch = self.current_epoch + self._batches / self.steps_per_epoch
-        self._batches += 1
+        # keras passes the in-epoch batch index — no extra counter needed
+        epoch = self.current_epoch + batch / self.steps_per_epoch
         if self._in_range(epoch):
             self._set_lr(self.initial_lr * self.multiplier(epoch))
 
