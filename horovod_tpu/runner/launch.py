@@ -94,6 +94,11 @@ def check_build(out=None) -> int:
         from jax.experimental import pallas  # noqa: F401
         return True
 
+    def _tf_bridge_built():
+        # report-only: a built artifact on disk, no compile kicked off
+        import horovod_tpu.tensorflow._xla_bridge as bridge
+        return os.path.exists(bridge._OUT)
+
     import horovod_tpu
     checks = [
         ("JAX (XLA collectives data plane)", lambda: has_module("jax")),
@@ -102,6 +107,7 @@ def check_build(out=None) -> int:
         ("Keras callbacks", lambda: has_module("tensorflow")),
         ("MXNet adapter", lambda: has_module("mxnet")),
         ("Native C++ core (_hvd_core)", native_built),
+        ("TF XLA op bridge (jit_compile collectives)", _tf_bridge_built),
         ("Pallas kernels (flash attention, fused xent)", flash_ok),
         ("Elastic training", lambda: has_module("horovod_tpu.elastic")),
         ("Estimators (Torch/Keras)",

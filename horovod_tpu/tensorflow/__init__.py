@@ -75,6 +75,74 @@ def _eager_allreduce_np(x: np.ndarray, name: str, op: str,
 # EagerPyFunc.
 _XLA_FENCE = "requires_jit_compile_False_see_docs_adapters_md"
 
+# dtypes the custom-op bridge's kernels support
+_BRIDGE_DTYPES = (tf.float32, tf.float64, tf.float16, tf.int32, tf.int64,
+                  tf.bfloat16)
+_bridge_consensus: Optional[bool] = None
+
+
+def _bridge_agreed() -> bool:
+    """Whether EVERY process has a working bridge.
+
+    The bridge and py_function paths submit structurally different work
+    (per-dtype grouped ops with suffixed names vs one group), so mixed
+    availability across processes would deadlock the negotiation.  One
+    engine round at first use agrees the answer for the job; a process
+    whose build failed forces everyone onto py_function — loudly."""
+    global _bridge_consensus
+    if _bridge_consensus is None:
+        from . import _xla_bridge
+        local = _xla_bridge.available()
+        oks = _api.allgather_object(bool(local), name="tfxla.bridge.ok")
+        _bridge_consensus = all(oks)
+        if local and not _bridge_consensus:
+            import logging
+            logging.getLogger("horovod_tpu").warning(
+                "TF XLA op bridge disabled for this job: %d/%d processes "
+                "failed to build/load it (their logs say why); every "
+                "process keeps the py_function path so submissions "
+                "match.", sum(1 for o in oks if not o), len(oks))
+    return _bridge_consensus
+
+
+def _f32_exact(v: float) -> bool:
+    return float(np.float32(v)) == float(v)
+
+
+def _bridge(dtypes, process_set=None, scales=()):
+    """The registered custom-op library when it can serve this call
+    (reference: mpi_ops.cc registered ops + xla_mpi_ops.cc CustomCall —
+    collectives that survive ``tf.function(jit_compile=True)``).
+
+    Falls back to the py_function path (returns None) for process-set
+    scoped calls (the bridge dispatches on the global set), dtypes
+    outside the kernel table, and single-process jobs (their stacked
+    per-worker semantics don't match the one-worker-per-process op
+    contract — and single-process graphs already lower to pure TF ops);
+    HOROVOD_TF_XLA_OPS=0 disables outright.  Availability is agreed
+    across processes (one engine round, cached) so every process takes
+    the same path."""
+    if process_set is not None:
+        return None
+    try:
+        if cross_size() <= 1:
+            return None
+    except Exception:  # noqa: BLE001 - not initialized
+        return None
+    for dt in dtypes:
+        if dt not in _BRIDGE_DTYPES:
+            return None
+    # scale factors ride f32 op attrs (upstream's op def uses float
+    # too); a factor that f32 cannot represent exactly keeps the
+    # py_function path, which forwards the full double
+    for v in scales:
+        if not _f32_exact(v):
+            return None
+    if not _bridge_agreed():
+        return None
+    from . import _xla_bridge
+    return _xla_bridge
+
 
 def _n_workers(process_set) -> int:
     return process_set.size() if process_set is not None else size()
@@ -136,6 +204,14 @@ def allreduce(tensor, average=None, name=None, op=None,
         out = _replicated_reduce(out, rop, _n_workers(process_set))
         return _scaled(out, postscale_factor)
 
+    br = _bridge([tensor.dtype], process_set,
+                 scales=(prescale_factor, postscale_factor))
+    if br is not None:
+        return br.ops().horovod_tpu_collective(
+            tensor, kind="allreduce", tensor_name=br.sanitize_name(nm),
+            reduce_op=rop, prescale=prescale_factor,
+            postscale=postscale_factor, nproc=_n_workers(process_set))
+
     def _np_op(x):
         return _eager_allreduce_np(x.numpy(), nm, rop, prescale_factor,
                                    postscale_factor, process_set)
@@ -176,6 +252,27 @@ def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
         return [_scaled(_replicated_reduce(
             _scaled(t, prescale_factor), rop, n), postscale_factor)
             for t in tensors]
+
+    br = _bridge([t.dtype for t in tensors], process_set,
+                 scales=(prescale_factor, postscale_factor))
+    if br is not None and tensors:
+        # one variadic op per dtype (the engine's fusion buckets are
+        # per-dtype anyway — SURVEY §2.1 fusion buffer); deterministic
+        # dtype order so every process negotiates the same groups
+        out: List = [None] * len(tensors)
+        by_dtype: dict = {}
+        for i, t in enumerate(tensors):
+            by_dtype.setdefault(t.dtype.name, []).append(i)
+        for dt_name in sorted(by_dtype):
+            idxs = by_dtype[dt_name]
+            outs = br.ops().horovod_tpu_grouped_allreduce(
+                [tensors[i] for i in idxs],
+                tensor_name=br.sanitize_name(f"{nm}.{dt_name}"),
+                reduce_op=rop, prescale=prescale_factor,
+                postscale=postscale_factor)
+            for i, o in zip(idxs, outs):
+                out[i] = o
+        return out
 
     def _np_op(*xs):
         outs = _api.grouped_allreduce([x.numpy() for x in xs],
@@ -242,6 +339,17 @@ def allgather(tensor, name=None, process_set=None):
         # replicated allgather = worker-count copies along dim 0
         return tf.concat([tensor] * _n_workers(process_set), axis=0)
 
+    # uniform shapes only via the bridge: the XLA lowering needs the
+    # n*dim0 output shape statically; ragged (Allgatherv) inputs fail
+    # actionably in the dispatch and need the py_function path
+    if tensor.shape.rank and tensor.shape[0] is not None:
+        br = _bridge([tensor.dtype], process_set)
+        if br is not None:
+            return br.ops().horovod_tpu_collective(
+                tensor, kind="allgather",
+                tensor_name=br.sanitize_name(nm),
+                nproc=_n_workers(process_set))
+
     def _np_op(x):
         return np.asarray(_api.allgather(x.numpy(), name=nm,
                                          process_set=process_set))
@@ -301,6 +409,13 @@ def reducescatter(tensor, op=None, name=None, process_set=None):
         out = tensor[idx * chunk:(idx + 1) * chunk]
         return out * tf.cast(n, out.dtype) if rop == Sum else out
 
+    if tensor.shape.rank and tensor.shape[0] is not None:
+        br = _bridge([tensor.dtype], process_set)
+        if br is not None:
+            return br.ops().horovod_tpu_collective(
+                tensor, kind="reducescatter",
+                tensor_name=br.sanitize_name(nm), reduce_op=rop, nproc=n)
+
     def _np_op(x):
         ps = _api._ps(process_set)
         arr = x.numpy()
@@ -350,6 +465,12 @@ def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):
     if _graph_singleproc():
         return tf.identity(tensor)  # replicated: already everywhere
 
+    br = _bridge([tensor.dtype], process_set)
+    if br is not None:
+        return br.ops().horovod_tpu_collective(
+            tensor, kind="broadcast", tensor_name=br.sanitize_name(nm),
+            root_rank=root_rank, nproc=_n_workers(process_set))
+
     def _np_op(x):
         return np.asarray(_api.broadcast(x.numpy(), root_rank, name=nm,
                                          process_set=process_set))
@@ -389,6 +510,13 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
                 tf.concat([tensor[j * rows:(j + 1) * rows]] * n, axis=0)
                 for j in range(n)]
             return tf.stack(per_worker, axis=0)
+
+    if splits is None:
+        br = _bridge([tensor.dtype], process_set)
+        if br is not None:
+            return br.ops().horovod_tpu_collective(
+                tensor, kind="alltoall", tensor_name=br.sanitize_name(nm),
+                nproc=_n_workers(process_set))
 
     def _np_op(x):
         res = _api.alltoall(x.numpy(), splits=splits, name=nm,

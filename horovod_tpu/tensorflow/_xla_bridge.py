@@ -1,0 +1,182 @@
+"""TF custom-op bridge: registered collective ops with XLA kernels.
+
+Reference parity: ``horovod/tensorflow/mpi_ops.cc`` (registered custom
+ops as the binding) + ``xla_mpi_ops.cc`` (XLA CustomCall registration so
+the ops survive ``tf.function(jit_compile=True)``) — SURVEY.md §2.1.
+
+``native/tf_xla_ops.cc`` registers ``HorovodTpuCollective`` /
+``HorovodTpuGroupedAllreduce`` with a CPU kernel (eager + plain graphs)
+and an XlaOpKernel lowering to a typed-FFI custom call (XLA:CPU
+clusters).  Both kernels call back into :func:`_dispatch` below, which
+routes into the same engine as every other frontend — so multi-process
+collectives now work INSIDE ``jit_compile=True`` graphs, the capability
+the py_function fence previously blocked.
+
+Built on demand with the toolchain g++ against the pip TF headers
+(``tf.sysconfig``); ``HOROVOD_TF_XLA_OPS=0`` disables, and any
+build/load failure falls back to the py_function path silently (the
+fence keeps working exactly as before).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sysconfig
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("horovod_tpu")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(_HERE), "native", "tf_xla_ops.cc")
+_OUT = os.path.join(os.path.dirname(_HERE), "native", "_hvd_tf_xla_ops.so")
+
+_lib = None
+_lib_failed = False
+
+
+def _build(timeout: float = 600.0) -> bool:
+    """Compile the op library.
+
+    Always file-locked (hvdrun spawns N workers that may all trigger a
+    first-use build), and the compiler writes to a temp path that is
+    os.replace()d into place — a reader can never observe a partially
+    written .so."""
+    import tensorflow as tf
+
+    lock_path = _OUT + ".lock"
+    with open(lock_path, "w") as lock_f:
+        import fcntl
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(_OUT) and \
+                    os.path.getmtime(_OUT) >= os.path.getmtime(_SRC):
+                return True
+            tf_dir = os.path.dirname(tf.__file__)
+            inc = os.path.join(tf_dir, "include")
+            tmp = _OUT + ".tmp"
+            cmd = [os.environ.get("CXX", "g++"), "-shared", "-fPIC", "-O2",
+                   _SRC, "-o", tmp,
+                   f"-I{sysconfig.get_paths()['include']}",
+                   f"-I{inc}",
+                   f"-I{os.path.join(inc, 'external', 'highwayhash')}",
+                   f"-I{os.path.join(inc, 'external', 'farmhash_archive', 'src')}",  # noqa: E501
+                   "-D_GLIBCXX_USE_CXX11_ABI=1", "--std=c++17",
+                   "-DEIGEN_MAX_ALIGN_BYTES=64",
+                   f"-L{tf_dir}", "-l:libtensorflow_framework.so.2"]
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=timeout)
+            os.replace(tmp, _OUT)
+            logger.info("built TF XLA op bridge: %s", _OUT)
+            return True
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+                FileNotFoundError) as exc:
+            stderr = getattr(exc, "stderr", b"") or b""
+            logger.warning(
+                "TF XLA op bridge build failed (%s); multi-process "
+                "collectives keep the py_function path.\n%s", exc,
+                stderr.decode(errors="replace")[-2000:])
+            return False
+        finally:
+            fcntl.flock(lock_f, fcntl.LOCK_UN)
+
+
+def available() -> bool:
+    """True when the op library is built and loaded.  The env kill
+    switch is honored per call (not cached), so a job can fence the
+    bridge off even after a load."""
+    global _lib, _lib_failed
+    if os.environ.get("HOROVOD_TF_XLA_OPS", "1") in ("0", "false"):
+        return False
+    if _lib is not None:
+        return True
+    if _lib_failed:
+        return False
+    try:
+        if not _build():
+            _lib_failed = True
+            return False
+        import tensorflow as tf
+        _lib = tf.load_op_library(_OUT)
+        return True
+    except Exception as exc:  # noqa: BLE001 - any failure means fallback
+        logger.warning("TF XLA op bridge unavailable (%s); multi-process "
+                       "collectives keep the py_function path.", exc)
+        _lib_failed = True
+        return False
+
+
+def ops():
+    """The loaded op module (call :func:`available` first)."""
+    return _lib
+
+
+def sanitize_name(name: str) -> str:
+    """Attr-safe tensor name (it rides an MLIR attribute dictionary in
+    the XLA lowering; applied in ONE place so the eager/graph/XLA paths
+    all negotiate the same identity)."""
+    return "".join(c if (c.isalnum() or c in "._/-") else "_"
+                   for c in name)
+
+
+def _np_dtype(dtype: str):
+    if dtype == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(dtype)
+
+
+def _dispatch(kind: str, name: str, rop: str, root: int, pre: float,
+              post: float, dtype: str, in_views, in_dims, out_views,
+              out_dims) -> None:
+    """Kernel-side trampoline: zero-copy memoryviews in/out.
+
+    Runs on a TF executor (or XLA runtime) thread under the GIL; the
+    engine's synchronize() waits on an Event, which releases the GIL so
+    the background engine thread keeps negotiating.
+    """
+    from .. import api
+
+    dt = _np_dtype(dtype)
+    arrs = [np.frombuffer(v, dtype=dt).reshape(d).copy()
+            for v, d in zip(in_views, in_dims)]
+
+    if kind == "grouped_allreduce":
+        res = api.grouped_allreduce(arrs, op=rop, name=name or None,
+                                    prescale_factor=pre,
+                                    postscale_factor=post)
+    else:
+        x = arrs[0]
+        if kind == "allreduce":
+            res = api.allreduce(x, op=rop, name=name or None,
+                                prescale_factor=pre, postscale_factor=post)
+        elif kind == "allgather":
+            res = api.allgather(x, name=name or None)
+            got = np.asarray(res).shape
+            if got != tuple(out_dims[0]):
+                raise ValueError(
+                    f"bridge allgather result shape {got} != static XLA "
+                    f"shape {tuple(out_dims[0])}: ragged (Allgatherv) "
+                    "inputs need the py_function path - set "
+                    "HOROVOD_TF_XLA_OPS=0 for this job")
+        elif kind == "broadcast":
+            res = api.broadcast(x, int(root), name=name or None)
+        elif kind == "alltoall":
+            res = api.alltoall(x, name=name or None)
+            if isinstance(res, list):
+                from .. import runtime
+                res = res[runtime.rank()]
+        elif kind == "reducescatter":
+            res = api.rs_own_slice_np(
+                api.reducescatter(x, op=rop, name=name or None),
+                x.ndim, api._ps(None))
+        else:
+            raise ValueError(f"unknown collective kind {kind!r}")
+        res = [res]
+
+    for r, v, d in zip(res, out_views, out_dims):
+        out = np.frombuffer(v, dtype=dt).reshape(d)
+        out[...] = np.asarray(r, dtype=dt).reshape(d)

@@ -520,3 +520,36 @@ def controller_shutdown_clean_fn():
     leftover = client.key_value_dir_get("hvdctl/cleantest/")
     return {"rank": r, "pre": len(pre),
             "leftover": [k for k, _ in leftover]}
+
+
+def tf_jit_collectives_fn():
+    """2-process collectives INSIDE tf.function(jit_compile=True): the
+    custom-op bridge lowers them to typed-FFI XLA custom calls
+    (reference: xla_mpi_ops.cc / HOROVOD_ENABLE_XLA_OPS — collectives
+    surviving XLA compilation)."""
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+    from horovod_tpu.tensorflow import _xla_bridge
+
+    hvd.init()
+    r = hvd.cross_rank()
+    if not _xla_bridge.available():
+        hvd.shutdown()
+        return {"rank": r, "skipped": True}
+
+    @tf.function(jit_compile=True)
+    def step(x):
+        s = hvd.allreduce(x, op=hvd.Sum, name="jit2p.sum")
+        g = hvd.allgather(tf.reshape(x, (1, 2)), name="jit2p.ag")
+        outs = hvd.grouped_allreduce([x, x * 2.0], op=hvd.Sum,
+                                     name="jit2p.grp")
+        b = hvd.broadcast(x, root_rank=0, name="jit2p.bc")
+        return s, g, outs[0], outs[1], b
+
+    x = tf.constant([float(r + 1), 2.0 * (r + 1)])
+    s, g, g0, g1, b = step(x)
+    out = {"rank": r, "sum": s.numpy().tolist(),
+           "gathered": g.numpy().tolist(), "grp0": g0.numpy().tolist(),
+           "grp1": g1.numpy().tolist(), "bcast": b.numpy().tolist()}
+    hvd.shutdown()
+    return out
