@@ -104,11 +104,13 @@ std::string FetchPyError() {
 }
 
 // Calls horovod_tpu.tensorflow._xla_bridge._dispatch(kind, name, rop,
-// root, pre, post, dtype, ins, in_dims, outs, out_dims).  Returns ""
+// root, pre, post, psid, dtype, ins, in_dims, outs, out_dims).
+// psid = registered process-set id, -1 for the global set.  Returns ""
 // on success, the error message otherwise.
 std::string CallDispatch(const std::string& kind, const std::string& name,
                          const std::string& rop, int64_t root, double pre,
-                         double post, const std::string& dtype,
+                         double post, int64_t psid,
+                         const std::string& dtype,
                          const std::vector<BufferRef>& ins,
                          const std::vector<MutBufferRef>& outs) {
   PyGILState_STATE gil = PyGILState_Ensure();
@@ -147,10 +149,10 @@ std::string CallDispatch(const std::string& kind, const std::string& name,
       PyList_SET_ITEM(out_dims, static_cast<Py_ssize_t>(i),
                       DimsTuple(outs[i].dims));
     }
-    args = Py_BuildValue("(sssLddsOOOO)", kind.c_str(), name.c_str(),
+    args = Py_BuildValue("(sssLddLsOOOO)", kind.c_str(), name.c_str(),
                          rop.c_str(), static_cast<long long>(root), pre,
-                         post, dtype.c_str(), in_views, in_dims, out_views,
-                         out_dims);
+                         post, static_cast<long long>(psid), dtype.c_str(),
+                         in_views, in_dims, out_views, out_dims);
     Py_DECREF(in_views);
     Py_DECREF(in_dims);
     Py_DECREF(out_views);
@@ -216,6 +218,7 @@ class HvdCollectiveCpuOp : public OpKernel {
     OP_REQUIRES_OK(c, c->GetAttr("prescale", &pre_));
     OP_REQUIRES_OK(c, c->GetAttr("postscale", &post_));
     OP_REQUIRES_OK(c, c->GetAttr("nproc", &nproc_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &psid_));
   }
 
   void Compute(OpKernelContext* c) override {
@@ -232,13 +235,13 @@ class HvdCollectiveCpuOp : public OpKernel {
         {const_cast<char*>(out->tensor_data().data()),
          ShapeDims(out->shape())}};
     const std::string err = CallDispatch(kind_, name_, rop_, root_, pre_,
-                                         post_, dtype, ins, outs);
+                                         post_, psid_, dtype, ins, outs);
     OP_REQUIRES(c, err.empty(), errors::Internal(err));
   }
 
  private:
   std::string kind_, name_, rop_;
-  int64_t root_, nproc_;
+  int64_t root_, nproc_, psid_;
   float pre_, post_;
 };
 
@@ -249,6 +252,7 @@ class HvdGroupedCpuOp : public OpKernel {
     OP_REQUIRES_OK(c, c->GetAttr("reduce_op", &rop_));
     OP_REQUIRES_OK(c, c->GetAttr("prescale", &pre_));
     OP_REQUIRES_OK(c, c->GetAttr("postscale", &post_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &psid_));
   }
 
   void Compute(OpKernelContext* c) override {
@@ -272,12 +276,14 @@ class HvdGroupedCpuOp : public OpKernel {
                       ShapeDims(out->shape())});
     }
     const std::string err = CallDispatch("grouped_allreduce", name_, rop_,
-                                         0, pre_, post_, dtype, ins, outs);
+                                         0, pre_, post_, psid_, dtype, ins,
+                                         outs);
     OP_REQUIRES(c, err.empty(), errors::Internal(err));
   }
 
  private:
   std::string name_, rop_;
+  int64_t psid_ = -1;
   float pre_, post_;
 };
 
@@ -304,7 +310,7 @@ std::vector<int64_t> FfiDims(ffi::AnyBuffer b) {
 
 ffi::Error HvdCollectiveFfi(std::string_view kind, std::string_view name,
                             std::string_view rop, int64_t root, float pre,
-                            float post, ffi::AnyBuffer x,
+                            float post, int64_t psid, ffi::AnyBuffer x,
                             ffi::Result<ffi::AnyBuffer> y) {
   const std::string dtype = FfiDtypeName(x);
   if (dtype == "unsupported") {
@@ -315,7 +321,7 @@ ffi::Error HvdCollectiveFfi(std::string_view kind, std::string_view name,
   std::vector<MutBufferRef> outs{{y->untyped_data(), FfiDims(*y)}};
   const std::string err =
       CallDispatch(std::string(kind), std::string(name), std::string(rop),
-                   root, pre, post, dtype, ins, outs);
+                   root, pre, post, psid, dtype, ins, outs);
   if (!err.empty()) return ffi::Error(ffi::ErrorCode::kInternal, err);
   return ffi::Error::Success();
 }
@@ -327,14 +333,15 @@ XLA_FFI_DEFINE_HANDLER(kHvdCollective, HvdCollectiveFfi,
                            .Attr<int64_t>("root")
                            .Attr<float>("pre")
                            .Attr<float>("post")
+                           .Attr<int64_t>("psid")
                            .Arg<ffi::AnyBuffer>()
                            .Ret<ffi::AnyBuffer>());
 XLA_FFI_REGISTER_HANDLER(ffi::GetXlaFfiApi(), "hvd_tpu_collective_ffi",
                          "Host", kHvdCollective);
 
 ffi::Error HvdGroupedFfi(std::string_view name, std::string_view rop,
-                         float pre, float post, ffi::RemainingArgs xs,
-                         ffi::RemainingRets ys) {
+                         float pre, float post, int64_t psid,
+                         ffi::RemainingArgs xs, ffi::RemainingRets ys) {
   if (xs.size() == 0 || xs.size() != ys.size()) {
     return ffi::Error(ffi::ErrorCode::kInvalidArgument,
                       "grouped allreduce arg/ret arity mismatch");
@@ -360,7 +367,7 @@ ffi::Error HvdGroupedFfi(std::string_view name, std::string_view rop,
   }
   const std::string err =
       CallDispatch("grouped_allreduce", std::string(name), std::string(rop),
-                   0, pre, post, dtype, ins, outs);
+                   0, pre, post, psid, dtype, ins, outs);
   if (!err.empty()) return ffi::Error(ffi::ErrorCode::kInternal, err);
   return ffi::Error::Success();
 }
@@ -370,6 +377,7 @@ XLA_FFI_DEFINE_HANDLER(kHvdGrouped, HvdGroupedFfi,
                            .Attr<std::string_view>("rop")
                            .Attr<float>("pre")
                            .Attr<float>("post")
+                           .Attr<int64_t>("psid")
                            .RemainingArgs()
                            .RemainingRets());
 XLA_FFI_REGISTER_HANDLER(ffi::GetXlaFfiApi(), "hvd_tpu_grouped_ffi",
@@ -399,6 +407,7 @@ class HvdCollectiveXlaOp : public XlaOpKernel {
     OP_REQUIRES_OK(c, c->GetAttr("prescale", &pre_));
     OP_REQUIRES_OK(c, c->GetAttr("postscale", &post_));
     OP_REQUIRES_OK(c, c->GetAttr("nproc", &nproc_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &psid_));
   }
 
   void Compile(XlaOpKernelContext* ctx) override {
@@ -418,7 +427,8 @@ class HvdCollectiveXlaOp : public XlaOpKernel {
     std::string cfg = "{kind = \"" + EscapeAttr(kind_) + "\", name = \"" +
                       EscapeAttr(name_) + "\", rop = \"" +
                       EscapeAttr(rop_) + "\", root = " +
-                      std::to_string(root_) + " : i64";
+                      std::to_string(root_) + " : i64, psid = " +
+                      std::to_string(psid_) + " : i64";
     snprintf(fbuf, sizeof(fbuf), ", pre = %.8e : f32", pre_);
     cfg += fbuf;
     snprintf(fbuf, sizeof(fbuf), ", post = %.8e : f32}", post_);
@@ -433,7 +443,7 @@ class HvdCollectiveXlaOp : public XlaOpKernel {
 
  private:
   std::string kind_, name_, rop_;
-  int64_t root_, nproc_;
+  int64_t root_, nproc_, psid_;
   float pre_, post_;
 };
 
@@ -444,6 +454,7 @@ class HvdGroupedXlaOp : public XlaOpKernel {
     OP_REQUIRES_OK(c, c->GetAttr("reduce_op", &rop_));
     OP_REQUIRES_OK(c, c->GetAttr("prescale", &pre_));
     OP_REQUIRES_OK(c, c->GetAttr("postscale", &post_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &psid_));
   }
 
   void Compile(XlaOpKernelContext* ctx) override {
@@ -459,7 +470,8 @@ class HvdGroupedXlaOp : public XlaOpKernel {
     xla::Shape out_shape = xla::ShapeUtil::MakeTupleShape(shapes);
     char fbuf[64];
     std::string cfg = "{name = \"" + EscapeAttr(name_) + "\", rop = \"" +
-                      EscapeAttr(rop_) + "\"";
+                      EscapeAttr(rop_) + "\", psid = " +
+                      std::to_string(psid_) + " : i64";
     snprintf(fbuf, sizeof(fbuf), ", pre = %.8e : f32", pre_);
     cfg += fbuf;
     snprintf(fbuf, sizeof(fbuf), ", post = %.8e : f32}", post_);
@@ -476,6 +488,7 @@ class HvdGroupedXlaOp : public XlaOpKernel {
 
  private:
   std::string name_, rop_;
+  int64_t psid_ = -1;
   float pre_, post_;
 };
 
@@ -496,6 +509,7 @@ REGISTER_OP("HorovodTpuCollective")
     .Attr("prescale: float = 1.0")
     .Attr("postscale: float = 1.0")
     .Attr("nproc: int = 1")
+    .Attr("process_set_id: int = -1")
     .SetIsStateful()
     .SetShapeFn([](shape_inference::InferenceContext* c) {
       std::string kind;
@@ -533,6 +547,7 @@ REGISTER_OP("HorovodTpuGroupedAllreduce")
     .Attr("reduce_op: string = 'average'")
     .Attr("prescale: float = 1.0")
     .Attr("postscale: float = 1.0")
+    .Attr("process_set_id: int = -1")
     .SetIsStateful()
     .SetShapeFn([](shape_inference::InferenceContext* c) {
       for (int i = 0; i < c->num_inputs(); ++i) {

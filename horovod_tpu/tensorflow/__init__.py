@@ -78,31 +78,47 @@ _XLA_FENCE = "requires_jit_compile_False_see_docs_adapters_md"
 # dtypes the custom-op bridge's kernels support
 _BRIDGE_DTYPES = (tf.float32, tf.float64, tf.float16, tf.int32, tf.int64,
                   tf.bfloat16)
-_bridge_consensus: Optional[bool] = None
+_bridge_consensus: dict = {}
+_bridge_consensus_state = None
 
 
-def _bridge_agreed() -> bool:
-    """Whether EVERY process has a working bridge.
+def _psid(process_set) -> int:
+    return -1 if process_set is None else int(process_set.process_set_id)
+
+
+def _bridge_agreed(process_set=None) -> bool:
+    """Whether EVERY member process has a working bridge.
 
     The bridge and py_function paths submit structurally different work
     (per-dtype grouped ops with suffixed names vs one group), so mixed
     availability across processes would deadlock the negotiation.  One
-    engine round at first use agrees the answer for the job; a process
-    whose build failed forces everyone onto py_function — loudly."""
-    global _bridge_consensus
-    if _bridge_consensus is None:
+    engine round over the call's process set at first use agrees the
+    answer (cached per set); a process whose build failed forces its
+    peers onto py_function — loudly."""
+    # keyed per init incarnation: shutdown/re-init recycles set ids,
+    # and a stale answer would skip (or desync) the agreement round
+    global _bridge_consensus_state
+    from .. import runtime as _runtime_mod
+    st = _runtime_mod._require_init()
+    if _bridge_consensus_state is not st:
+        _bridge_consensus.clear()
+        _bridge_consensus_state = st
+    key = _psid(process_set)
+    if key not in _bridge_consensus:
         from . import _xla_bridge
         local = _xla_bridge.available()
-        oks = _api.allgather_object(bool(local), name="tfxla.bridge.ok")
-        _bridge_consensus = all(oks)
-        if local and not _bridge_consensus:
+        oks = _api.allgather_object(bool(local),
+                                    name=f"tfxla.bridge.ok.{key}",
+                                    process_set=process_set)
+        _bridge_consensus[key] = all(oks)
+        if local and not _bridge_consensus[key]:
             import logging
             logging.getLogger("horovod_tpu").warning(
                 "TF XLA op bridge disabled for this job: %d/%d processes "
                 "failed to build/load it (their logs say why); every "
                 "process keeps the py_function path so submissions "
                 "match.", sum(1 for o in oks if not o), len(oks))
-    return _bridge_consensus
+    return _bridge_consensus[key]
 
 
 def _f32_exact(v: float) -> bool:
@@ -114,16 +130,23 @@ def _bridge(dtypes, process_set=None, scales=()):
     (reference: mpi_ops.cc registered ops + xla_mpi_ops.cc CustomCall —
     collectives that survive ``tf.function(jit_compile=True)``).
 
-    Falls back to the py_function path (returns None) for process-set
-    scoped calls (the bridge dispatches on the global set), dtypes
-    outside the kernel table, and single-process jobs (their stacked
+    Falls back to the py_function path (returns None) for dtypes
+    outside the kernel table and single-process jobs (their stacked
     per-worker semantics don't match the one-worker-per-process op
     contract — and single-process graphs already lower to pure TF ops);
-    HOROVOD_TF_XLA_OPS=0 disables outright.  Availability is agreed
-    across processes (one engine round, cached) so every process takes
-    the same path."""
+    HOROVOD_TF_XLA_OPS=0 disables outright.  Process-set scoped calls
+    carry the registered set id through the op attr.  Availability is
+    agreed across the set's processes (one engine round, cached per
+    set) so every member takes the same path."""
     if process_set is not None:
-        return None
+        if not process_set.initialized():
+            return None
+        from ..ops.collectives import spans_processes
+        if not spans_processes(process_set):
+            # a set confined to one process keeps the engine's stacked
+            # per-worker semantics, which the one-worker-per-process op
+            # contract cannot represent
+            return None
     try:
         if cross_size() <= 1:
             return None
@@ -138,7 +161,7 @@ def _bridge(dtypes, process_set=None, scales=()):
     for v in scales:
         if not _f32_exact(v):
             return None
-    if not _bridge_agreed():
+    if not _bridge_agreed(process_set):
         return None
     from . import _xla_bridge
     return _xla_bridge
@@ -210,7 +233,8 @@ def allreduce(tensor, average=None, name=None, op=None,
         return br.ops().horovod_tpu_collective(
             tensor, kind="allreduce", tensor_name=br.sanitize_name(nm),
             reduce_op=rop, prescale=prescale_factor,
-            postscale=postscale_factor, nproc=_n_workers(process_set))
+            postscale=postscale_factor, nproc=_n_workers(process_set),
+            process_set_id=_psid(process_set))
 
     def _np_op(x):
         return _eager_allreduce_np(x.numpy(), nm, rop, prescale_factor,
@@ -269,7 +293,8 @@ def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
                 [tensors[i] for i in idxs],
                 tensor_name=br.sanitize_name(f"{nm}.{dt_name}"),
                 reduce_op=rop, prescale=prescale_factor,
-                postscale=postscale_factor)
+                postscale=postscale_factor,
+                process_set_id=_psid(process_set))
             for i, o in zip(idxs, outs):
                 out[i] = o
         return out
@@ -348,7 +373,8 @@ def allgather(tensor, name=None, process_set=None):
             return br.ops().horovod_tpu_collective(
                 tensor, kind="allgather",
                 tensor_name=br.sanitize_name(nm),
-                nproc=_n_workers(process_set))
+                nproc=_n_workers(process_set),
+                process_set_id=_psid(process_set))
 
     def _np_op(x):
         return np.asarray(_api.allgather(x.numpy(), name=nm,
@@ -414,7 +440,8 @@ def reducescatter(tensor, op=None, name=None, process_set=None):
         if br is not None:
             return br.ops().horovod_tpu_collective(
                 tensor, kind="reducescatter",
-                tensor_name=br.sanitize_name(nm), reduce_op=rop, nproc=n)
+                tensor_name=br.sanitize_name(nm), reduce_op=rop, nproc=n,
+                process_set_id=_psid(process_set))
 
     def _np_op(x):
         ps = _api._ps(process_set)
@@ -469,7 +496,8 @@ def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):
     if br is not None:
         return br.ops().horovod_tpu_collective(
             tensor, kind="broadcast", tensor_name=br.sanitize_name(nm),
-            root_rank=root_rank, nproc=_n_workers(process_set))
+            root_rank=root_rank, nproc=_n_workers(process_set),
+            process_set_id=_psid(process_set))
 
     def _np_op(x):
         return np.asarray(_api.broadcast(x.numpy(), root_rank, name=nm,
@@ -516,7 +544,8 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
         if br is not None:
             return br.ops().horovod_tpu_collective(
                 tensor, kind="alltoall", tensor_name=br.sanitize_name(nm),
-                nproc=_n_workers(process_set))
+                nproc=_n_workers(process_set),
+                process_set_id=_psid(process_set))
 
     def _np_op(x):
         res = _api.alltoall(x.numpy(), splits=splits, name=nm,

@@ -130,16 +130,18 @@ def _np_dtype(dtype: str):
 
 
 def _dispatch(kind: str, name: str, rop: str, root: int, pre: float,
-              post: float, dtype: str, in_views, in_dims, out_views,
-              out_dims) -> None:
+              post: float, psid: int, dtype: str, in_views, in_dims,
+              out_views, out_dims) -> None:
     """Kernel-side trampoline: zero-copy memoryviews in/out.
 
     Runs on a TF executor (or XLA runtime) thread under the GIL; the
     engine's synchronize() waits on an Event, which releases the GIL so
-    the background engine thread keeps negotiating.
+    the background engine thread keeps negotiating.  ``psid`` selects a
+    registered process set (-1 = global).
     """
-    from .. import api
+    from .. import api, runtime
 
+    ps = None if psid < 0 else runtime.get_process_set_by_id(int(psid))
     dt = _np_dtype(dtype)
     arrs = [np.frombuffer(v, dtype=dt).reshape(d).copy()
             for v, d in zip(in_views, in_dims)]
@@ -147,14 +149,15 @@ def _dispatch(kind: str, name: str, rop: str, root: int, pre: float,
     if kind == "grouped_allreduce":
         res = api.grouped_allreduce(arrs, op=rop, name=name or None,
                                     prescale_factor=pre,
-                                    postscale_factor=post)
+                                    postscale_factor=post, process_set=ps)
     else:
         x = arrs[0]
         if kind == "allreduce":
             res = api.allreduce(x, op=rop, name=name or None,
-                                prescale_factor=pre, postscale_factor=post)
+                                prescale_factor=pre, postscale_factor=post,
+                                process_set=ps)
         elif kind == "allgather":
-            res = api.allgather(x, name=name or None)
+            res = api.allgather(x, name=name or None, process_set=ps)
             got = np.asarray(res).shape
             if got != tuple(out_dims[0]):
                 raise ValueError(
@@ -163,16 +166,17 @@ def _dispatch(kind: str, name: str, rop: str, root: int, pre: float,
                     "inputs need the py_function path - set "
                     "HOROVOD_TF_XLA_OPS=0 for this job")
         elif kind == "broadcast":
-            res = api.broadcast(x, int(root), name=name or None)
+            res = api.broadcast(x, int(root), name=name or None,
+                                process_set=ps)
         elif kind == "alltoall":
-            res = api.alltoall(x, name=name or None)
+            res = api.alltoall(x, name=name or None, process_set=ps)
             if isinstance(res, list):
-                from .. import runtime
                 res = res[runtime.rank()]
         elif kind == "reducescatter":
             res = api.rs_own_slice_np(
-                api.reducescatter(x, op=rop, name=name or None),
-                x.ndim, api._ps(None))
+                api.reducescatter(x, op=rop, name=name or None,
+                                  process_set=ps),
+                x.ndim, api._ps(ps))
         else:
             raise ValueError(f"unknown collective kind {kind!r}")
         res = [res]

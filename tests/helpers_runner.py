@@ -537,6 +537,10 @@ def tf_jit_collectives_fn():
         hvd.shutdown()
         return {"rank": r, "skipped": True}
 
+    # proper subset SPANNING processes 0 and 1 (the bridge only serves
+    # sets that cross a process boundary) — hence np=3 in the test
+    ps = hvd.add_process_set([0, 1])
+
     @tf.function(jit_compile=True)
     def step(x):
         s = hvd.allreduce(x, op=hvd.Sum, name="jit2p.sum")
@@ -548,8 +552,19 @@ def tf_jit_collectives_fn():
 
     x = tf.constant([float(r + 1), 2.0 * (r + 1)])
     s, g, g0, g1, b = step(x)
+    if r in (0, 1):
+        # process-set-scoped collective through the bridge attr path
+        # (members only — per-set negotiation never waits on rank 2)
+        @tf.function(jit_compile=True)
+        def ps_step(t):
+            return hvd.allreduce(t, op=hvd.Sum, name="jit2p.ps",
+                                 process_set=ps)
+        p = ps_step(x)
+    else:
+        p = tf.constant([0.0, 0.0])  # non-member: no ps collective
     out = {"rank": r, "sum": s.numpy().tolist(),
            "gathered": g.numpy().tolist(), "grp0": g0.numpy().tolist(),
-           "grp1": g1.numpy().tolist(), "bcast": b.numpy().tolist()}
+           "grp1": g1.numpy().tolist(), "bcast": b.numpy().tolist(),
+           "ps_sum": p.numpy().tolist()}
     hvd.shutdown()
     return out

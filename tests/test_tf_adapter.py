@@ -466,15 +466,19 @@ def test_tf_jit_compile_two_process():
         "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
         "HOROVOD_CYCLE_TIME": "0.2",
     }
-    results = run(helpers_runner.tf_jit_collectives_fn, np=2, env=env,
+    results = run(helpers_runner.tf_jit_collectives_fn, np=3, env=env,
                   port=29547)
     assert not any(r.get("skipped") for r in results), \
         "bridge must build on this image"
     by_rank = {r["rank"]: r for r in results}
-    for r in (0, 1):
-        np.testing.assert_allclose(by_rank[r]["sum"], [3.0, 6.0])
+    for r in (0, 1, 2):
+        np.testing.assert_allclose(by_rank[r]["sum"], [6.0, 12.0])
         np.testing.assert_allclose(by_rank[r]["gathered"],
-                                   [[1.0, 2.0], [2.0, 4.0]])
-        np.testing.assert_allclose(by_rank[r]["grp0"], [3.0, 6.0])
-        np.testing.assert_allclose(by_rank[r]["grp1"], [6.0, 12.0])
+                                   [[1.0, 2.0], [2.0, 4.0], [3.0, 6.0]])
+        np.testing.assert_allclose(by_rank[r]["grp0"], [6.0, 12.0])
+        np.testing.assert_allclose(by_rank[r]["grp1"], [12.0, 24.0])
         np.testing.assert_allclose(by_rank[r]["bcast"], [1.0, 2.0])
+    # process-set-scoped collective through the bridge attr path: the
+    # spanning subset {0, 1} sums only its members' tensors
+    np.testing.assert_allclose(by_rank[0]["ps_sum"], [3.0, 6.0])
+    np.testing.assert_allclose(by_rank[1]["ps_sum"], [3.0, 6.0])
