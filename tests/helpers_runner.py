@@ -568,3 +568,41 @@ def tf_jit_collectives_fn():
            "ps_sum": p.numpy().tolist()}
     hvd.shutdown()
     return out
+
+
+def tf_jit_training_fn():
+    """2-process DP training with the WHOLE train step (tape, grouped
+    gradient allreduce, update) inside tf.function(jit_compile=True) —
+    the workload upstream's xla_mpi_ops.cc existed for."""
+    import numpy as np
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+    from horovod_tpu.tensorflow import _xla_bridge
+
+    hvd.init()
+    r = hvd.cross_rank()
+    if not _xla_bridge.available():
+        hvd.shutdown()
+        return {"rank": r, "skipped": True}
+
+    X = np.random.RandomState(3).randn(8, 2).astype("f4")
+    y = (X @ np.array([[1.0], [-0.5]], dtype="f4")).astype("f4")
+    Xs = tf.constant(X[r * 4:(r + 1) * 4])
+    ys = tf.constant(y[r * 4:(r + 1) * 4])
+    w = tf.Variable([[0.2], [0.1]])
+    hvd.broadcast_variables([w], root_rank=0)
+
+    @tf.function(jit_compile=True)
+    def train_step():
+        tape = hvd.DistributedGradientTape(tf.GradientTape())
+        with tape:
+            loss = tf.reduce_mean((tf.matmul(Xs, w) - ys) ** 2)
+        g = tape.gradient(loss, [w])
+        w.assign_sub(0.5 * g[0])
+        return loss
+
+    for _ in range(3):
+        train_step()
+    out = {"rank": r, "w": w.numpy().tolist()}
+    hvd.shutdown()
+    return out

@@ -482,3 +482,31 @@ def test_tf_jit_compile_two_process():
     # spanning subset {0, 1} sums only its members' tensors
     np.testing.assert_allclose(by_rank[0]["ps_sum"], [3.0, 6.0])
     np.testing.assert_allclose(by_rank[1]["ps_sum"], [3.0, 6.0])
+
+
+def test_tf_jit_compile_two_process_training_matches_single():
+    """End-to-end DP training with the full step under jit_compile=True
+    across 2 real processes equals the single-process full-batch run
+    (the same equivalence bar as the non-jit tape test)."""
+    env = {
+        "HOROVOD_TPU_FORCE_PLATFORM": "cpu",
+        "PYTHONPATH": REPO + ":" + os.path.join(REPO, "tests"),
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "HOROVOD_CYCLE_TIME": "0.2",
+    }
+    results = run(helpers_runner.tf_jit_training_fn, np=2, env=env,
+                  port=29549)
+    assert not any(r.get("skipped") for r in results)
+    by_rank = {r["rank"]: r for r in results}
+    np.testing.assert_allclose(by_rank[0]["w"], by_rank[1]["w"], atol=1e-6)
+    X = np.random.RandomState(3).randn(8, 2).astype("f4")
+    y = (X @ np.array([[1.0], [-0.5]], dtype="f4")).astype("f4")
+    w = tf.Variable([[0.2], [0.1]])
+    for _ in range(3):
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean(
+                (tf.matmul(tf.constant(X), w) - tf.constant(y)) ** 2)
+        g = tape.gradient(loss, [w])
+        w.assign_sub(0.5 * g[0])
+    np.testing.assert_allclose(by_rank[0]["w"], w.numpy().tolist(),
+                               atol=1e-5)
