@@ -19,10 +19,8 @@ _POLL_S = 0.05
 
 
 def main(control_dir: str) -> int:
-    plat = os.environ.get("HOROVOD_TPU_FORCE_PLATFORM")
-    if plat:
-        import jax
-        jax.config.update("jax_platforms", plat)
+    from horovod_tpu.runtime import apply_force_platform
+    apply_force_platform()
     import horovod_tpu as hvd
     hvd.init()
     rank = int(os.environ.get("HOROVOD_RANK", hvd.rank()))

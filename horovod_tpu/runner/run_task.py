@@ -19,10 +19,8 @@ def main(payload_path: str, results_dir: str) -> int:
     # plugin programmatically, so JAX_PLATFORMS in the env is not enough to
     # run CPU-mesh workers (tests, dry runs).  HOROVOD_TPU_FORCE_PLATFORM
     # wins over it because jax.config.update runs after sitecustomize.
-    plat = os.environ.get("HOROVOD_TPU_FORCE_PLATFORM")
-    if plat:
-        import jax
-        jax.config.update("jax_platforms", plat)
+    from horovod_tpu.runtime import apply_force_platform
+    apply_force_platform()
     with open(payload_path, "rb") as f:
         fn, args, kwargs = pickle.load(f)
     import horovod_tpu as hvd

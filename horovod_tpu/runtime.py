@@ -255,6 +255,19 @@ def _require_init() -> _RuntimeState:
     return _STATE
 
 
+def apply_force_platform() -> None:
+    """Apply ``HOROVOD_TPU_FORCE_PLATFORM`` to the JAX config (CPU-forced
+    tests/CI/dev runs).  The TPU sitecustomize overrides JAX_PLATFORMS
+    programmatically, so the env var alone is not enough; must run
+    before the first backend touch (no-op once a backend exists)."""
+    plat = os.environ.get("HOROVOD_TPU_FORCE_PLATFORM")
+    if plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # noqa: BLE001 - backend already initialized
+            pass
+
+
 def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
     """Initialize the runtime (reference: horovod_init → InitializeHorovodOnce).
 
@@ -272,6 +285,7 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
     ``process_sets`` are additional process sets to create at init, as in the
     reference's ``hvd.init(process_sets=...)``.
     """
+    apply_force_platform()
     with _STATE._init_lock:
         if _STATE.initialized:
             return

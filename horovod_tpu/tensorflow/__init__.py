@@ -24,6 +24,7 @@ NOT XLA-compilable — the py_function op names carry
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -712,3 +713,20 @@ def DistributedOptimizer(optimizer, name=None,
     _Dist.__name__ = base.__name__
     optimizer.__class__ = _Dist
     return optimizer
+
+
+# Load the custom-op bridge BEFORE the first TF op executes: TF
+# materializes its XLA compilation-kernel registry once, and op
+# libraries loaded after that point lose their XlaOpKernel
+# registrations (jit_compile would then fail with "no registered
+# kernel ... compatible"; the reference loaded mpi_lib at import for
+# the same reason).  Only multi-process launches need the bridge, so
+# single-process imports skip the one-time build; availability stays
+# consensus-agreed at first use either way.
+# (HOROVOD_NUM_PROCESSES counts hvdrun-launched worker processes;
+# HOROVOD_SIZE is the reference's §3.4 contract any launcher exports.
+# The env contract's CROSS_SIZE counts hosts — 1 for local jobs.)
+if os.environ.get("HOROVOD_NUM_PROCESSES", "1") not in ("", "1") or \
+        os.environ.get("HOROVOD_SIZE", "1") not in ("", "1"):
+    from . import _xla_bridge as _xla_bridge_eager_load
+    _xla_bridge_eager_load.available()

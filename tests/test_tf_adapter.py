@@ -495,7 +495,7 @@ def test_tf_jit_compile_two_process_training_matches_single():
         "HOROVOD_CYCLE_TIME": "0.2",
     }
     results = run(helpers_runner.tf_jit_training_fn, np=2, env=env,
-                  port=29549)
+                  port=29573)
     assert not any(r.get("skipped") for r in results)
     by_rank = {r["rank"]: r for r in results}
     np.testing.assert_allclose(by_rank[0]["w"], by_rank[1]["w"], atol=1e-6)
