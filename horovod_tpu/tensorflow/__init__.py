@@ -201,14 +201,44 @@ def _replicated_reduce(x, op, n: int):
     return x
 
 
+def _allreduce_sparse(sl, name, rop, process_set):
+    """Sparse allreduce: allgather values + indices (reference:
+    hvd.tensorflow's IndexedSlices handling — duplicate indices sum
+    implicitly when the slices are applied).  Each worker's nonzero
+    count may differ; the ragged gathers ride the engine's Allgatherv,
+    as ONE atomic group (a single negotiated round per gradient)."""
+    if rop not in (Sum, Average):
+        raise ValueError(
+            f"sparse allreduce supports Sum and Average, got {rop}")
+    vals, idx = grouped_allgather(
+        [tf.convert_to_tensor(sl.values), tf.convert_to_tensor(sl.indices)],
+        name=name, process_set=process_set)
+    if rop == Average:
+        vals = vals / tf.cast(_n_workers(process_set), vals.dtype)
+    return tf.IndexedSlices(vals, idx, sl.dense_shape)
+
+
 def allreduce(tensor, average=None, name=None, op=None,
               compression=Compression.none, prescale_factor=1.0,
               postscale_factor=1.0, process_set=None):
-    """Allreduce a tf.Tensor (works eagerly and inside ``tf.function``)."""
+    """Allreduce a tf.Tensor or tf.IndexedSlices (works eagerly and
+    inside ``tf.function``; sparse slices reduce via ragged allgather)."""
     if average is not None and op is not None:
         raise ValueError("The average and op arguments cannot both be set")
     rop = op if op is not None else (
         Average if (average is None or average) else Sum)
+    if isinstance(tensor, tf.IndexedSlices):
+        # reference parity: scale factors / compression are rejected for
+        # sparse inputs, never silently dropped
+        if prescale_factor != 1.0 or postscale_factor != 1.0:
+            raise ValueError(
+                "prescale/postscale factors are not supported for "
+                "tf.IndexedSlices")
+        if compression is not Compression.none:
+            raise ValueError(
+                "compression is not supported for tf.IndexedSlices")
+        return _allreduce_sparse(tensor, name or "tfsparse", rop,
+                                 process_set)
     nm = name or f"tfallreduce.{tensor.shape.rank}d"
     wire_dtype = tensor.dtype
     if compression is not Compression.none and tensor.dtype in (
@@ -366,9 +396,12 @@ def allgather(tensor, name=None, process_set=None):
         return tf.concat([tensor] * _n_workers(process_set), axis=0)
 
     # uniform shapes only via the bridge: the XLA lowering needs the
-    # n*dim0 output shape statically; ragged (Allgatherv) inputs fail
-    # actionably in the dispatch and need the py_function path
-    if tensor.shape.rank and tensor.shape[0] is not None:
+    # n*dim0 output shape statically, and no single process can verify
+    # its peers' row counts at trace time.  Eager calls therefore skip
+    # the bridge entirely (the engine path handles ragged Allgatherv);
+    # graph-mode ragged inputs fail actionably in the dispatch.
+    if not tf.executing_eagerly() and tensor.shape.rank \
+            and tensor.shape[0] is not None:
         br = _bridge([tensor.dtype], process_set)
         if br is not None:
             return br.ops().horovod_tpu_collective(
@@ -617,11 +650,13 @@ class DistributedGradientTape:
                  compression=Compression.none, op=Average,
                  gradient_predivide_factor: float = 1.0,
                  backward_passes_per_step: int = 1,
-                 persistent: bool = False, process_set=None):
+                 persistent: bool = False, sparse_as_dense: bool = False,
+                 process_set=None):
         self._wrapped = tape if tape is not None else tf.GradientTape(
             persistent=persistent)
         self._compression = compression
         self._op = op
+        self._sparse_as_dense = bool(sparse_as_dense)
         if gradient_predivide_factor != 1.0 and op != Average:
             raise ValueError(
                 "gradient_predivide_factor requires op == Average")
@@ -647,6 +682,12 @@ class DistributedGradientTape:
         grads = self._wrapped.gradient(target, sources, output_gradients)
         self._pass += 1
         if self._bpps > 1:
+            if not self._sparse_as_dense and any(
+                    isinstance(g, tf.IndexedSlices) for g in grads):
+                raise ValueError(
+                    "backward_passes_per_step > 1 accumulates gradients "
+                    "densely; pass sparse_as_dense=True to accept the "
+                    "dense materialization of sparse gradients")
             if self._acc is None:
                 self._acc = [tf.zeros_like(g) if g is not None else None
                              for g in grads]
@@ -661,11 +702,18 @@ class DistributedGradientTape:
         # engine round-trip per gradient (the TF frontend's former
         # per-op latency tax)
         dense_idx, dense = [], []
+        out: List = [None] * len(grads)
         for i, g in enumerate(grads):
             if g is None:
                 continue
             if isinstance(g, tf.IndexedSlices):
-                g = tf.convert_to_tensor(g)  # sparse-as-dense (reference)
+                if self._sparse_as_dense:
+                    g = tf.convert_to_tensor(g)  # densify (reference knob)
+                else:
+                    # ragged allgather-based sparse reduction
+                    out[i] = _allreduce_sparse(
+                        g, f"tape.sparse.{i}", self._op, self._process_set)
+                    continue
             dense_idx.append(i)
             dense.append(g)
         reduced = grouped_allreduce(
@@ -674,7 +722,6 @@ class DistributedGradientTape:
             prescale_factor=self._prescale,
             postscale_factor=self._postscale,
             process_set=self._process_set) if dense else []
-        out: List = [None] * len(grads)
         for i, r in zip(dense_idx, reduced):
             out[i] = r
         return out
@@ -683,6 +730,7 @@ class DistributedGradientTape:
 def DistributedOptimizer(optimizer, name=None,
                          compression=Compression.none, op=Average,
                          backward_passes_per_step: int = 1,
+                         sparse_as_dense: bool = False,
                          process_set=None):
     """Wrap a ``keras.optimizers.Optimizer``: gradients are allreduced
     before being applied (reference: hvd.DistributedOptimizer for TF2 —
@@ -699,7 +747,13 @@ def DistributedOptimizer(optimizer, name=None,
                 if g is None:
                     continue
                 if isinstance(g, tf.IndexedSlices):
-                    g = tf.convert_to_tensor(g)
+                    if sparse_as_dense:
+                        g = tf.convert_to_tensor(g)
+                    else:
+                        gv[i] = (_allreduce_sparse(
+                            g, f"opt.sparse.{i}", op, process_set),
+                            gv[i][1])
+                        continue
                 dense_idx.append(i)
                 dense.append(g)
             outs = grouped_allreduce(

@@ -606,3 +606,27 @@ def tf_jit_training_fn():
     out = {"rank": r, "w": w.numpy().tolist()}
     hvd.shutdown()
     return out
+
+
+def tf_sparse_allreduce_fn():
+    """2-process sparse allreduce with DIFFERENT nonzero counts per
+    rank: the values/indices gathers ride Allgatherv (ragged dim 0)."""
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+    r = hvd.cross_rank()
+    if r == 0:
+        sl = tf.IndexedSlices(values=tf.constant([[1.0], [2.0]]),
+                              indices=tf.constant([0, 1], dtype=tf.int64),
+                              dense_shape=tf.constant([4, 1], tf.int64))
+    else:
+        sl = tf.IndexedSlices(values=tf.constant([[10.0]]),
+                              indices=tf.constant([1], dtype=tf.int64),
+                              dense_shape=tf.constant([4, 1], tf.int64))
+    out = hvd.allreduce(sl, op=hvd.Sum, name="sp2p")
+    dense = tf.scatter_nd(tf.reshape(out.indices, (-1, 1)), out.values,
+                          (4, 1))
+    res = {"rank": r, "dense": dense.numpy().ravel().tolist()}
+    hvd.shutdown()
+    return res
