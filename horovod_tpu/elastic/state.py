@@ -77,6 +77,43 @@ class State:
         pass
 
 
+class FrameworkState(State):
+    """Shared machinery for the per-framework model states (TorchState,
+    TensorFlowKerasState): a model + optimizer pair plus named scalars
+    readable/writable as attributes.  Subclasses implement
+    save/restore/sync over their framework's weight containers."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        self._model = model
+        self._optimizer = optimizer
+        self._scalars: Dict[str, Any] = dict(kwargs)
+        self._saved: Dict[str, Any] = {}
+        super().__init__()
+        self.save()
+
+    def __getattr__(self, name):
+        scalars = object.__getattribute__(self, "_scalars")
+        if name in scalars:
+            return scalars[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        elif "_scalars" in self.__dict__ and name in self._scalars:
+            self._scalars[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    @property
+    def model(self):
+        return self._model
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+
 class ObjectState(State):
     """Elastic state for picklable Python attributes.
 

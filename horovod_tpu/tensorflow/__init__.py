@@ -201,21 +201,34 @@ def _replicated_reduce(x, op, n: int):
     return x
 
 
-def _allreduce_sparse(sl, name, rop, process_set):
-    """Sparse allreduce: allgather values + indices (reference:
-    hvd.tensorflow's IndexedSlices handling — duplicate indices sum
-    implicitly when the slices are applied).  Each worker's nonzero
-    count may differ; the ragged gathers ride the engine's Allgatherv,
-    as ONE atomic group (a single negotiated round per gradient)."""
+def _allreduce_sparse_many(slices, name, rop, process_set):
+    """Sparse allreduce for a LIST of tf.IndexedSlices: every tensor's
+    values+indices gather in ONE atomic group (a single negotiated
+    round for all sparse gradients — the same one-round design as the
+    dense grouped path).  Duplicate indices sum implicitly when the
+    slices are applied (reference: hvd.tensorflow's IndexedSlices
+    handling); each worker's nonzero count may differ — the ragged
+    gathers ride the engine's Allgatherv."""
     if rop not in (Sum, Average):
         raise ValueError(
             f"sparse allreduce supports Sum and Average, got {rop}")
-    vals, idx = grouped_allgather(
-        [tf.convert_to_tensor(sl.values), tf.convert_to_tensor(sl.indices)],
-        name=name, process_set=process_set)
-    if rop == Average:
-        vals = vals / tf.cast(_n_workers(process_set), vals.dtype)
-    return tf.IndexedSlices(vals, idx, sl.dense_shape)
+    flat = []
+    for sl in slices:
+        flat.append(tf.convert_to_tensor(sl.values))
+        flat.append(tf.convert_to_tensor(sl.indices))
+    gathered = grouped_allgather(flat, name=name, process_set=process_set)
+    n = _n_workers(process_set)
+    out = []
+    for k, sl in enumerate(slices):
+        vals, idx = gathered[2 * k], gathered[2 * k + 1]
+        if rop == Average:
+            vals = vals / tf.cast(n, vals.dtype)
+        out.append(tf.IndexedSlices(vals, idx, sl.dense_shape))
+    return out
+
+
+def _allreduce_sparse(sl, name, rop, process_set):
+    return _allreduce_sparse_many([sl], name, rop, process_set)[0]
 
 
 def allreduce(tensor, average=None, name=None, op=None,
@@ -702,6 +715,7 @@ class DistributedGradientTape:
         # engine round-trip per gradient (the TF frontend's former
         # per-op latency tax)
         dense_idx, dense = [], []
+        sparse_idx, sparse_sl = [], []
         out: List = [None] * len(grads)
         for i, g in enumerate(grads):
             if g is None:
@@ -710,12 +724,21 @@ class DistributedGradientTape:
                 if self._sparse_as_dense:
                     g = tf.convert_to_tensor(g)  # densify (reference knob)
                 else:
-                    # ragged allgather-based sparse reduction
-                    out[i] = _allreduce_sparse(
-                        g, f"tape.sparse.{i}", self._op, self._process_set)
+                    if self._compression is not Compression.none:
+                        raise ValueError(
+                            "compression is not supported for sparse "
+                            "gradients; pass sparse_as_dense=True to "
+                            "densify them")
+                    sparse_idx.append(i)
+                    sparse_sl.append(g)
                     continue
             dense_idx.append(i)
             dense.append(g)
+        if sparse_sl:  # ONE ragged-gather round for all sparse grads
+            for i, r in zip(sparse_idx, _allreduce_sparse_many(
+                    sparse_sl, "tape.sparse", self._op,
+                    self._process_set)):
+                out[i] = r
         reduced = grouped_allreduce(
             dense, op=self._op, name="tape.grads",
             compression=self._compression,
@@ -740,22 +763,33 @@ def DistributedOptimizer(optimizer, name=None,
     class _Dist(base):  # noqa: D401 - dynamic wrapper
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             gv = list(grads_and_vars)
+            if backward_passes_per_step > 1:
+                return self._hvd_accumulate_apply(gv, args, kwargs)
             # one grouped round for all dense gradients (see
             # DistributedGradientTape.gradient)
             dense_idx, dense = [], []
+            sparse_idx, sparse_sl = [], []
             for i, (g, _v) in enumerate(gv):
                 if g is None:
                     continue
                 if isinstance(g, tf.IndexedSlices):
                     if sparse_as_dense:
                         g = tf.convert_to_tensor(g)
+                    elif compression is not Compression.none:
+                        raise ValueError(
+                            "compression is not supported for sparse "
+                            "gradients; pass sparse_as_dense=True to "
+                            "densify them")
                     else:
-                        gv[i] = (_allreduce_sparse(
-                            g, f"opt.sparse.{i}", op, process_set),
-                            gv[i][1])
+                        sparse_idx.append(i)
+                        sparse_sl.append(g)
                         continue
                 dense_idx.append(i)
                 dense.append(g)
+            if sparse_sl:  # one ragged-gather round for all sparse
+                for i, r in zip(sparse_idx, _allreduce_sparse_many(
+                        sparse_sl, "opt.sparse", op, process_set)):
+                    gv[i] = (r, gv[i][1])
             outs = grouped_allreduce(
                 dense, op=op, name="opt.grads", compression=compression,
                 process_set=process_set) if dense else []
@@ -764,9 +798,65 @@ def DistributedOptimizer(optimizer, name=None,
                 reduced[i] = (r, reduced[i][1])
             return base.apply_gradients(self, reduced, *args, **kwargs)
 
+        def _hvd_accumulate_apply(self, gv, args, kwargs):
+            """Local gradient accumulation: reduce + apply every N-th
+            call (reference: backward_passes_per_step via the TF
+            LocalGradientAggregationHelper — variable-backed counter and
+            accumulators so keras's tf.function-compiled train steps
+            count correctly)."""
+            if any(isinstance(g, tf.IndexedSlices)
+                   for g, _v in gv if g is not None)                     and not sparse_as_dense:
+                raise ValueError(
+                    "backward_passes_per_step > 1 accumulates gradients "
+                    "densely; pass sparse_as_dense=True to accept the "
+                    "dense materialization of sparse gradients")
+            gv = [(tf.convert_to_tensor(g)
+                   if isinstance(g, tf.IndexedSlices) else g, v)
+                  for g, v in gv]
+            if not hasattr(self, "_hvd_bpps_counter"):
+                self._hvd_bpps_counter = tf.Variable(
+                    0, trainable=False, dtype=tf.int64,
+                    name="hvd_bpps_counter")
+                self._hvd_bpps_acc = {}
+            idxs = [i for i, (g, _v) in enumerate(gv) if g is not None]
+            for i in idxs:
+                # keyed by VARIABLE, not position: one optimizer may
+                # serve several apply_gradients call shapes (GAN nets,
+                # freeze schedules) — upstream's aggregation helper
+                # keys by variable for the same reason
+                key = gv[i][1].ref()
+                if key not in self._hvd_bpps_acc:
+                    self._hvd_bpps_acc[key] = tf.Variable(
+                        tf.zeros_like(gv[i][0]), trainable=False,
+                        name=f"hvd_bpps_acc_{len(self._hvd_bpps_acc)}")
+                self._hvd_bpps_acc[key].assign_add(gv[i][0])
+            self._hvd_bpps_counter.assign_add(1)
+
+            def _apply():
+                accs = [self._hvd_bpps_acc[gv[i][1].ref()] for i in idxs]
+                outs = grouped_allreduce(
+                    [a.value() for a in accs],
+                    op=op, name="opt.acc.grads", compression=compression,
+                    process_set=process_set) if idxs else []
+                reduced = [(o, gv[i][1]) for o, i in zip(outs, idxs)]
+                base.apply_gradients(self, reduced, *args, **kwargs)
+                for a in accs:
+                    a.assign(tf.zeros_like(a))
+                return tf.constant(True)
+
+            return tf.cond(
+                tf.equal(self._hvd_bpps_counter % backward_passes_per_step,
+                         0),
+                _apply, lambda: tf.constant(False))
+
     _Dist.__name__ = base.__name__
     optimizer.__class__ = _Dist
     return optimizer
+
+
+from . import elastic  # noqa: E402,F401 - hvd.elastic namespace
+
+__all__ += ["elastic"]
 
 
 # Load the custom-op bridge BEFORE the first TF op executes: TF

@@ -17,47 +17,19 @@ JAX pytrees.  Use with ``@hvd.elastic.run`` exactly as upstream:
 from __future__ import annotations
 
 import copy
-from typing import Any, Dict
 
 import torch
 
-from ..elastic.state import State
+from ..elastic.state import FrameworkState
 
 
-class TorchState(State):
-    """Elastic snapshot/sync for torch modules + optimizers + scalars."""
+class TorchState(FrameworkState):
+    """Elastic snapshot/sync for torch modules + optimizers + scalars
+    (scalar/attribute machinery shared via FrameworkState)."""
 
     def __init__(self, model: torch.nn.Module = None, optimizer=None,
                  **kwargs):
-        self._model = model
-        self._optimizer = optimizer
-        self._scalars: Dict[str, Any] = dict(kwargs)
-        self._saved: Dict[str, Any] = {}
-        super().__init__()
-        self.save()
-
-    # attribute surface: model/optimizer/scalars read naturally ----------
-    def __getattr__(self, name):
-        scalars = object.__getattribute__(self, "_scalars")
-        if name in scalars:
-            return scalars[name]
-        raise AttributeError(name)
-
-    def __setattr__(self, name, value):
-        if name.startswith("_"):
-            object.__setattr__(self, name, value)
-        elif "_scalars" in self.__dict__ and name in self._scalars:
-            self._scalars[name] = value
-        else:
-            object.__setattr__(self, name, value)
-
-    @property
-    def model(self):
-        return self._model
-
-    @property
-    def optimizer(self):
-        return self._optimizer
+        super().__init__(model=model, optimizer=optimizer, **kwargs)
 
     # State interface ----------------------------------------------------
     def save(self):
@@ -89,10 +61,6 @@ class TorchState(State):
             broadcast_optimizer_state(self._optimizer, root_rank=0)
         self._scalars = broadcast_object(self._scalars, root_rank=0)
         self.save()
-
-    # torch state lives on host; nothing to evacuate before re-init
-    def evacuate(self):
-        pass
 
 
 # the torch elastic namespace mirrors upstream hvd.elastic: the run

@@ -574,3 +574,67 @@ def test_tf_sparse_allreduce_two_process_ragged():
     for r in results:
         # rank0 contributes rows {0:1, 1:2}, rank1 {1:10} -> summed
         np.testing.assert_allclose(r["dense"], [1.0, 12.0, 0.0, 0.0])
+
+
+def test_tf_keras_elastic_state(tfhvd):
+    """TensorFlowKerasState (reference: horovod/tensorflow/elastic.py):
+    commit/restore round-trips model+optimizer weights and scalars;
+    sync broadcasts and re-saves."""
+    from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+    model = _tiny_keras_model()
+    X = np.random.RandomState(0).randn(8, 4).astype("f4")
+    y = X @ np.array([[1.0], [0.5], [-0.5], [0.2]], dtype="f4")
+    model.train_on_batch(X, y)  # materialize optimizer slots
+
+    state = TensorFlowKerasState(model, epoch=3, batch=7)
+    w0 = [w.copy() for w in model.get_weights()]
+
+    model.train_on_batch(X, y)  # perturb
+    state.epoch = 5
+    assert any(not np.allclose(a, b)
+               for a, b in zip(w0, model.get_weights()))
+
+    state.restore()
+    for a, b in zip(w0, model.get_weights()):
+        np.testing.assert_allclose(a, b)
+    assert state.epoch == 3 and state.batch == 7
+
+    # commit() captures the new point; restore returns to IT afterwards
+    model.train_on_batch(X, y)
+    state.epoch = 9
+    state.commit()
+    w1 = [w.copy() for w in model.get_weights()]
+    model.train_on_batch(X, y)
+    state.restore()
+    for a, b in zip(w1, model.get_weights()):
+        np.testing.assert_allclose(a, b)
+    assert state.epoch == 9
+
+    state.sync()  # replicated single-controller: broadcast is identity
+    for a, b in zip(w1, model.get_weights()):
+        np.testing.assert_allclose(a, b)
+
+
+def test_distributed_optimizer_backward_passes_per_step(tfhvd):
+    """DistributedOptimizer(backward_passes_per_step=N) accumulates N
+    calls locally and reduces+applies on the N-th (reference: the TF
+    LocalGradientAggregationHelper semantics)."""
+    opt = tfhvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=1.0),
+        backward_passes_per_step=2)
+    v = tf.Variable([1.0, 1.0])
+
+    applied = opt.apply_gradients([(tf.constant([0.25, 0.25]), v)])
+    assert not bool(applied)  # pass 1: accumulated only
+    np.testing.assert_allclose(v.numpy(), [1.0, 1.0])
+
+    applied = opt.apply_gradients([(tf.constant([0.25, 0.25]), v)])
+    assert bool(applied)  # pass 2: sum of both passes applied
+    np.testing.assert_allclose(v.numpy(), [0.5, 0.5])
+
+    # next cycle starts from zeroed accumulators
+    opt.apply_gradients([(tf.constant([0.5, 0.5]), v)])
+    np.testing.assert_allclose(v.numpy(), [0.5, 0.5])
+    opt.apply_gradients([(tf.constant([0.5, 0.5]), v)])
+    np.testing.assert_allclose(v.numpy(), [-0.5, -0.5])
