@@ -36,6 +36,10 @@ setup(
     description="TPU-native distributed training framework "
                 "(capability rebuild of Horovod)",
     packages=find_packages(exclude=("tests", "tests.*")),
+    # native sources ride the wheel: the TF XLA op bridge (and the
+    # pure-python-install fallback of the core) compile on demand from
+    # the installed tree
+    package_data={"horovod_tpu.native": ["*.cc", "*.cpp"]},
     ext_modules=exts,
     entry_points={
         "console_scripts": [
