@@ -59,3 +59,68 @@ def test_async_handles_resolve_out_of_order(hvd, n_workers):
                for i in range(32)]
     for i, h in reversed(list(enumerate(handles))):
         assert float(np.asarray(h.synchronize())) == i * n_workers
+
+
+def test_three_frontends_share_one_engine(hvd, n_workers):
+    """TF, torch, and JAX numpy frontends interleave submissions from
+    separate threads against ONE engine — the shared-core claim of the
+    adapter design (docs/adapters.md), exercised concurrently.  (All
+    three take the eager engine path here; the TF registered-op bridge
+    only engages in multi-process jobs.)"""
+    import threading
+
+    import pytest
+    tf = pytest.importorskip("tensorflow")
+    torch = pytest.importorskip("torch")
+    import horovod_tpu.tensorflow as tfhvd
+    import horovod_tpu.torch as thvd
+
+    errors = []
+    # main thread participates: a hung worker fails the barrier with
+    # BrokenBarrierError instead of silently passing after the joins
+    done = threading.Barrier(4, timeout=120)
+
+    def tf_worker():
+        try:
+            for i in range(6):
+                out = tfhvd.allreduce(tf.ones(3) * (i + 1), op=tfhvd.Sum,
+                                      name=f"mix.tf.{i}")
+                np.testing.assert_allclose(
+                    out.numpy(), np.full(3, (i + 1.0) * n_workers))
+        except Exception as e:  # noqa: BLE001
+            errors.append(("tf", e))
+        finally:
+            done.wait()
+
+    def torch_worker():
+        try:
+            for i in range(6):
+                out = thvd.allreduce(torch.ones(4) * (i + 1), op=thvd.Sum,
+                                     name=f"mix.torch.{i}")
+                assert torch.allclose(
+                    out, torch.full((4,), (i + 1.0) * n_workers))
+        except Exception as e:  # noqa: BLE001
+            errors.append(("torch", e))
+        finally:
+            done.wait()
+
+    def np_worker():
+        try:
+            for i in range(6):
+                out = hvd.allreduce(np.float32(i + 1), op=hvd.Sum,
+                                    name=f"mix.np.{i}")
+                assert float(np.asarray(out)) == (i + 1) * n_workers
+        except Exception as e:  # noqa: BLE001
+            errors.append(("np", e))
+        finally:
+            done.wait()
+
+    threads = [threading.Thread(target=f, daemon=True)
+               for f in (tf_worker, torch_worker, np_worker)]
+    for t in threads:
+        t.start()
+    done.wait()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads), "worker hung"
+    assert not errors, errors
