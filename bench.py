@@ -96,12 +96,15 @@ def bench_bert():
 
     on_cpu = jax.devices()[0].platform == "cpu"
     cfg = bert.bert_base(num_labels=4) if not on_cpu else bert.tiny()
-    batch, seq, steps = (_env_batch(256), 128, 40) if not on_cpu \
+    # batch 512 = the round-5 measured knee (608.4 seq/s vs 328.4 at
+    # b256; b1024 fails to fit) — BENCH_NOTE_r05.md sweeps 4-5
+    batch, seq, steps = (_env_batch(512), 128, 40) if not on_cpu \
         else (4, 32, 3)
     cfg = dataclasses.replace(
         cfg, max_seq_len=max(cfg.max_seq_len, seq),
-        # fine-tune activations at seq 128 fit HBM comfortably — remat
-        # would spend ~1/3 more FLOPs for memory we don't need
+        # remat is REQUIRED at the b512 default: the b512 (and b256)
+        # remat-off variants OOM HBM (bench_ab_r05_rest.log); only at
+        # b<=128 do activations fit without recompute
         remat=os.environ.get("HOROVOD_BENCH_REMAT", "1") != "0")
     n_chips = jax.local_device_count()
     mesh = jax.make_mesh((n_chips,), ("dp",))
@@ -153,7 +156,9 @@ def bench_resnet():
     from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
 
     on_cpu = jax.devices()[0].platform == "cpu"
-    variant, img, batch, steps = (50, 224, _env_batch(128), 40) \
+    # batch 256 = the round-5 measured knee (2,571 img/s vs 2,541 at
+    # b128; 2,426 at b512) — BENCH_NOTE_r05.md sweeps 3-4
+    variant, img, batch, steps = (50, 224, _env_batch(256), 40) \
         if not on_cpu else (18, 32, 2, 3)
     cfg = resnet.ResNetConfig(variant=variant, dtype=jnp.bfloat16)
     n_chips = jax.local_device_count()
@@ -212,11 +217,13 @@ def bench_longctx():
     cfg = llama.LlamaConfig(
         vocab_size=32768, d_model=1024, n_layers=8, n_heads=16,
         n_kv_heads=8, d_ff=4096, max_seq_len=8192,
-        # ~100M params: 8k-seq activations fit HBM without remat, so
-        # recompute is an A/B knob here rather than a necessity
-        remat=os.environ.get("HOROVOD_BENCH_REMAT", "1") != "0",
+        # ~100M params: 8k-seq activations fit HBM without remat —
+        # round-5 measured: remat OFF is +9.4%, and batch 2 another +5%
+        # (50,355 t/s vs 43,760 at the b1+remat r4 configuration; b4
+        # fails to fit) — BENCH_NOTE_r05.md sweeps 3-4
+        remat=os.environ.get("HOROVOD_BENCH_REMAT", "0") != "0",
         remat_policy="full", loss_chunk=1024)
-    batch, seq, steps = _env_batch(1), 8192, 10
+    batch, seq, steps = _env_batch(2), 8192, 10
     if on_cpu:
         cfg = dataclasses.replace(cfg, d_model=256, n_layers=2, n_heads=8,
                                   n_kv_heads=4, d_ff=1024, vocab_size=4096,
@@ -398,8 +405,8 @@ def main():
     # recompute FLOPs for the HBM that lets adamw master state fit.
     # Env knobs (defaults = the round-5 measured A/B winner on the real
     # v5e chip, BENCH_NOTE_r05.md: chunk-2048 xent + bf16-moment AdamW +
-    # last-2-layers un-remat'd -> 16,569 t/s, confirmed twice, vs 16,518
-    # at chunk-1024 and 15,895 at the r2-era defaults):
+    # last-2-layers un-remat'd + scan10 -> 16,690 t/s, vs 16,518 at
+    # chunk-1024 and 15,895 at the r2-era defaults):
     #   HOROVOD_BENCH_LOSS_CHUNK  chunked vocab cross-entropy
     #   HOROVOD_BENCH_REMAT_SKIP  last-k layers un-remat'd
     #   HOROVOD_BENCH_OPT=lp      bf16-moment AdamW
@@ -444,8 +451,10 @@ def main():
         rng.randint(0, cfg.vocab_size, (batch * n_chips, seq)), jnp.int32),
         sh)
 
-    # warmup (compile)
-    k = _env_scan()
+    # warmup (compile).  scan10 = the round-5 measured winner (16,690
+    # t/s vs 16,569 eager, two agreeing runs; scan20 16,641) — real TPU
+    # loops amortize host dispatch the same way (BENCH_NOTE_r05.md).
+    k = _env_scan(10) if not on_cpu else _env_scan()
     sf = ts.step_fn if k == 1 else _scan_wrap(ts.step_fn, 2, 2, k)
     params, opt_state, loss = sf(params, opt_state, toks, tgts)
     float(loss)  # device→host transfer is the reliable sync point

@@ -16,9 +16,11 @@ run() {
   # runs ~5 min; babysit the sweep rather than killing clients
   env "$@" python bench.py 2>&1 | grep -E '^\{' || echo FAILED
 }
-# r2-era configuration, pinned (bench.py defaults are now the round-5
-# measured winner: chunk1024 + lp + remat_skip2)
-run HOROVOD_BENCH_LOSS_CHUNK=0 HOROVOD_BENCH_OPT=std HOROVOD_BENCH_REMAT_SKIP=0
+# r2-era configuration, pinned — including eager dispatch (bench.py
+# defaults are now the round-5 measured winner: chunk2048 + lp +
+# remat_skip2 + scan10, so every knob the winner moved must be pinned
+# back here for the baseline row to stay the r2 configuration)
+run HOROVOD_BENCH_LOSS_CHUNK=0 HOROVOD_BENCH_OPT=std HOROVOD_BENCH_REMAT_SKIP=0 HOROVOD_BENCH_SCAN=1
 run HOROVOD_BENCH_NOOP=1   # current defaults (= the round-5 winner)
 run HOROVOD_BENCH_LOSS_CHUNK=1024 HOROVOD_BENCH_OPT=lp HOROVOD_BENCH_REMAT_SKIP=1
 run HOROVOD_BENCH_FUSED_XENT=1 HOROVOD_BENCH_LOSS_CHUNK=0 HOROVOD_BENCH_OPT=std HOROVOD_BENCH_REMAT_SKIP=0
