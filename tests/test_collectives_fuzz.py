@@ -1,0 +1,139 @@
+"""Property-style fuzz of the eager collective surface: randomized
+op x dtype x shape cases checked against a numpy reference (the
+reference's per-op x per-dtype sweeps in test/parallel/test_torch.py,
+generalized to random shapes).
+
+Values are small integers so every dtype — including bf16/fp16 whose
+sums of eight elements stay exactly representable — admits an exact
+reference; only true-average cases use a float tolerance.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+DTYPES = [np.float32, np.float64, np.float16, jnp.bfloat16,
+          np.int32, np.int64, np.uint8]
+FLOATS = (np.float32, np.float64, np.float16, jnp.bfloat16)
+
+
+def _stacked(hvd, vals, dtype):
+    """Rank-dependent stacked input: worker r contributes vals[r]."""
+    return hvd.worker_values(
+        lambda r: np.asarray(vals[r]).astype(np.dtype(dtype)))
+
+
+def _case(hvd, seed):
+    """Random (shape, dtype, stacked worker inputs) for 8 workers."""
+    rng = np.random.RandomState(seed)
+    dtype = DTYPES[rng.randint(len(DTYPES))]
+    ndim = rng.randint(1, 4)
+    shape = tuple(int(rng.randint(1, 5)) for _ in range(ndim))
+    vals = rng.randint(0, 5, size=(8,) + shape)
+    return shape, dtype, vals, _stacked(hvd, vals, dtype)
+
+
+def _assert_exact(out, expected, dtype):
+    got = np.asarray(out).astype(np.float64)
+    np.testing.assert_allclose(got, expected.astype(np.float64))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_allreduce_sum(hvd, seed):
+    shape, dtype, vals, x = _case(hvd, seed)
+    out = hvd.allreduce(x, op=hvd.Sum, name=f"fz_ar_{seed}")
+    assert out.dtype == jnp.asarray(x).dtype
+    assert out.shape == shape
+    _assert_exact(out, vals.sum(axis=0), dtype)
+
+
+@pytest.mark.parametrize("seed", range(8, 14))
+def test_fuzz_allreduce_minmax(hvd, seed):
+    shape, dtype, vals, x = _case(hvd, seed)
+    out_min = hvd.allreduce(x, op=hvd.Min, name=f"fz_mn_{seed}")
+    out_max = hvd.allreduce(x, op=hvd.Max, name=f"fz_mx_{seed}")
+    _assert_exact(out_min, vals.min(axis=0), dtype)
+    _assert_exact(out_max, vals.max(axis=0), dtype)
+
+
+@pytest.mark.parametrize("seed", range(14, 20))
+def test_fuzz_allreduce_average_float(hvd, seed):
+    shape, dtype, vals, x = _case(hvd, seed)
+    if dtype not in FLOATS:
+        dtype = np.float32
+        x = _stacked(hvd, vals, dtype)
+    out = hvd.allreduce(x, name=f"fz_avg_{seed}")  # default average
+    got = np.asarray(out).astype(np.float64)
+    np.testing.assert_allclose(got, vals.mean(axis=0), rtol=2e-2)
+
+
+@pytest.mark.parametrize("seed", range(20, 26))
+def test_fuzz_allgather(hvd, seed):
+    shape, dtype, vals, x = _case(hvd, seed)
+    out = hvd.allgather(x, name=f"fz_ag_{seed}")
+    assert out.shape == (8 * shape[0],) + shape[1:]
+    expected = np.concatenate([vals[r] for r in range(8)], axis=0)
+    _assert_exact(out, expected, dtype)
+
+
+@pytest.mark.parametrize("seed", range(26, 32))
+def test_fuzz_broadcast(hvd, seed):
+    shape, dtype, vals, x = _case(hvd, seed)
+    root = int(np.random.RandomState(1000 + seed).randint(8))
+    out = hvd.broadcast(x, root_rank=root, name=f"fz_bc_{seed}")
+    _assert_exact(out, vals[root], dtype)
+
+
+@pytest.mark.parametrize("seed", range(32, 38))
+def test_fuzz_reducescatter_sum(hvd, seed):
+    rng = np.random.RandomState(seed)
+    dtype = DTYPES[rng.randint(len(DTYPES))]
+    tail = tuple(int(rng.randint(1, 4))
+                 for _ in range(int(rng.randint(0, 3))))
+    rows = 8 * int(rng.randint(1, 4))
+    vals = rng.randint(0, 5, size=(8, rows) + tail)
+    x = _stacked(hvd, vals, dtype)
+    out = hvd.reducescatter(x, op=hvd.Sum, name=f"fz_rs_{seed}")
+    summed = vals.sum(axis=0)               # [rows, ...]
+    per = rows // 8
+    expected = np.stack([summed[j * per:(j + 1) * per] for j in range(8)])
+    assert out.shape == (8, per) + tail
+    _assert_exact(out, expected, dtype)
+
+
+@pytest.mark.parametrize("seed", range(38, 44))
+def test_fuzz_alltoall_uniform(hvd, seed):
+    rng = np.random.RandomState(seed)
+    dtype = DTYPES[rng.randint(len(DTYPES))]
+    tail = tuple(int(rng.randint(1, 4))
+                 for _ in range(int(rng.randint(0, 3))))
+    rows = 8 * int(rng.randint(1, 4))
+    vals = rng.randint(0, 5, size=(8, rows) + tail)
+    x = _stacked(hvd, vals, dtype)
+    out = hvd.alltoall(x, name=f"fz_a2a_{seed}")
+    per = rows // 8
+    # worker j receives chunk j from every worker i, concatenated over i
+    expected = np.stack([
+        np.concatenate([vals[i, j * per:(j + 1) * per] for i in range(8)],
+                       axis=0)
+        for j in range(8)])
+    assert out.shape == (8, rows) + tail
+    _assert_exact(out, expected, dtype)
+
+
+@pytest.mark.parametrize("seed", range(44, 48))
+def test_fuzz_grouped_allreduce_mixed(hvd, seed):
+    rng = np.random.RandomState(seed)
+    xs, refs = [], []
+    for i in range(int(rng.randint(2, 5))):
+        dtype = DTYPES[rng.randint(len(DTYPES))]
+        shape = tuple(int(rng.randint(1, 4))
+                      for _ in range(int(rng.randint(1, 3))))
+        vals = rng.randint(0, 5, size=(8,) + shape)
+        xs.append(_stacked(hvd, vals, dtype))
+        refs.append(vals.sum(axis=0))
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum, name=f"fz_gar_{seed}")
+    assert len(outs) == len(xs)
+    for out, ref, x in zip(outs, refs, xs):
+        assert out.dtype == x.dtype
+        _assert_exact(out, ref, None)

@@ -23,7 +23,10 @@ run() {
 run HOROVOD_BENCH_LOSS_CHUNK=0 HOROVOD_BENCH_OPT=std HOROVOD_BENCH_REMAT_SKIP=0 HOROVOD_BENCH_SCAN=1
 run HOROVOD_BENCH_NOOP=1   # current defaults (= the round-5 winner)
 run HOROVOD_BENCH_LOSS_CHUNK=1024 HOROVOD_BENCH_OPT=lp HOROVOD_BENCH_REMAT_SKIP=1
-run HOROVOD_BENCH_FUSED_XENT=1 HOROVOD_BENCH_LOSS_CHUNK=0 HOROVOD_BENCH_OPT=std HOROVOD_BENCH_REMAT_SKIP=0
+# fused xent at the r2 config: pin SCAN=1 too, same reason as row 1
+# (rows below it compare fused against the CURRENT defaults, so they
+# inherit on purpose)
+run HOROVOD_BENCH_FUSED_XENT=1 HOROVOD_BENCH_LOSS_CHUNK=0 HOROVOD_BENCH_OPT=std HOROVOD_BENCH_REMAT_SKIP=0 HOROVOD_BENCH_SCAN=1
 run HOROVOD_BENCH_FUSED_XENT=1
 run HOROVOD_BENCH_FUSED_XENT=1 HOROVOD_BENCH_REMAT_SKIP=1
 run HOROVOD_BENCH_MODEL=bert

@@ -1,0 +1,86 @@
+# Shared probe-watcher scaffolding for the wedged-tunnel bench watchers
+# (bench_watch*.sh source this).  Contract:
+#   - caller defines sweep()   — serial bench runs, writes to stdout
+#   - caller sets PROBE_DIR    — per-watcher probe state directory
+#   - caller sets SWEEP_LOG    — file the sweep output is appended to
+#   - then calls watch_loop
+# Discipline (BENCH_NOTE_r03..r05): probes are NEVER killed — a
+# SIGTERM/SIGKILL on a mid-claim PJRT client is what wedges the
+# tunnel; at most MAX_PENDING of THIS watcher's probes are live at
+# once (orphans from earlier runs are not ours to manage); sweeps run
+# serially only after a probe confirms the chip answers.
+
+MAX_PENDING=${MAX_PENDING:-2}
+SLEEP=${SLEEP:-300}
+
+run() {
+  echo "=== $* ==="
+  local out
+  out=$(env "$@" python bench.py 2>&1 | grep -E '^\{' || echo FAILED)
+  echo "$out"
+  # Abort ONLY on a probe-guard timeout ('"error"' key): every later
+  # variant would also park 300s while queueing one more orphan claim
+  # client each.  A fast FAILED (compile error / OOM) is a property of
+  # that variant — keep sweeping the rest.
+  case "$out" in *'"error"'*) return 1;; esac
+  return 0
+}
+
+launch_probe() {
+  local tag="$PROBE_DIR/probe_$(date +%s)"
+  setsid nohup python -c "import jax; jax.devices(); print('ok', flush=True)" \
+    > "$tag.out" 2> "$tag.err" < /dev/null &
+  echo "$!" > "$tag.pid"
+  echo "$(date -u +%T) launched probe $tag (pid $!)" >> "$PROBE_DIR/watch.log"
+}
+
+chip_free() {
+  grep -l "^ok" "$PROBE_DIR"/probe_*.out 2>/dev/null | head -1
+}
+
+pending_probes() {
+  # THIS watcher's live, not-yet-answered probes only (orphans from
+  # earlier bench runs are invisible to chip_free, so counting them
+  # here would deadlock the watcher while they idle)
+  local n=0
+  for pidf in "$PROBE_DIR"/probe_*.pid; do
+    [ -f "$pidf" ] || continue
+    local pid out
+    pid=$(cat "$pidf"); out="${pidf%.pid}.out"
+    if kill -0 "$pid" 2>/dev/null && ! grep -q "^ok" "$out" 2>/dev/null; then
+      n=$((n + 1))
+    fi
+  done
+  echo "$n"
+}
+
+watch_loop() {
+  mkdir -p "$PROBE_DIR"
+  while true; do
+    if [ -n "$(chip_free)" ]; then
+      local SWEEP_OUT
+      SWEEP_OUT=$(mktemp)
+      sweep > "$SWEEP_OUT" 2>&1
+      cat "$SWEEP_OUT" >> "$SWEEP_LOG"
+      # Done only when the sweep produced at least one value and no
+      # probe-guard error: a mid-sweep re-wedge leaves unmeasured
+      # variants, so the watcher keeps retrying the full list.
+      if ! grep '^{' "$SWEEP_OUT" | grep -q '"error"' \
+          && grep '^{' "$SWEEP_OUT" | grep -q '"value"'; then
+        rm -f "$SWEEP_OUT"
+        echo "$(date -u +%T) sweep complete — watcher done" \
+          >> "$PROBE_DIR/watch.log"
+        return 0
+      fi
+      rm -f "$SWEEP_OUT"
+      for okf in $(grep -l "^ok" "$PROBE_DIR"/probe_*.out 2>/dev/null); do
+        local base="${okf%.out}"
+        rm -f "$base.out" "$base.pid" "$base.err"
+      done
+    fi
+    if [ "$(pending_probes)" -lt "$MAX_PENDING" ]; then
+      launch_probe
+    fi
+    sleep "$SLEEP"
+  done
+}
