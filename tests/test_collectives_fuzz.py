@@ -33,7 +33,7 @@ def _case(hvd, seed):
     return shape, dtype, vals, _stacked(hvd, vals, dtype)
 
 
-def _assert_exact(out, expected, dtype):
+def _assert_exact(out, expected):
     got = np.asarray(out).astype(np.float64)
     np.testing.assert_allclose(got, expected.astype(np.float64))
 
@@ -44,7 +44,7 @@ def test_fuzz_allreduce_sum(hvd, seed):
     out = hvd.allreduce(x, op=hvd.Sum, name=f"fz_ar_{seed}")
     assert out.dtype == jnp.asarray(x).dtype
     assert out.shape == shape
-    _assert_exact(out, vals.sum(axis=0), dtype)
+    _assert_exact(out, vals.sum(axis=0))
 
 
 @pytest.mark.parametrize("seed", range(8, 14))
@@ -52,8 +52,8 @@ def test_fuzz_allreduce_minmax(hvd, seed):
     shape, dtype, vals, x = _case(hvd, seed)
     out_min = hvd.allreduce(x, op=hvd.Min, name=f"fz_mn_{seed}")
     out_max = hvd.allreduce(x, op=hvd.Max, name=f"fz_mx_{seed}")
-    _assert_exact(out_min, vals.min(axis=0), dtype)
-    _assert_exact(out_max, vals.max(axis=0), dtype)
+    _assert_exact(out_min, vals.min(axis=0))
+    _assert_exact(out_max, vals.max(axis=0))
 
 
 @pytest.mark.parametrize("seed", range(14, 20))
@@ -73,7 +73,7 @@ def test_fuzz_allgather(hvd, seed):
     out = hvd.allgather(x, name=f"fz_ag_{seed}")
     assert out.shape == (8 * shape[0],) + shape[1:]
     expected = np.concatenate([vals[r] for r in range(8)], axis=0)
-    _assert_exact(out, expected, dtype)
+    _assert_exact(out, expected)
 
 
 @pytest.mark.parametrize("seed", range(26, 32))
@@ -81,7 +81,7 @@ def test_fuzz_broadcast(hvd, seed):
     shape, dtype, vals, x = _case(hvd, seed)
     root = int(np.random.RandomState(1000 + seed).randint(8))
     out = hvd.broadcast(x, root_rank=root, name=f"fz_bc_{seed}")
-    _assert_exact(out, vals[root], dtype)
+    _assert_exact(out, vals[root])
 
 
 @pytest.mark.parametrize("seed", range(32, 38))
@@ -98,7 +98,7 @@ def test_fuzz_reducescatter_sum(hvd, seed):
     per = rows // 8
     expected = np.stack([summed[j * per:(j + 1) * per] for j in range(8)])
     assert out.shape == (8, per) + tail
-    _assert_exact(out, expected, dtype)
+    _assert_exact(out, expected)
 
 
 @pytest.mark.parametrize("seed", range(38, 44))
@@ -118,7 +118,7 @@ def test_fuzz_alltoall_uniform(hvd, seed):
                        axis=0)
         for j in range(8)])
     assert out.shape == (8, rows) + tail
-    _assert_exact(out, expected, dtype)
+    _assert_exact(out, expected)
 
 
 @pytest.mark.parametrize("seed", range(44, 48))
@@ -136,4 +136,48 @@ def test_fuzz_grouped_allreduce_mixed(hvd, seed):
     assert len(outs) == len(xs)
     for out, ref, x in zip(outs, refs, xs):
         assert out.dtype == x.dtype
-        _assert_exact(out, ref, None)
+        _assert_exact(out, ref)
+
+
+@pytest.mark.parametrize("seed", range(48, 54))
+def test_fuzz_process_set_scoped(hvd, seed):
+    """Random rank subsets: the collective must see ONLY members."""
+    import horovod_tpu.ops.collectives as C
+
+    rng = np.random.RandomState(seed)
+    k = int(rng.randint(2, 8))
+    members = sorted(rng.choice(8, size=k, replace=False).tolist())
+    ps = hvd.add_process_set(members)
+    try:
+        dtype = DTYPES[rng.randint(len(DTYPES))]
+        shape = tuple(int(rng.randint(1, 4))
+                      for _ in range(int(rng.randint(1, 3))))
+        vals = rng.randint(0, 5, size=(k,) + shape)
+        x = C.stack_on_workers(
+            [np.asarray(vals[i]).astype(np.dtype(dtype)) for i in range(k)],
+            ps)
+        out = hvd.allreduce(x, op=hvd.Sum, process_set=ps,
+                            name=f"fz_ps_{seed}")
+        _assert_exact(out, vals.sum(axis=0))
+        g = hvd.allgather(x, process_set=ps, name=f"fz_psg_{seed}")
+        expected = np.concatenate([vals[i] for i in range(k)], axis=0)
+        _assert_exact(g, expected)
+    finally:
+        hvd.remove_process_set(ps)
+
+
+@pytest.mark.parametrize("seed", range(54, 60))
+def test_fuzz_compression_roundtrip(hvd, seed):
+    """fp16/bf16 wire compression: output dtype is restored and values
+    match within the wire format's precision."""
+    rng = np.random.RandomState(seed)
+    comp = (hvd.Compression.fp16, hvd.Compression.bf16)[rng.randint(2)]
+    shape = tuple(int(rng.randint(1, 5))
+                  for _ in range(int(rng.randint(1, 4))))
+    vals = rng.randint(0, 5, size=(8,) + shape)
+    x = _stacked(hvd, vals, np.float32)
+    out = hvd.allreduce(x, op=hvd.Sum, compression=comp,
+                        name=f"fz_comp_{seed}")
+    assert out.dtype == jnp.float32
+    # sums of eight 0..4 integers are exact in both wire formats
+    _assert_exact(out, vals.sum(axis=0))

@@ -1,84 +1,21 @@
 #!/bin/bash
 # Round-5 tunnel watcher: poll for TPU availability all round and run the
-# full A/B sweep the moment the claim lock frees.
-#
-# Discipline (BENCH_NOTE_r03/r04, memory: tpu-single-client):
-#   - NEVER kill a mid-claim PJRT client (that is what wedges the tunnel);
-#     probes are left running and exit cleanly on their own when the chip
-#     frees or the relay drops them.
-#   - at most MAX_PENDING live probes at a time, so a long wedge does not
-#     accumulate an unbounded claim queue.
-#   - ONE TPU client does real work at a time: the sweep runs only after a
-#     probe confirms the chip answers.
+# full A/B sweep (tools/bench_ab.sh) the moment the claim lock frees.
+# Probe discipline and the watch loop live in bench_watch_lib.sh.
 set -u
 cd "$(dirname "$0")/.."
 PROBE_DIR=${PROBE_DIR:-/tmp/bench_probes_r05}
-MAX_PENDING=${MAX_PENDING:-2}
-SLEEP=${SLEEP:-300}
-mkdir -p "$PROBE_DIR"
+SWEEP_LOG=bench_ab_r05.log
+. tools/bench_watch_lib.sh
 
 # wait for any already-running sweep to finish before watching (pgrep -f
 # matches the sweep script's own processes; this watcher's cmdline does
 # not contain "bench_ab.sh", so no self-match to filter)
 while pgrep -f "tools/bench_ab.sh" > /dev/null; do sleep 60; done
 
-launch_probe() {
-  local tag="$PROBE_DIR/probe_$(date +%s)"
-  setsid nohup python -c "import jax; jax.devices(); print('ok', flush=True)" \
-    > "$tag.out" 2> "$tag.err" < /dev/null &
-  echo "$!" > "$tag.pid"
-  echo "$(date -u +%T) launched probe $tag (pid $!)" >> "$PROBE_DIR/watch.log"
+sweep() {
+  echo "=== full A/B sweep via watcher ($(date -u +%T)) ==="
+  bash tools/bench_ab.sh
 }
 
-chip_free() {
-  # any probe (old or new) that printed ok proves the tunnel answers
-  grep -l "^ok" "$PROBE_DIR"/probe_*.out 2>/dev/null | head -1
-}
-
-pending_probes() {
-  local n=0
-  for pidf in "$PROBE_DIR"/probe_*.pid; do
-    [ -f "$pidf" ] || continue
-    local pid out
-    pid=$(cat "$pidf"); out="${pidf%.pid}.out"
-    if kill -0 "$pid" 2>/dev/null && ! grep -q "^ok" "$out" 2>/dev/null; then
-      n=$((n + 1))
-    fi
-  done
-  echo "$n"
-}
-
-while true; do
-  if [ -n "$(chip_free)" ]; then
-    echo "$(date -u +%T) chip answered — running full A/B sweep" \
-      >> "$PROBE_DIR/watch.log"
-    # capture THIS sweep's output separately: the success check must see
-    # only fresh rows, never value lines accumulated from earlier runs
-    SWEEP_OUT=$(mktemp)
-    bash tools/bench_ab.sh > "$SWEEP_OUT" 2>&1
-    cat "$SWEEP_OUT" >> bench_ab_r05.log
-    # success = at least one variant emitted a real JSON line (error
-    # lines carry an "error" key; real runs never do, whatever the value)
-    if grep '^{' "$SWEEP_OUT" | grep -v '"error"' \
-        | grep -q '"value"'; then
-      rm -f "$SWEEP_OUT"
-      echo "$(date -u +%T) sweep produced numbers — watcher done" \
-        >> "$PROBE_DIR/watch.log"
-      exit 0
-    fi
-    rm -f "$SWEEP_OUT"
-    # sweep ran but still failed (lock re-wedged mid-claim).  Consume
-    # ONLY the stale ok markers: a probe that printed ok has already
-    # exited, so removing its files is safe — probes still pending keep
-    # their files so pending_probes() keeps counting them (never exceed
-    # MAX_PENDING live claim clients; see header)
-    for okf in $(grep -l "^ok" "$PROBE_DIR"/probe_*.out 2>/dev/null); do
-      base="${okf%.out}"
-      rm -f "$base.out" "$base.pid" "$base.err"
-    done
-  fi
-  if [ "$(pending_probes)" -lt "$MAX_PENDING" ]; then
-    launch_probe
-  fi
-  sleep "$SLEEP"
-done
+watch_loop

@@ -1,32 +1,20 @@
 #!/bin/bash
 # Continuation-session watcher: when the wedged tunnel frees, run the
-# outstanding round-5 A/B variants (sweep 3).  Same discipline as
-# bench_watch.sh: probes are never killed, at most MAX_PENDING of THIS
-# watcher's probes live at once (earlier sessions' orphan claim clients
-# are not ours to manage and exit on their own when the terminal
-# recovers), sweeps run serially after a probe confirms the chip
-# answers.
+# outstanding round-5 A/B variants (sweep 3).  Probe discipline and the
+# watch loop live in bench_watch_lib.sh: probes are never killed, at
+# most MAX_PENDING of THIS watcher's probes live at once, sweeps run
+# serially after a probe confirms the chip answers, and the watcher is
+# done only when the sweep ran END TO END with no probe-guard timeout
+# (a mid-sweep re-wedge leaves unmeasured variants; re-measuring a
+# leading variant costs ~5 min, missing the tail silently costs the
+# round).
 set -u
 cd "$(dirname "$0")/.."
 PROBE_DIR=${PROBE_DIR:-/tmp/bench_probes_r05b}
-MAX_PENDING=${MAX_PENDING:-2}
-SLEEP=${SLEEP:-300}
-mkdir -p "$PROBE_DIR"
+SWEEP_LOG=bench_ab_r05_rest.log
+. tools/bench_watch_lib.sh
 
-run() {
-  echo "=== $* ==="
-  local out
-  out=$(env "$@" python bench.py 2>&1 | grep -E '^\{' || echo FAILED)
-  echo "$out"
-  # Abort ONLY on a probe-guard timeout ('"error"' key): every later
-  # variant would also park 300s while queueing one more orphan claim
-  # client each.  A fast FAILED (compile error / OOM) is a property of
-  # that variant — keep sweeping the rest.
-  case "$out" in *'"error"'*) return 1;; esac
-  return 0
-}
-
-sweep3() {
+sweep() {
   echo "=== sweep 3 via watcher ($(date -u +%T)) ==="
   run HOROVOD_BENCH_SCAN=10 || return            # confirm the 16,636 run
   run HOROVOD_BENCH_MODEL=bert HOROVOD_BENCH_BATCH=256 \
@@ -45,58 +33,4 @@ sweep3() {
   run HOROVOD_FLASH_ATTENTION=0 || return
 }
 
-launch_probe() {
-  local tag="$PROBE_DIR/probe_$(date +%s)"
-  setsid nohup python -c "import jax; jax.devices(); print('ok', flush=True)" \
-    > "$tag.out" 2> "$tag.err" < /dev/null &
-  echo "$!" > "$tag.pid"
-  echo "$(date -u +%T) launched probe $tag (pid $!)" >> "$PROBE_DIR/watch.log"
-}
-
-chip_free() {
-  grep -l "^ok" "$PROBE_DIR"/probe_*.out 2>/dev/null | head -1
-}
-
-pending_probes() {
-  # THIS watcher's live, not-yet-answered probes only (orphans from
-  # earlier bench runs are invisible to chip_free, so counting them
-  # here would deadlock the watcher while they idle)
-  local n=0
-  for pidf in "$PROBE_DIR"/probe_*.pid; do
-    [ -f "$pidf" ] || continue
-    local pid out
-    pid=$(cat "$pidf"); out="${pidf%.pid}.out"
-    if kill -0 "$pid" 2>/dev/null && ! grep -q "^ok" "$out" 2>/dev/null; then
-      n=$((n + 1))
-    fi
-  done
-  echo "$n"
-}
-
-while true; do
-  if [ -n "$(chip_free)" ]; then
-    SWEEP_OUT=$(mktemp)
-    sweep3 > "$SWEEP_OUT" 2>&1
-    cat "$SWEEP_OUT" >> bench_ab_r05_rest.log
-    # Done only when the sweep ran END TO END with no probe-guard
-    # timeout: a mid-sweep re-wedge leaves unmeasured variants, so the
-    # watcher keeps retrying the full list (re-measuring a leading
-    # variant costs ~5 min; missing the tail silently costs the round).
-    if ! grep '^{' "$SWEEP_OUT" | grep -q '"error"' \
-        && grep '^{' "$SWEEP_OUT" | grep -q '"value"'; then
-      rm -f "$SWEEP_OUT"
-      echo "$(date -u +%T) sweep 3 complete — watcher done" \
-        >> "$PROBE_DIR/watch.log"
-      exit 0
-    fi
-    rm -f "$SWEEP_OUT"
-    for okf in $(grep -l "^ok" "$PROBE_DIR"/probe_*.out 2>/dev/null); do
-      base="${okf%.out}"
-      rm -f "$base.out" "$base.pid" "$base.err"
-    done
-  fi
-  if [ "$(pending_probes)" -lt "$MAX_PENDING" ]; then
-    launch_probe
-  fi
-  sleep "$SLEEP"
-done
+watch_loop
