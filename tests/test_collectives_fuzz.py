@@ -181,3 +181,45 @@ def test_fuzz_compression_roundtrip(hvd, seed):
     assert out.dtype == jnp.float32
     # sums of eight 0..4 integers are exact in both wire formats
     _assert_exact(out, vals.sum(axis=0))
+
+
+@pytest.mark.parametrize("seed", range(60, 66))
+def test_fuzz_alltoall_uneven(hvd, seed):
+    """Random per-destination splits (zeros allowed): worker j receives
+    its split from every sender, concatenated in sender order."""
+    rng = np.random.RandomState(seed)
+    dtype = DTYPES[rng.randint(len(DTYPES))]
+    splits = [int(s) for s in rng.randint(0, 4, size=8)]
+    if len(set(splits)) == 1:
+        # all-equal splits (incl. all-zero) take the uniform alltoall
+        # path, which returns a stacked array — keep this test on the
+        # uneven list-returning path
+        splits[int(rng.randint(8))] += 1
+    rows = sum(splits)
+    tail = tuple(int(rng.randint(1, 4))
+                 for _ in range(int(rng.randint(0, 3))))
+    vals = rng.randint(0, 5, size=(8, rows) + tail)
+    x = _stacked(hvd, vals, dtype)
+    out = hvd.alltoall(x, splits=splits, name=f"fz_a2av_{seed}")
+    assert isinstance(out, list) and len(out) == 8
+    offs = np.concatenate([[0], np.cumsum(splits)])
+    for j in range(8):
+        expected = np.concatenate(
+            [vals[i, offs[j]:offs[j + 1]] for i in range(8)], axis=0)
+        assert np.asarray(out[j]).shape == (8 * splits[j],) + tail
+        _assert_exact(out[j], expected)
+
+
+@pytest.mark.parametrize("seed", range(66, 70))
+def test_fuzz_allreduce_scaled(hvd, seed):
+    """prescale/postscale compose as out = post * sum(pre * x_r)."""
+    rng = np.random.RandomState(seed)
+    shape = tuple(int(rng.randint(1, 5))
+                  for _ in range(int(rng.randint(1, 4))))
+    vals = rng.randint(0, 5, size=(8,) + shape)
+    pre = float(rng.choice([0.25, 0.5, 1.0, 2.0]))
+    post = float(rng.choice([0.25, 0.5, 1.0, 4.0]))
+    x = _stacked(hvd, vals, np.float32)
+    out = hvd.allreduce(x, op=hvd.Sum, prescale_factor=pre,
+                        postscale_factor=post, name=f"fz_sc_{seed}")
+    _assert_exact(out, post * (pre * vals).sum(axis=0))
