@@ -1,0 +1,11 @@
+"""Shared test helpers importable regardless of pytest import mode."""
+
+import jax
+
+
+def sp_sharded(mesh, fn):
+    """jit(shard_map) over the sp axis with the specs the SP paths use."""
+    from jax.sharding import PartitionSpec as P
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+        out_specs=P(None, "sp"), check_vma=False))

@@ -36,3 +36,9 @@ def hvd():
 @pytest.fixture(scope="session")
 def n_workers(hvd):
     return hvd.size()
+
+
+@pytest.fixture(scope="session")
+def sp_mesh(hvd):
+    """8-way sequence-parallel mesh shared by the parallel test modules."""
+    return jax.make_mesh((8,), ("sp",))

@@ -6,27 +6,17 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from _helpers import sp_sharded as _sharded
 from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh, factor_mesh
 from horovod_tpu.parallel.pipeline import pipeline_apply
 from horovod_tpu.parallel.ring_attention import ring_attention
 from horovod_tpu.parallel.ulysses import ulysses_attention
 
 
-@pytest.fixture(scope="module")
-def sp_mesh(hvd):
-    return jax.make_mesh((8,), ("sp",))
-
-
 def _qkv(B=2, T=64, H=8, D=16, seed=0):
     rng = np.random.RandomState(seed)
     return tuple(jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
                  for _ in range(3))
-
-
-def _sharded(mesh, fn):
-    return jax.jit(jax.shard_map(
-        fn, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
-        out_specs=P(None, "sp"), check_vma=False))
 
 
 def test_ring_attention_matches_reference(sp_mesh):

@@ -1,0 +1,81 @@
+"""Randomized-geometry equivalence fuzz for the sequence-parallel
+attention paths: ring and Ulysses outputs at random (B, T, H, Hkv, D,
+causal) draws are checked against an independent numpy softmax-attention
+oracle (not against ring_attention itself, so an error shared by both
+code paths cannot hide)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from _helpers import sp_sharded as _sharded
+from horovod_tpu.parallel.ring_attention import ring_attention
+from horovod_tpu.parallel.ulysses import ulysses_attention
+
+
+def _np_attention(q, k, v, causal):
+    """Numpy oracle: softmax(q k^T / sqrt(D)) v with GQA repeat."""
+    B, T, H, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != H:
+        k = np.repeat(k, H // Hkv, axis=2)
+        v = np.repeat(v, H // Hkv, axis=2)
+    scores = np.einsum("bthd,bshd->bhts", q, k) / np.sqrt(D)
+    if causal:
+        mask = np.triu(np.ones((T, T), bool), k=1)
+        scores = np.where(mask[None, None], -np.inf, scores)
+    scores = scores - scores.max(axis=-1, keepdims=True)
+    w = np.exp(scores)
+    w = w / w.sum(axis=-1, keepdims=True)
+    return np.einsum("bhts,bshd->bthd", w, v)
+
+
+def _draw(seed, head_div=None):
+    rng = np.random.RandomState(seed)
+    B = int(rng.randint(1, 3))
+    T = 8 * int(rng.randint(1, 9))
+    # head_div = the sp degree: ulysses needs H divisible by it, so the
+    # H choices are restricted to multiples
+    H = int(rng.choice([8, 16] if head_div else [2, 4, 8, 16]))
+    divisors = [h for h in (1, 2, 4, 8, 16) if H % h == 0]
+    Hkv = int(rng.choice(divisors))
+    D = int(rng.choice([4, 8, 16]))
+    causal = bool(rng.randint(2))
+    q = rng.normal(size=(B, T, H, D)).astype(np.float32)
+    k = rng.normal(size=(B, T, Hkv, D)).astype(np.float32)
+    v = rng.normal(size=(B, T, Hkv, D)).astype(np.float32)
+    return q, k, v, causal
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_ring_attention_vs_numpy(sp_mesh, seed):
+    q, k, v, causal = _draw(seed)
+    want = _np_attention(q, k, v, causal)
+    got = _sharded(sp_mesh, lambda q, k, v: ring_attention(
+        q, k, v, "sp", causal=causal))(jnp.asarray(q), jnp.asarray(k),
+                                       jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
+
+
+@pytest.mark.parametrize("seed", range(6, 10))
+def test_fuzz_ulysses_vs_numpy(sp_mesh, seed):
+    q, k, v, causal = _draw(seed, head_div=8)
+    want = _np_attention(q, k, v, causal)
+    got = _sharded(sp_mesh, lambda q, k, v: ulysses_attention(
+        q, k, v, "sp", causal=causal))(jnp.asarray(q), jnp.asarray(k),
+                                       jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
+
+
+@pytest.mark.parametrize("seed", range(10, 13))
+def test_fuzz_ring_vs_ulysses_agree(sp_mesh, seed):
+    """The two SP strategies compute the same math — outputs must agree
+    bit-for-bit-ish on identical random inputs."""
+    q, k, v, causal = _draw(seed, head_div=8)
+    a = _sharded(sp_mesh, lambda q, k, v: ring_attention(
+        q, k, v, "sp", causal=causal))(jnp.asarray(q), jnp.asarray(k),
+                                       jnp.asarray(v))
+    b = _sharded(sp_mesh, lambda q, k, v: ulysses_attention(
+        q, k, v, "sp", causal=causal))(jnp.asarray(q), jnp.asarray(k),
+                                       jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
