@@ -1,4 +1,6 @@
-"""Shared test helpers importable regardless of pytest import mode."""
+"""Shared test helpers (imported as a plain module from tests/; the
+suite runs with pytest's default prepend import mode, which puts this
+directory on sys.path)."""
 
 import jax
 

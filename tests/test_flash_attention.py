@@ -11,6 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from _helpers import sp_sharded as _ring_sharded
 from horovod_tpu.ops import flash_attention as fa
 from horovod_tpu.parallel.ring_attention import local_attention
 
@@ -159,13 +160,6 @@ def test_flash_attention_lse_grads_interpret(monkeypatch):
 
 
 # --- flash kernel inside the ring (VERDICT r2 #7) ---------------------------
-
-def _ring_sharded(mesh, fn):
-    from jax.sharding import PartitionSpec as P
-    return jax.jit(jax.shard_map(
-        fn, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
-        out_specs=P(None, "sp"), check_vma=False))
-
 
 @pytest.mark.parametrize("causal,Hkv", [(True, 2), (False, 2), (True, 1)])
 def test_ring_attention_kernel_path_interpret(causal, Hkv, monkeypatch,
