@@ -5,7 +5,10 @@
 #   bash tools/ci.sh [--quick]
 #
 # Stages:
-#   1. package: wheel + sdist build (no isolation - deps are baked in)
+#   1. package: wheel + sdist build (no isolation - deps are baked in),
+#               then install the wheel into a scratch --target dir and
+#               run an eager-collectives smoke from OUTSIDE the repo
+#               (catches wheels that build but don't ship runnable code)
 #   2. native:  build the C++ core in place, run its parity tests
 #   3. purepy:  the HOROVOD_TPU_NATIVE_CORE=0 fallback paths
 #   4. noctl:   single-process semantics with the controller disabled
@@ -18,6 +21,34 @@ rm -rf dist/
 python -m build --no-isolation --outdir dist/ . > /tmp/ci_build.log 2>&1 \
   || { tail -30 /tmp/ci_build.log; exit 1; }
 ls -l dist/
+
+echo "== 1b/5 wheel install smoke (scratch target, run from /tmp) =="
+WHEEL_TGT=$(mktemp -d)
+trap 'rm -rf "$WHEEL_TGT"' EXIT
+REPO_DIR="$(pwd)"
+pip install --no-deps --quiet --target "$WHEEL_TGT" dist/*.whl
+(cd /tmp && HOROVOD_TPU_FORCE_PLATFORM=cpu PYTHONPATH="$WHEEL_TGT" \
+  REPO_DIR="$REPO_DIR" python - <<'PYEOF'
+import os, sys
+repo = os.environ["REPO_DIR"]
+assert not any(p == repo for p in sys.path)
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+os.environ["HOROVOD_CYCLE_TIME"] = "0.2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import horovod_tpu as hvd
+assert "horovod_tpu" in hvd.__file__ and not hvd.__file__.startswith(repo)
+hvd.init()
+assert hvd.size() == 8
+x = hvd.worker_values(lambda r: np.full((3,), float(r)))
+np.testing.assert_allclose(
+    np.asarray(hvd.allreduce(x, op=hvd.Sum)), np.full((3,), 28.0))
+hvd.shutdown()
+print("wheel smoke OK")
+PYEOF
+)
 
 echo "== 2/5 native core build + parity tests =="
 python setup.py build_ext --inplace > /tmp/ci_native.log 2>&1 \
