@@ -26,9 +26,13 @@ echo "== 1b/5 wheel install smoke (scratch target, run from /tmp) =="
 WHEEL_TGT=$(mktemp -d)
 trap 'rm -rf "$WHEEL_TGT"' EXIT
 REPO_DIR="$(pwd)"
-pip install --no-deps --quiet --target "$WHEEL_TGT" dist/*.whl
-(cd /tmp && HOROVOD_TPU_FORCE_PLATFORM=cpu PYTHONPATH="$WHEEL_TGT" \
-  REPO_DIR="$REPO_DIR" python - <<'PYEOF'
+
+dist_smoke() {  # $1 = a wheel or sdist under dist/
+  rm -rf "$WHEEL_TGT"/*
+  pip install --no-deps --no-build-isolation --quiet \
+    --target "$WHEEL_TGT" "$1"
+  (cd /tmp && HOROVOD_TPU_FORCE_PLATFORM=cpu PYTHONPATH="$WHEEL_TGT" \
+    REPO_DIR="$REPO_DIR" python - <<'PYEOF'
 import os, sys
 repo = os.environ["REPO_DIR"]
 assert not any(p == repo for p in sys.path)
@@ -46,9 +50,16 @@ x = hvd.worker_values(lambda r: np.full((3,), float(r)))
 np.testing.assert_allclose(
     np.asarray(hvd.allreduce(x, op=hvd.Sum)), np.full((3,), 28.0))
 hvd.shutdown()
-print("wheel smoke OK")
+print("dist smoke OK, imported from", os.path.dirname(hvd.__file__))
 PYEOF
-)
+  )
+}
+
+dist_smoke dist/*.whl
+if [ "${1:-}" != "--quick" ]; then
+  echo "== 1c/5 sdist install smoke (builds from source) =="
+  dist_smoke dist/*.tar.gz
+fi
 
 echo "== 2/5 native core build + parity tests =="
 python setup.py build_ext --inplace > /tmp/ci_native.log 2>&1 \
