@@ -42,3 +42,10 @@ def n_workers(hvd):
 def sp_mesh(hvd):
     """8-way sequence-parallel mesh shared by the parallel test modules."""
     return jax.make_mesh((8,), ("sp",))
+
+
+@pytest.fixture(scope="session")
+def tfhvd(hvd):
+    """TF adapter over the initialized engine (importorskip at use sites)."""
+    import horovod_tpu.tensorflow as tfhvd
+    return tfhvd

@@ -20,12 +20,6 @@ import os  # noqa: E402
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.fixture(scope="module")
-def tfhvd(hvd):
-    import horovod_tpu.tensorflow as tfhvd
-    return tfhvd
-
-
 def test_allreduce_eager(tfhvd, n_workers):
     t = tf.constant([1.0, 2.0, 3.0])
     out = tfhvd.allreduce(t, op=tfhvd.Sum, name="tf_sum")

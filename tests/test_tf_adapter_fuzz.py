@@ -1,0 +1,59 @@
+"""Randomized op x dtype x shape fuzz at the TensorFlow boundary:
+replicated TF tensors through the adapter must match numpy references
+(the TF analog of tests/test_collectives_fuzz.py; single-process
+replicated semantics, so allreduce(sum) multiplies by the worker count
+and allgather tiles the input).  Covers allreduce (eager +
+tf.function), allgather, and broadcast; alltoall keeps its targeted
+tests in test_tf_adapter.py."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+TF_DTYPES = [np.float32, np.float64, np.int32, np.int64]
+
+
+def _draw(seed):
+    rng = np.random.RandomState(seed)
+    dtype = TF_DTYPES[rng.randint(len(TF_DTYPES))]
+    shape = tuple(int(rng.randint(1, 5))
+                  for _ in range(int(rng.randint(1, 4))))
+    vals = rng.randint(0, 5, size=shape).astype(dtype)
+    return vals, tf.constant(vals)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_tf_allreduce_sum(tfhvd, n_workers, seed):
+    vals, t = _draw(seed)
+    out = tfhvd.allreduce(t, op=tfhvd.Sum, name=f"tfz_ar_{seed}")
+    assert out.dtype == t.dtype
+    np.testing.assert_allclose(out.numpy(), vals * n_workers)
+
+
+@pytest.mark.parametrize("seed", range(4, 8))
+def test_fuzz_tf_allgather(tfhvd, n_workers, seed):
+    vals, t = _draw(seed)
+    out = tfhvd.allgather(t, name=f"tfz_ag_{seed}")
+    expected = np.concatenate([vals] * n_workers, axis=0)
+    assert out.shape == expected.shape
+    np.testing.assert_allclose(out.numpy(), expected)
+
+
+@pytest.mark.parametrize("seed", range(8, 12))
+def test_fuzz_tf_broadcast(tfhvd, seed):
+    vals, t = _draw(seed)
+    root = int(np.random.RandomState(2000 + seed).randint(8))
+    out = tfhvd.broadcast(t, root_rank=root, name=f"tfz_bc_{seed}")
+    np.testing.assert_allclose(out.numpy(), vals)  # replicated: identity
+
+
+@pytest.mark.parametrize("seed", range(12, 15))
+def test_fuzz_tf_allreduce_in_tf_function(tfhvd, n_workers, seed):
+    vals, t = _draw(seed)
+
+    @tf.function
+    def fn(x):
+        return tfhvd.allreduce(x, op=tfhvd.Sum, name=f"tfz_fn_{seed}")
+
+    np.testing.assert_allclose(fn(t).numpy(), vals * n_workers)
