@@ -49,3 +49,10 @@ def tfhvd(hvd):
     """TF adapter over the initialized engine (importorskip at use sites)."""
     import horovod_tpu.tensorflow as tfhvd
     return tfhvd
+
+
+@pytest.fixture(scope="session")
+def thvd(hvd):
+    """Torch adapter over the initialized engine."""
+    import horovod_tpu.torch as thvd
+    return thvd

@@ -79,7 +79,7 @@ def test_fuzz_allgather(hvd, seed):
 @pytest.mark.parametrize("seed", range(26, 32))
 def test_fuzz_broadcast(hvd, seed):
     shape, dtype, vals, x = _case(hvd, seed)
-    root = int(np.random.RandomState(1000 + seed).randint(8))
+    root = int(np.random.RandomState(1000 + seed).randint(hvd.size()))
     out = hvd.broadcast(x, root_rank=root, name=f"fz_bc_{seed}")
     _assert_exact(out, vals[root])
 

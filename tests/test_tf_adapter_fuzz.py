@@ -41,9 +41,9 @@ def test_fuzz_tf_allgather(tfhvd, n_workers, seed):
 
 
 @pytest.mark.parametrize("seed", range(8, 12))
-def test_fuzz_tf_broadcast(tfhvd, seed):
+def test_fuzz_tf_broadcast(tfhvd, n_workers, seed):
     vals, t = _draw(seed)
-    root = int(np.random.RandomState(2000 + seed).randint(8))
+    root = int(np.random.RandomState(2000 + seed).randint(n_workers))
     out = tfhvd.broadcast(t, root_rank=root, name=f"tfz_bc_{seed}")
     np.testing.assert_allclose(out.numpy(), vals)  # replicated: identity
 

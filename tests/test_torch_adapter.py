@@ -18,12 +18,6 @@ from horovod_tpu.runner import run
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.fixture(scope="module")
-def thvd(hvd):
-    import horovod_tpu.torch as thvd
-    return thvd
-
-
 # --- tensor collectives -----------------------------------------------------
 
 def test_allreduce_sum_and_average(thvd, n_workers):

@@ -1,0 +1,55 @@
+"""Randomized op x dtype x shape fuzz at the torch boundary:
+replicated torch tensors through the adapter must match references
+computed in torch (the torch analog of tests/test_tf_adapter_fuzz.py;
+single-process replicated semantics).  Covers allreduce (sync + async
+handle), allgather, and broadcast; in-place and grouped forms keep
+their targeted tests in test_torch_adapter.py."""
+
+import numpy as np
+import pytest
+import torch
+
+T_DTYPES = [torch.float32, torch.float64, torch.float16, torch.bfloat16,
+            torch.int32, torch.int64]
+
+
+def _draw(seed):
+    rng = np.random.RandomState(seed)
+    dtype = T_DTYPES[rng.randint(len(T_DTYPES))]
+    shape = tuple(int(rng.randint(1, 5))
+                  for _ in range(int(rng.randint(1, 4))))
+    vals = torch.tensor(rng.randint(0, 5, size=shape)).to(dtype)
+    return vals
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_torch_allreduce_sum(thvd, n_workers, seed):
+    t = _draw(seed)
+    out = thvd.allreduce(t, op=thvd.Sum, name=f"tzf_ar_{seed}")
+    assert out.dtype == t.dtype and out.shape == t.shape
+    assert torch.equal(out.double(), t.double() * n_workers)
+
+
+@pytest.mark.parametrize("seed", range(4, 8))
+def test_fuzz_torch_allreduce_async(thvd, n_workers, seed):
+    t = _draw(seed)
+    h = thvd.allreduce_async(t, op=thvd.Sum, name=f"tzf_as_{seed}")
+    out = thvd.synchronize(h)
+    assert torch.equal(out.double(), t.double() * n_workers)
+
+
+@pytest.mark.parametrize("seed", range(8, 12))
+def test_fuzz_torch_allgather(thvd, n_workers, seed):
+    t = _draw(seed)
+    out = thvd.allgather(t, name=f"tzf_ag_{seed}")
+    expected = torch.cat([t] * n_workers, dim=0)
+    assert out.shape == expected.shape
+    assert torch.equal(out.double(), expected.double())
+
+
+@pytest.mark.parametrize("seed", range(12, 15))
+def test_fuzz_torch_broadcast(thvd, n_workers, seed):
+    t = _draw(seed)
+    root = int(np.random.RandomState(3000 + seed).randint(n_workers))
+    out = thvd.broadcast(t, root_rank=root, name=f"tzf_bc_{seed}")
+    assert torch.equal(out.double(), t.double())  # replicated: identity
