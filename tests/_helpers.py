@@ -11,3 +11,19 @@ def sp_sharded(mesh, fn):
     return jax.jit(jax.shard_map(
         fn, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
         out_specs=P(None, "sp"), check_vma=False))
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port for a multi-process launch.
+
+    Fixed per-test ports collided (two tests shared 29567) and raced
+    with late-exiting workers from earlier launches; binding port 0
+    lets the kernel pick. The tiny close-to-use window is a far
+    smaller risk than cross-test collisions, and SO_REUSEADDR on the
+    coordination service side tolerates TIME_WAIT.
+    """
+    import socket
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

@@ -13,6 +13,8 @@ import os
 import numpy as np
 import pytest
 
+from _helpers import free_port
+
 from horovod_tpu.autotune import _CYCLE_GRID_MS, ParameterManager
 from horovod_tpu.config import Config
 
@@ -180,7 +182,7 @@ def test_negotiated_autotune_identical_across_processes():
             "HOROVOD_AUTOTUNE_MAX_SAMPLES": "3",
             "HOROVOD_AUTOTUNE_RETUNE_DROP": "0",
         },
-        port=29545)
+        port=free_port())
     by_rank = {r["rank"]: r for r in results}
     assert by_rank[0]["negotiated"] and by_rank[1]["negotiated"]
     assert by_rank[0]["thr"] == by_rank[1]["thr"]
@@ -261,7 +263,7 @@ def test_negotiated_autotune_survives_leader_join():
             "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "1",
             "HOROVOD_AUTOTUNE_RETUNE_DROP": "0",
         },
-        port=29567)
+        port=free_port())
     by_rank = {r["rank"]: r for r in results}
     assert by_rank[1]["neg"]                  # params were negotiated
     assert by_rank[0]["last"] == 1            # rank 1 joined last

@@ -12,6 +12,8 @@ import os
 import numpy as np
 import pytest
 
+from _helpers import free_port
+
 import helpers_runner
 from horovod_tpu.runner import run
 
@@ -34,7 +36,7 @@ def test_eager_cross_process_allreduce():
     """The engine's eager path does a REAL cross-process reduction:
     rank-dependent inputs, negotiated dispatch, lifted onto the mesh."""
     results = run(helpers_runner.eager_allreduce_fn, np=2, env=_env(),
-                  port=29521)
+                  port=free_port())
     by_rank = {r["rank"]: r for r in results}
     # sum: (r0+1) + (r1+1) = 3 everywhere
     assert by_rank[0]["sum"] == [3.0] * 4
@@ -49,7 +51,7 @@ def test_steady_state_hash_fast_path():
     """After the first full negotiation of a cycle signature, identical
     cycles take the hash-only round (response-cache bit-vector analog)."""
     results = run(helpers_runner.steady_state_fast_path_fn, np=2,
-                  env=_env(), port=29523)
+                  env=_env(), port=free_port())
     for r in results:
         assert r["fast"] >= 1, r
         assert r["full"] >= 1, r  # the first round was a full one
@@ -59,7 +61,7 @@ def test_late_tensor_waits_and_dispatches():
     """A tensor submitted 1.5s late on one process must not error or hang:
     the peer's entry is requeued until both are ready."""
     results = run(helpers_runner.late_tensor_fn, np=2, env=_env(),
-                  port=29525)
+                  port=free_port())
     for r in results:
         assert r["sum"] == [1.0] * 3  # 0 + 1
 
@@ -74,7 +76,7 @@ def test_divergent_tensor_diagnosed_not_hung():
             "HOROVOD_STALL_CHECK_TIME_SECONDS": "1",
             "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "4",
         }),
-        port=29527)
+        port=free_port())
     by_rank = {r["rank"]: r for r in results}
     # the common tensor dispatched fine on both
     assert by_rank[0]["common"] == [2.0] * 2
@@ -93,7 +95,7 @@ def test_shape_mismatch_is_divergence_error():
     results = run(
         helpers_runner.shape_mismatch_fn, np=2,
         env=_env({"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "10"}),
-        port=29529)
+        port=free_port())
     for r in results:
         assert r["error"] is not None
         assert "bad_tensor" in r["error"]
@@ -105,7 +107,7 @@ def test_join_uneven_batches():
     joins; process 0's 3rd allreduce proceeds with a zero contribution
     from the joined process; join() returns the last joiner's rank."""
     results = run(helpers_runner.join_uneven_fn, np=2, env=_env(),
-                  port=29531)
+                  port=free_port())
     by_rank = {r["rank"]: r for r in results}
     # batches 1-2: sum of (r0+1)*i + (r1+1)*i = 3i
     assert by_rank[0]["sums"][:2] == [3.0, 6.0]
@@ -124,7 +126,7 @@ def test_subset_process_set_does_not_wait_on_non_members():
     results = run(
         helpers_runner.subset_process_set_fn, np=2,
         env=_env({"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "20"}),
-        port=29535)
+        port=free_port())
     by_rank = {r["rank"]: r for r in results}
     assert by_rank[0]["sub"] == [1.0, 1.0]  # single-member sum
     assert by_rank[1]["sub"] is None
@@ -135,7 +137,7 @@ def test_reinit_cycle_negotiation_isolated():
     """init → shutdown → init: the new incarnation's negotiation must not
     read the previous incarnation's keys or leave markers."""
     results = run(helpers_runner.reinit_cycle_fn, np=2, env=_env(),
-                  port=29537)
+                  port=free_port())
     for r in results:
         assert r["vals"] == [[3.0, 3.0], [3.0, 3.0]]
 
@@ -160,7 +162,7 @@ def test_single_process_join_returns_size_minus_one(hvd):
 def test_barrier_holds_early_process():
     """The engine barrier is a real member rendezvous: the on-time process
     waits ~the straggler's delay before proceeding."""
-    results = run(helpers_runner.barrier_fn, np=2, env=_env(), port=29541)
+    results = run(helpers_runner.barrier_fn, np=2, env=_env(), port=free_port())
     by_rank = {r["rank"]: r for r in results}
     assert by_rank[0]["waited"] > 0.5   # held for the late process
     assert by_rank[1]["waited"] < 0.5   # straggler passes straight through
@@ -174,7 +176,7 @@ def test_hash_cache_lru_eviction_cross_process():
     bounded, counts evictions, and an evicted signature still reduces
     correctly when it recurs."""
     results = run(helpers_runner.cache_eviction_fn, np=2,
-                  env=_env({"HOROVOD_CACHE_CAPACITY": "2"}), port=29547)
+                  env=_env({"HOROVOD_CACHE_CAPACITY": "2"}), port=free_port())
     for r in results:
         assert r["sum"] == [3.0, 3.0]          # (1)+(2) both times
         assert r["capacity"] == 2
@@ -285,7 +287,7 @@ def test_allgather_object_cross_process():
     """hvd.allgather_object returns every process's object, ordered by
     process index, on all processes (reference: allgather_object)."""
     results = run(helpers_runner.allgather_object_fn, np=2, env=_env(),
-                  port=29549)
+                  port=free_port())
     expected = [{"rank": 0, "payload": [0]}, {"rank": 1, "payload": [1, 1]}]
     for r in results:
         assert r["objs"] == expected
@@ -297,7 +299,7 @@ def test_uneven_allgather_cross_process():
     sizes).  Both processes receive the concatenation of every worker's
     true rows, and the async submit stays non-blocking."""
     results = run(helpers_runner.uneven_allgather_fn, np=2, env=_env(),
-                  port=29559)
+                  port=free_port())
     expected = [[0.0, 1.0], [2.0, 3.0],
                 [100.0, 101.0], [102.0, 103.0], [104.0, 105.0]]
     expected2 = [[0.0], [1.0], [1.0]]
@@ -311,7 +313,7 @@ def test_join_with_float64_collective():
     with float64 (not a silently-downcast float32), so the two
     processes execute the same SPMD program."""
     results = run(helpers_runner.join_uneven_f64_fn, np=2, env=_env(),
-                  port=29561)
+                  port=free_port())
     by_rank = {r["rank"]: r for r in results}
     assert by_rank[0]["sums"][0] == [3.0, 3.0, 3.0]
     assert by_rank[1]["sums"] == [[3.0, 3.0, 3.0]]
@@ -324,7 +326,7 @@ def test_four_process_controller():
     subset groups, 4-way ragged allgather, and a 3-early-joiner join —
     all on one round-trip ordering (reference: test/parallel at -np 4)."""
     results = run(helpers_runner.four_process_fn, np=4, env=_env(),
-                  port=29563)
+                  port=free_port())
     assert len(results) == 4
     expected_ag = [0.0] + [1.0] * 2 + [2.0] * 3 + [3.0] * 4
     for r in results:
@@ -344,7 +346,7 @@ def test_mixed_op_storm_cross_process():
     agree and every value must be exact; the steady-state fast path must
     engage at least once across repeated signatures."""
     results = run(helpers_runner.mixed_op_storm_fn, np=2, env=_env(),
-                  port=29565)
+                  port=free_port())
     for r in results:
         assert r["ok"] == 30
         assert r["rounds"] >= 30
@@ -357,7 +359,7 @@ def test_negotiation_kv_ops_per_round_bounded():
     all peers in one RPC).  The old transport cost (N-1) polled gets per
     round plus (N-1) leave-marker gets per tick."""
     results = run(helpers_runner.kv_ops_per_round_fn, np=4, env=_env(),
-                  port=29567)
+                  port=free_port())
     assert len(results) == 4
     for r in results:
         assert r["rounds"] == 10, r
@@ -377,7 +379,7 @@ def test_controller_keys_cleaned_at_shutdown():
     hvdctl/ keys for the incarnation survive on the coordination service
     (the last process out subtree-deletes the namespace)."""
     results = run(helpers_runner.controller_shutdown_clean_fn, np=2,
-                  env=_env(), port=29569)
+                  env=_env(), port=free_port())
     for r in results:
         assert r["pre"] >= 1          # rounds really published keys
         assert r["leftover"] == [], r
@@ -388,7 +390,7 @@ def test_profiler_trace_contains_framework_spans(tmp_path):
     (hvd.NEGOTIATE / hvd.cycle) AND the fused-dispatch annotation, so
     framework phases correlate with XLA ops in a single Perfetto view."""
     results = run(helpers_runner.profiler_merged_trace_fn, np=2,
-                  env=_env({"TEST_PROF_DIR": str(tmp_path)}), port=29571)
+                  env=_env({"TEST_PROF_DIR": str(tmp_path)}), port=free_port())
     for r in results:
         assert r["negotiate"], r
         assert r["cycle"], r

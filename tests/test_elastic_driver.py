@@ -17,16 +17,12 @@ from pathlib import Path
 
 import pytest
 
+from _helpers import free_port
+
 from horovod_tpu.elastic import discovery, registration
 from horovod_tpu.elastic.driver import ElasticDriver
 from horovod_tpu.elastic.worker import HostUpdateResult
 from horovod_tpu.runner.rpc import JsonRpcServer, json_request
-
-
-def free_port():
-    with socket.socket() as s:
-        s.bind(("", 0))
-        return s.getsockname()[1]
 
 
 # --- discovery --------------------------------------------------------------

@@ -5,6 +5,8 @@ import os
 
 import numpy as np
 import pytest
+
+from _helpers import free_port
 import torch
 import torch.nn.functional as F
 
@@ -50,7 +52,7 @@ def test_torch_estimator_fit_predict(tmp_path):
         model=model,
         optimizer=lambda p: torch.optim.Adam(p, lr=5e-3),
         loss=F.mse_loss, epochs=6, batch_size=16, np=2,
-        store=store, run_id="fit1", env=_env(), port=29601)
+        store=store, run_id="fit1", env=_env(), port=free_port())
     fitted = est.fit(X, y)
     # loss decreased and every epoch logged
     assert len(fitted.history) == 6
@@ -84,7 +86,7 @@ def test_keras_estimator_fit_predict(tmp_path):
         model=model, optimizer={"class_name": "SGD",
                                 "config": {"learning_rate": 0.05}},
         loss="mse", epochs=4, batch_size=16, np=2, store=store,
-        run_id="kfit1", env=_env(), port=29611)
+        run_id="kfit1", env=_env(), port=free_port())
     fitted = est.fit(X, y)
     losses = fitted.history["loss"]
     assert len(losses) == 4 and losses[-1] < losses[0]
@@ -170,7 +172,7 @@ def test_lightning_estimator_functional_with_fake_lightning(tmp_path):
         env["PYTHONPATH"] = str(pkg) + ":" + env["PYTHONPATH"]
         est = LightningEstimator(fake_lm_model.LinearLM(), num_proc=2,
                                  epochs=5, batch_size=8, store=store,
-                                 env=env, port=29611)
+                                 env=env, port=free_port())
         fitted = est.fit(X, y)
         pred = fitted.predict(X)[:, 0]
         mse = float(((pred - y) ** 2).mean())
@@ -196,7 +198,7 @@ def test_torch_estimator_uneven_shards(tmp_path):
         model=model, optimizer=lambda p: torch.optim.SGD(p, lr=0.05),
         loss=F.mse_loss, epochs=3, batch_size=32, np=2,
         store=FilesystemStore(str(tmp_path)), run_id="uneven",
-        env=_env(), port=29612)
+        env=_env(), port=free_port())
     fitted = est.fit(X, y)
     assert len(fitted.history) == 3
     assert fitted.predict(X).shape == (127, 1)
@@ -277,7 +279,7 @@ def test_torch_estimator_fit_with_remote_store(tmp_path):
     est = TorchEstimator(
         model=model, optimizer=lambda p: torch.optim.SGD(p, lr=0.05),
         loss=F.mse_loss, epochs=2, batch_size=16, np=2,
-        store=store, run_id="rfit", env=_env(), port=29613)
+        store=store, run_id="rfit", env=_env(), port=free_port())
     fitted = est.fit(X, y)
     assert store.exists("rfit")
     standalone = load_model(store, "rfit")
@@ -301,7 +303,7 @@ def test_torch_estimator_validation_split(tmp_path):
     est = TorchEstimator(
         model=model, optimizer=lambda p: torch.optim.Adam(p, lr=5e-3),
         loss=F.mse_loss, epochs=5, batch_size=16, np=2,
-        store=store, run_id="vfit", env=_env(), port=29614,
+        store=store, run_id="vfit", env=_env(), port=free_port(),
         validation=0.25)
     fitted = est.fit(X, y)
     assert len(fitted.history) == 5
@@ -348,8 +350,8 @@ def test_torch_estimator_fit_from_parquet_matches_in_memory(tmp_path):
 
     ds = ParquetDataset(str(tmp_path / "train.parquet"),
                         features=["x0", "x1", "x2", "x3"], label="y")
-    from_disk = make_est("disk", 29615).fit(ds)
-    from_mem = make_est("mem", 29616).fit(X, y)
+    from_disk = make_est("disk", free_port()).fit(ds)
+    from_mem = make_est("mem", free_port()).fit(X, y)
     assert from_disk.history == from_mem.history
     assert from_disk.val_history == from_mem.val_history
     assert len(from_disk.history) == 3
@@ -392,8 +394,8 @@ def test_keras_estimator_fit_from_parquet(tmp_path):
 
     ds = ParquetDataset(str(tmp_path / "k.parquet"),
                         features=["x0", "x1"], label="y")
-    from_disk = make_est("kdisk", 29617).fit(ds)
-    from_mem = make_est("kmem", 29618).fit(X, y)
+    from_disk = make_est("kdisk", free_port()).fit(ds)
+    from_mem = make_est("kmem", free_port()).fit(X, y)
     assert from_disk.history["loss"] == from_mem.history["loss"]
     assert from_disk.history["loss"][-1] < from_disk.history["loss"][0]
 

@@ -9,6 +9,8 @@ import os
 
 import pytest
 
+from _helpers import free_port
+
 from horovod_tpu.runner import TpuExecutor
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -49,7 +51,7 @@ def _boom():
 
 
 def test_executor_pool_persistent_state():
-    with TpuExecutor(np=2, env=_env(), port=29551) as ex:
+    with TpuExecutor(np=2, env=_env(), port=free_port()) as ex:
         topo = ex.run(_topology)
         assert [t["rank"] for t in topo] == [0, 1]
         assert all(t["size"] == 2 for t in topo)
@@ -61,13 +63,13 @@ def test_executor_pool_persistent_state():
 
 
 def test_executor_task_failure_surfaces():
-    with TpuExecutor(np=2, env=_env(), port=29553) as ex:
+    with TpuExecutor(np=2, env=_env(), port=free_port()) as ex:
         with pytest.raises(RuntimeError, match="deliberate task failure"):
             ex.run(_boom)
 
 
 def test_executor_run_remote_fetch():
-    with TpuExecutor(np=2, env=_env(), port=29555) as ex:
+    with TpuExecutor(np=2, env=_env(), port=free_port()) as ex:
         t1 = ex.run_remote(_bump_counter)
         t2 = ex.run_remote(_bump_counter)
         assert ex.fetch(t1) == [1, 1]
@@ -89,7 +91,7 @@ def test_executor_startup_failure_cleans_up(tmp_path):
     control dir (review regression)."""
     bad_env = _env()
     bad_env["XLA_FLAGS"] = "--definitely-not-a-flag"
-    ex = TpuExecutor(np=2, env=bad_env, port=29557)
+    ex = TpuExecutor(np=2, env=bad_env, port=free_port())
     with pytest.raises(RuntimeError):
         ex.start(timeout_s=30)
     assert ex._procs is None and ex._tmp is None

@@ -7,6 +7,8 @@ import sys
 
 import pytest
 
+from _helpers import free_port
+
 from horovod_tpu.runner import parse_args
 from horovod_tpu.runner.hosts import (
     HostInfo, SlotAssignment, assign_slots, effective_hosts, parse_hostfile,
@@ -134,7 +136,7 @@ def test_run_api_two_process_topology():
     import helpers_runner
     from horovod_tpu.runner import run
     results = run(helpers_runner.topology_fn, np=2, env=_run_env(),
-                  port=29511)
+                  port=free_port())
     assert len(results) == 2
     assert [r["rank"] for r in results] == [0, 1]
     assert all(r["size"] == 2 for r in results)
@@ -145,7 +147,7 @@ def test_run_api_real_cross_process_collective():
     import helpers_runner
     from horovod_tpu.runner import run
     results = run(helpers_runner.cross_process_sum_fn, np=2, env=_run_env(),
-                  port=29513)
+                  port=free_port())
     # sum of 0*10 + 1*10 computed via a jitted global reduction
     assert all(r["sum"] == 10.0 for r in results)
     assert all(r["procs"] == 2 for r in results)
@@ -155,7 +157,7 @@ def test_run_api_worker_failure_propagates():
     import helpers_runner
     from horovod_tpu.runner import run
     with pytest.raises(RuntimeError, match="failed with exit code"):
-        run(helpers_runner.failing_fn, np=2, env=_run_env(), port=29515)
+        run(helpers_runner.failing_fn, np=2, env=_run_env(), port=free_port())
 
 
 def test_check_build_flag(capsys):

@@ -11,6 +11,8 @@ equivalence bar (VERDICT #3): a ``tf.function`` training loop through
 import numpy as np
 import pytest
 
+from _helpers import free_port
+
 tf = pytest.importorskip("tensorflow")
 
 import helpers_runner  # noqa: E402
@@ -183,7 +185,7 @@ def test_tf_two_process_tape_training_matches_single():
         "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
         "HOROVOD_CYCLE_TIME": "0.2",
     }
-    results = run(helpers_runner.tf_training_fn, np=2, env=env, port=29539)
+    results = run(helpers_runner.tf_training_fn, np=2, env=env, port=free_port())
     by_rank = {r["rank"]: r for r in results}
     np.testing.assert_allclose(by_rank[0]["w"], by_rank[1]["w"], atol=1e-6)
     # single-process full-batch reference
@@ -461,7 +463,7 @@ def test_tf_jit_compile_two_process():
         "HOROVOD_CYCLE_TIME": "0.2",
     }
     results = run(helpers_runner.tf_jit_collectives_fn, np=3, env=env,
-                  port=29547)
+                  port=free_port())
     assert not any(r.get("skipped") for r in results), \
         "bridge must build on this image"
     by_rank = {r["rank"]: r for r in results}
@@ -489,7 +491,7 @@ def test_tf_jit_compile_two_process_training_matches_single():
         "HOROVOD_CYCLE_TIME": "0.2",
     }
     results = run(helpers_runner.tf_jit_training_fn, np=2, env=env,
-                  port=29573)
+                  port=free_port())
     assert not any(r.get("skipped") for r in results)
     by_rank = {r["rank"]: r for r in results}
     np.testing.assert_allclose(by_rank[0]["w"], by_rank[1]["w"], atol=1e-6)
@@ -564,7 +566,7 @@ def test_tf_sparse_allreduce_two_process_ragged():
         "HOROVOD_CYCLE_TIME": "0.2",
     }
     results = run(helpers_runner.tf_sparse_allreduce_fn, np=2, env=env,
-                  port=29575)
+                  port=free_port())
     for r in results:
         # rank0 contributes rows {0:1, 1:2}, rank1 {1:10} -> summed
         np.testing.assert_allclose(r["dense"], [1.0, 12.0, 0.0, 0.0])

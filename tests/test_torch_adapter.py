@@ -10,6 +10,8 @@ import os
 
 import numpy as np
 import pytest
+
+from _helpers import free_port
 import torch
 
 import helpers_runner
@@ -182,7 +184,7 @@ def test_torch_two_process_training_matches_single():
         "HOROVOD_CYCLE_TIME": "0.2",
     }
     results = run(helpers_runner.torch_training_fn, np=2, env=env,
-                  port=29533)
+                  port=free_port())
     by_rank = {r["rank"]: r for r in results}
     # both processes end with identical params (same averaged gradients)
     for a, b in zip(by_rank[0]["params"], by_rank[1]["params"]):
@@ -317,7 +319,7 @@ def test_torch_reducescatter_two_process():
         "HOROVOD_CYCLE_TIME": "0.2",
     }
     results = run(helpers_runner.torch_reducescatter_fn, np=2, env=env,
-                  port=29543)
+                  port=free_port())
     by_rank = {r["rank"]: r for r in results}
     # reduction: arange(4) * (1 + 2) = [0, 3, 6, 9]; rank0 keeps [0, 3],
     # rank1 keeps [6, 9]
