@@ -25,5 +25,8 @@ def free_port() -> int:
     import socket
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind(("127.0.0.1", 0))
+        # wildcard bind: the services this allocates for (JsonRpcServer,
+        # coordination service) bind 0.0.0.0, so probing only loopback
+        # could hand out a port someone holds on a real interface
+        s.bind(("", 0))
         return s.getsockname()[1]

@@ -9,7 +9,6 @@ with a fake discovery script backed by a mutable hostfile — the reference's
 
 import json
 import os
-import socket
 import sys
 import threading
 import time

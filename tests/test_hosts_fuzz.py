@@ -1,0 +1,58 @@
+"""Property fuzz of host-major slot assignment (runner/hosts.py):
+for random host sets and -np draws, the §3.4 identity contract must
+hold — contiguous global ranks, host-major order, per-host local
+ranks, consistent cross ranks, honest overflow errors."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.runner.hosts import HostInfo, assign_slots
+
+
+def _hosts(rng):
+    n = int(rng.randint(1, 6))
+    return [HostInfo(f"h{i}", int(rng.randint(1, 5))) for i in range(n)]
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_assign_slots_invariants(seed):
+    rng = np.random.RandomState(seed)
+    hosts = _hosts(rng)
+    total = sum(h.slots for h in hosts)
+    np_ = int(rng.randint(1, total + 1))
+    slots = assign_slots(hosts, np_)
+
+    assert len(slots) == np_
+    assert [s.rank for s in slots] == list(range(np_))       # contiguous
+    assert all(s.size == np_ for s in slots)
+
+    # host-major: ranks grouped by host in input order, each group a
+    # contiguous local_rank run of exactly local_size slots
+    by_host = {}
+    for s in slots:
+        by_host.setdefault(s.hostname, []).append(s)
+    host_order = [h.hostname for h in hosts if h.hostname in by_host]
+    assert list(by_host) == host_order                       # input order
+    rank = 0
+    for cross_rank, hn in enumerate(host_order):
+        group = by_host[hn]
+        assert [s.local_rank for s in group] == list(range(len(group)))
+        assert all(s.local_size == len(group) for s in group)
+        assert all(s.cross_rank == cross_rank for s in group)
+        assert all(s.cross_size == len(host_order) for s in group)
+        assert [s.rank for s in group] == list(range(rank, rank + len(group)))
+        rank += len(group)
+
+    # no host exceeds its advertised slots
+    declared = {h.hostname: h.slots for h in hosts}
+    for hn, group in by_host.items():
+        assert len(group) <= declared[hn]
+
+
+@pytest.mark.parametrize("seed", range(12, 16))
+def test_fuzz_assign_slots_overflow_raises(seed):
+    rng = np.random.RandomState(seed)
+    hosts = _hosts(rng)
+    total = sum(h.slots for h in hosts)
+    with pytest.raises(ValueError, match="exceeds"):
+        assign_slots(hosts, total + int(rng.randint(1, 4)))
