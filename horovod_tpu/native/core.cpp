@@ -190,11 +190,19 @@ std::vector<std::vector<long long>> plan(const std::vector<Sig> &sigs,
                                          long long threshold) {
   std::vector<size_t> order(sigs.size());
   for (size_t i = 0; i < order.size(); ++i) order[i] = i;
-  // Deterministic total order: (bucket_key, name, submission index) — the
-  // invariant the reference's rank-0 negotiation exists to provide.
+  // Deterministic total order: (bucket_key, group-contiguity, name,
+  // submission index) — the invariant the reference's rank-0 negotiation
+  // exists to provide.  Grouped sigs sort contiguously (by group_id)
+  // ahead of ungrouped ones within a bucket key so a threshold flush can
+  // never split a group (group_table.cc all-or-nothing; mirrors
+  // ops/fusion.py plan_fusion).
   std::stable_sort(order.begin(), order.end(), [&](size_t x, size_t y) {
     int c = key_cmp(sigs[x], sigs[y]);
     if (c) return c < 0;
+    bool gx = sigs[x].group_id != -1, gy = sigs[y].group_id != -1;
+    if (gx != gy) return gx;  // grouped first
+    if (gx && sigs[x].group_id != sigs[y].group_id)
+      return sigs[x].group_id < sigs[y].group_id;
     c = sigs[x].name.compare(sigs[y].name);
     if (c) return c < 0;
     return x < y;

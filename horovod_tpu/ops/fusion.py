@@ -81,10 +81,18 @@ def plan_fusion(entries: Sequence[EntrySig],
     Grouped entries (same ``group_id``) always land in one bucket regardless
     of the threshold (reference: group_table.cc all-or-nothing fusion).
     Only allreduce fuses; other op types dispatch one bucket per entry.
+
+    Within a bucket key, grouped entries sort CONTIGUOUSLY (by group_id,
+    then name) ahead of ungrouped ones: an ungrouped entry whose name
+    interleaves a group's members must not sit between them, or a
+    threshold flush would split the group (all-or-nothing would break).
     """
-    order = sorted(range(len(entries)),
-                   key=lambda i: (entries[i].bucket_key(), entries[i].name,
-                                  i))
+    order = sorted(
+        range(len(entries)),
+        key=lambda i: (entries[i].bucket_key(),
+                       (0, entries[i].group_id)
+                       if entries[i].group_id != -1 else (1, 0),
+                       entries[i].name, i))
     buckets: List[List[int]] = []
     cur: List[int] = []
     cur_key: Optional[Tuple] = None

@@ -1,0 +1,69 @@
+"""Property fuzz of the fusion planner (ops/fusion.py plan_fusion):
+for random entry streams the bucket invariants must hold — every entry
+in exactly one bucket, group atomicity, homogeneous bucket keys, only
+allreduce fuses, threshold respected except for single-oversize/whole-
+group buckets, and the plan is a pure function of the (unordered)
+entry set (the cross-process determinism the negotiation relies on)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from _helpers import random_entry_sigs
+from horovod_tpu.ops.fusion import plan_fusion
+
+
+# seeds 53/132/388 reproduce the group-split planner bug (an ungrouped
+# same-key name interleaving a group under a tight threshold) against
+# the pre-fix (bucket_key, name) sort — verified by running the old
+# planner over seeds 0-399 with THIS generator's draw order; kept as
+# regressions for the contiguous-group sort
+@pytest.mark.parametrize("seed", list(range(10)) + [53, 132, 388])
+def test_fuzz_plan_fusion_invariants(seed):
+    rng = random.Random(seed)
+    entries = random_entry_sigs(rng, rng.randint(1, 40))
+    threshold = rng.choice([1, 1024, 64 << 10, 64 << 20])
+    plan = plan_fusion(entries, threshold)
+
+    # partition: every index exactly once
+    flat = [i for b in plan for i in b]
+    assert sorted(flat) == list(range(len(entries)))
+    assert all(b for b in plan)
+
+    for b in plan:
+        es = [entries[i] for i in b]
+        # homogeneous fusion key
+        assert len({e.bucket_key() for e in es}) == 1
+        # only allreduce fuses
+        if any(e.op_type != "allreduce" for e in es):
+            assert len(es) == 1
+        # group atomicity: a group's members all land in ONE bucket
+        # (checked globally below); within a bucket, threshold holds
+        # unless the bucket is a single entry or carries a group
+        nbytes = sum(e.nbytes for e in es)
+        has_group = any(e.group_id != -1 for e in es)
+        if len(es) > 1 and not has_group:
+            assert nbytes <= threshold
+
+    # group atomicity across buckets
+    for gid in {e.group_id for e in entries if e.group_id != -1}:
+        for psid in {e.process_set_id for e in entries}:
+            members = [i for i, e in enumerate(entries)
+                       if e.group_id == gid and e.op_type == "allreduce"
+                       and e.process_set_id == psid]
+            if not members:
+                continue
+            holding = [b for b in plan if any(i in b for i in members)]
+            assert len(holding) <= len(
+                {entries[i].bucket_key() for i in members})
+
+    # determinism under permutation: same entry SET -> same bucket
+    # contents (by name), independent of submission order
+    perm = list(np.random.RandomState(seed).permutation(len(entries)))
+    plan2 = plan_fusion([entries[i] for i in perm], threshold)
+    names1 = sorted(tuple(sorted(entries[i].name for i in b))
+                    for b in plan)
+    names2 = sorted(tuple(sorted(entries[perm[i]].name for i in b))
+                    for b in plan2)
+    assert names1 == names2

@@ -20,25 +20,7 @@ pytestmark = pytest.mark.skipif(
     core is None, reason="native core not built (no C++ toolchain)")
 
 
-def _random_sigs(rng, n):
-    sigs = []
-    for i in range(n):
-        op = rng.choice(["allreduce", "allreduce", "allreduce",
-                         "allgather", "broadcast", "alltoall"])
-        group = rng.choice([-1, -1, -1, 1, 2])
-        sigs.append(fusion.EntrySig(
-            name=f"tensor.{rng.randint(0, n)}.{i}",
-            op_type=op,
-            reduce_op=rng.choice(["average", "sum"]),
-            dtype=rng.choice(["float32", "bfloat16", "int32"]),
-            shape=(rng.randint(1, 2048), rng.choice([1, 8])),
-            process_set_id=rng.choice([0, 0, 0, 1]),
-            stacked=rng.random() < 0.2,
-            group_id=group if op == "allreduce" else -1,
-            prescale=rng.choice([None, None, 0.5]),
-            postscale=rng.choice([None, None, 2.0]),
-        ))
-    return sigs
+from _helpers import random_entry_sigs as _random_sigs
 
 
 @pytest.mark.parametrize("seed", range(20))
