@@ -25,6 +25,7 @@
 #include <cstring>
 #include <deque>
 #include <list>
+#include <map>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -192,17 +193,28 @@ std::vector<std::vector<long long>> plan(const std::vector<Sig> &sigs,
   for (size_t i = 0; i < order.size(); ++i) order[i] = i;
   // Deterministic total order: (bucket_key, group-contiguity, name,
   // submission index) — the invariant the reference's rank-0 negotiation
-  // exists to provide.  Grouped sigs sort contiguously (by group_id)
-  // ahead of ungrouped ones within a bucket key so a threshold flush can
-  // never split a group (group_table.cc all-or-nothing; mirrors
-  // ops/fusion.py plan_fusion).
+  // exists to provide.  Grouped sigs sort contiguously ahead of
+  // ungrouped ones within a bucket key so a threshold flush can never
+  // split a group (group_table.cc all-or-nothing), and groups order by
+  // their MINIMUM MEMBER NAME — never by group_id, which is a
+  // per-process counter (mirrors ops/fusion.py plan_fusion).
+  std::map<long long, const std::string *> group_min_name;
+  for (const Sig &s : sigs) {
+    if (s.group_id == -1) continue;
+    auto it = group_min_name.find(s.group_id);
+    if (it == group_min_name.end() || s.name < *it->second)
+      group_min_name[s.group_id] = &s.name;
+  }
   std::stable_sort(order.begin(), order.end(), [&](size_t x, size_t y) {
     int c = key_cmp(sigs[x], sigs[y]);
     if (c) return c < 0;
     bool gx = sigs[x].group_id != -1, gy = sigs[y].group_id != -1;
     if (gx != gy) return gx;  // grouped first
-    if (gx && sigs[x].group_id != sigs[y].group_id)
-      return sigs[x].group_id < sigs[y].group_id;
+    if (gx && sigs[x].group_id != sigs[y].group_id) {
+      c = group_min_name[sigs[x].group_id]->compare(
+          *group_min_name[sigs[y].group_id]);
+      if (c) return c < 0;
+    }
     c = sigs[x].name.compare(sigs[y].name);
     if (c) return c < 0;
     return x < y;

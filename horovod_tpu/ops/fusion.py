@@ -82,16 +82,26 @@ def plan_fusion(entries: Sequence[EntrySig],
     of the threshold (reference: group_table.cc all-or-nothing fusion).
     Only allreduce fuses; other op types dispatch one bucket per entry.
 
-    Within a bucket key, grouped entries sort CONTIGUOUSLY (by group_id,
-    then name) ahead of ungrouped ones: an ungrouped entry whose name
-    interleaves a group's members must not sit between them, or a
-    threshold flush would split the group (all-or-nothing would break).
+    Within a bucket key, grouped entries sort CONTIGUOUSLY ahead of
+    ungrouped ones: an ungrouped entry whose name interleaves a group's
+    members must not sit between them, or a threshold flush would split
+    the group (all-or-nothing would break).  Groups order by their
+    MINIMUM MEMBER NAME, never by ``group_id`` — group ids are
+    per-process counters (a joined process renumbers synthesized groups,
+    see engine join synthesis), and the whole point of this sort is an
+    identical plan on every process.
     """
+    group_min_name = {}
+    for e in entries:
+        if e.group_id != -1:
+            cur = group_min_name.get(e.group_id)
+            if cur is None or e.name < cur:
+                group_min_name[e.group_id] = e.name
     order = sorted(
         range(len(entries)),
         key=lambda i: (entries[i].bucket_key(),
-                       (0, entries[i].group_id)
-                       if entries[i].group_id != -1 else (1, 0),
+                       (0, group_min_name[entries[i].group_id])
+                       if entries[i].group_id != -1 else (1, ""),
                        entries[i].name, i))
     buckets: List[List[int]] = []
     cur: List[int] = []

@@ -67,3 +67,17 @@ def test_fuzz_plan_fusion_invariants(seed):
     names2 = sorted(tuple(sorted(entries[perm[i]].name for i in b))
                     for b in plan2)
     assert names1 == names2
+
+    # determinism under group-id RELABELING: group ids are per-process
+    # counters (a joined process renumbers synthesized groups), so the
+    # plan must depend only on which entries share a group, never on
+    # the id values — relabel every gid by a bijection and compare
+    import dataclasses
+    gids = sorted({e.group_id for e in entries if e.group_id != -1})
+    remap = {g: 1000 - k for k, g in enumerate(gids)}   # order-reversing
+    relabeled = [dataclasses.replace(
+        e, group_id=remap.get(e.group_id, -1)) for e in entries]
+    plan3 = plan_fusion(relabeled, threshold)
+    names3 = sorted(tuple(sorted(relabeled[i].name for i in b))
+                    for b in plan3)
+    assert names3 == names1

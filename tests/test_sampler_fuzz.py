@@ -1,0 +1,92 @@
+"""Property fuzz of the elastic sampler: random resize schedules must
+never drop a sample.  Simulates K workers (one ElasticSampler each,
+kept consistent the way elastic State.sync does), processing random
+batch counts between random resizes until the epoch completes; at
+every point the workers' views agree, and at the end every dataset
+index was processed."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.elastic.sampler import ElasticSampler
+
+
+def _fleet(n, size, shuffle, seed):
+    return [ElasticSampler(dataset_size=size, shuffle=shuffle, seed=seed,
+                           rank=r, num_replicas=n) for r in range(n)]
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_fuzz_sampler_no_drops_across_resizes(seed):
+    rng = np.random.RandomState(seed)
+    size = int(rng.randint(5, 60))
+    shuffle = bool(rng.randint(2))
+    bs = int(rng.randint(1, 4))
+    n = int(rng.randint(1, 5))
+    fleet = _fleet(n, size, shuffle, seed)
+
+    guard = 0
+    batch_idx = 0
+    while fleet[0].remaining_indices:
+        guard += 1
+        assert guard < 500, "epoch failed to converge"
+        # workers' padded orders must agree (same reset inputs)
+        pads = {tuple(s._padded) for s in fleet}
+        assert len(pads) == 1
+        # per-worker shards partition the padded order
+        together = [i for k in range(len(fleet[0]._local))
+                    for i in (s._local[k] for s in fleet
+                              if k < len(s._local))]
+        assert together == fleet[0]._padded
+
+        # process a few batches (possibly none, forcing a pure resize)
+        steps = int(rng.randint(0, 3))
+        max_batches = len(fleet[0]._local) // bs
+        steps = min(steps, max_batches)
+        for _ in range(steps):
+            for s in fleet:
+                s.record_batch(batch_idx, bs)
+            batch_idx += 1
+
+        if rng.randint(2):  # resize
+            n = int(rng.randint(1, 5))
+            state = fleet[0].state_dict()
+            fleet = _fleet(n, size, shuffle, seed)
+            for s in fleet:
+                s.load_state_dict(state)
+            batch_idx = 0
+        elif steps == max_batches and max_batches > 0:
+            # local shard exhausted without a resize: epoch boundary for
+            # what remains — reset continues the epoch on the same fleet
+            for s in fleet:
+                s.reset()
+            batch_idx = 0
+        elif steps == 0 and max_batches == 0:
+            # tail smaller than one batch: drain it via record_indices
+            for s in fleet:
+                s.record_indices(s.remaining_indices)
+            for s in fleet:
+                s.reset()
+            batch_idx = 0
+
+    processed = {frozenset(s.processed_indices) for s in fleet}
+    assert len(processed) == 1                       # workers agree
+    assert set(fleet[0].processed_indices) == set(range(size))  # no drops
+
+
+@pytest.mark.parametrize("seed", range(10, 14))
+def test_fuzz_sampler_state_roundtrip_preserves_plan(seed):
+    rng = np.random.RandomState(seed)
+    size = int(rng.randint(5, 40))
+    s = ElasticSampler(dataset_size=size, shuffle=True, seed=seed,
+                       rank=0, num_replicas=2)
+    s.record_batch(0, min(3, len(s._local)))
+    clone = ElasticSampler(dataset_size=size, shuffle=True, seed=seed,
+                           rank=0, num_replicas=2)
+    clone.load_state_dict(s.state_dict())
+    # record_batch marks processed but does not re-plan until reset()
+    # (reference semantics: the iterator runs on mid-epoch); the
+    # round-trip contract is equality of the RESET plan
+    s.reset()
+    assert list(clone) == list(s)
+    assert clone.remaining_indices == s.remaining_indices
