@@ -1,0 +1,110 @@
+# hvdlint: skip-file — intentionally-buggy teaching example, linted only
+# via `--include-skipped` (tests/test_analysis.py runs it end-to-end).
+"""ANTIPATTERNS — every classic Horovod deadlock/divergence bug in one file.
+
+DO NOT RUN THIS.  It is a non-runnable teaching example and the
+end-to-end fixture for the static analyzer (docs/analysis.md):
+
+    python -m horovod_tpu.analysis --include-skipped examples/antipatterns.py
+
+flags one finding per bug below.  Each function names the rule it trips
+and the comment shows the corrected form.  The bugs:
+
+* HVD001 — collective under a rank-conditional branch (deadlock)
+* HVD002 — DistributedOptimizer with no initial-state broadcast
+           (silent divergence)
+* HVD003 — collective on an except / early-return path
+* HVD004 — grouped collective fed from a set (order divergence)
+* HVD005 — one tensor name, two signatures
+* HVD006 — eager collective inside a jit-traced function
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+
+
+def rank_conditional_allreduce(metrics):
+    # HVD001: only rank 0 submits the allreduce; every other rank
+    # deadlocks waiting for it.  Fix: hoist the collective out of the
+    # branch — all ranks submit, rank 0 alone uses the result.
+    if hvd.rank() == 0:
+        metrics = hvd.allreduce(metrics, name="metrics")
+    return metrics
+
+
+def missing_initial_broadcast():
+    # HVD002: no broadcast_parameters after init() — each worker trains
+    # from its own random init and the replicas silently diverge.
+    # Fix: params = hvd.broadcast_parameters(params, root_rank=0)
+    params = {"w": jnp.ones((8, 8))}
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3),
+                                   axis_name=hvd.worker_axis())
+    return params, opt.init(params)
+
+
+def collective_in_except(opt, params, opt_state):
+    # HVD003 (except path): the barrier only runs on ranks where the
+    # step raised; the others never reach it.  Fix: re-raise (or signal
+    # through an allreduced flag that every rank submits).
+    try:
+        return opt.update(params, opt_state)
+    except Exception:
+        hvd.barrier()
+        return opt_state
+
+
+def collective_after_early_return(metrics):
+    # HVD003 (early return): non-zero ranks leave the function, so the
+    # allreduce below only runs on rank 0 and the peers deadlock.
+    # Fix: every rank reduces; rank 0 alone does the rank-0-only work.
+    if hvd.rank() != 0:
+        return None
+    return hvd.allreduce(metrics, name="final.metrics")
+
+
+def grouped_from_set(params):
+    # HVD004: set iteration order differs across processes, so the
+    # grouped members submit in different orders and the fusion plans
+    # diverge.  Fix: iterate sorted(grads) instead.
+    grads = {k: jnp.zeros_like(v) for k, v in params.items()}
+    return hvd.grouped_allreduce([grads[k] for k in set(grads)])
+
+
+def reused_tensor_name(metrics):
+    # HVD005: one name, two signatures — negotiation matches requests by
+    # name and would pair an allreduce with an allgather.  Fix: give
+    # each collective its own name.
+    s = hvd.allreduce(metrics, name="stats", op=hvd.Sum)
+    g = hvd.allgather(metrics, name="stats")
+    return s, g
+
+
+def eager_collective_in_jit(metrics):
+    # HVD006: the eager API blocks on the background engine thread,
+    # which can never progress while the trace holds the main thread.
+    # Fix: use the in-jit form, hvd.allreduce_p(x, hvd.worker_axis()).
+    @jax.jit
+    def train_step(x):
+        return hvd.allreduce(x, name="jit.grads")
+
+    return train_step(metrics)
+
+
+def main():
+    hvd.init()
+    metrics = jnp.zeros((4,))
+    metrics = rank_conditional_allreduce(metrics)
+    params, opt_state = missing_initial_broadcast()
+    reused_tensor_name(metrics)
+    grouped_from_set(params)
+    collective_after_early_return(metrics)
+    eager_collective_in_jit(metrics)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    raise SystemExit("antipatterns.py is a non-runnable teaching example; "
+                     "read the comments instead")

@@ -1,0 +1,39 @@
+"""hvdlint: static collective-consistency and lock-order analysis.
+
+The runtime controller (``ops/controller.py``) diagnoses rank divergence
+only after a job has stalled for ``HOROVOD_STALL_CHECK_TIME`` seconds on
+real hardware.  The classic Horovod failure classes — collectives under
+rank-conditional branches, missing initial-state broadcast, mismatched
+submission order — are statically detectable in user scripts, so this
+package catches them in CI instead of on a TPU reservation.
+
+Two engines:
+
+* **user-script rules** (``user_rules.py``): HVD001–HVD006, AST checks
+  over training scripts for the deadlock/divergence hazard taxonomy.
+* **framework self-check** (``lock_order.py``): HVD101–HVD103, a
+  lock-acquisition-graph race detector over our own threaded modules
+  (engine, controller, elastic driver, stall inspector).
+
+CLI::
+
+    python -m horovod_tpu.analysis horovod_tpu/ examples/
+    tools/hvdlint --format=json path/to/train.py
+
+Suppress a finding with ``# hvdlint: disable=HVD001`` on (or directly
+above) the flagged line, or skip a whole file with
+``# hvdlint: skip-file``.  See docs/analysis.md for the rule catalog.
+
+The analysis modules themselves import only the standard library (no
+jax, no numpy), so a lint run costs AST parsing, nothing more.  (The
+``horovod_tpu`` parent package still imports its runtime deps on entry,
+so the CLI needs the normal install — as in CI.)
+"""
+
+from .report import Finding, RULES, iter_suppressions  # noqa: F401
+from .cli import analyze_paths, analyze_source, main  # noqa: F401
+
+__all__ = [
+    "Finding", "RULES", "analyze_paths", "analyze_source", "main",
+    "iter_suppressions",
+]

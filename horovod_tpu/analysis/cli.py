@@ -1,0 +1,158 @@
+"""hvdlint CLI: file collection, engine dispatch, output formatting."""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from typing import Iterable, List, Optional, Sequence
+
+from . import lock_order, user_rules
+from .report import (Finding, RULES, apply_suppressions, file_skipped,
+                     iter_suppressions)
+
+_SKIP_DIRS = {"__pycache__", ".git", "build", "dist", "node_modules",
+              ".pytest_cache", ".hypothesis"}
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in _SKIP_DIRS
+                                 and not d.startswith("."))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        else:
+            out.append(p)
+    return out
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   include_skipped: bool = False,
+                   engines: Iterable[str] = ("user", "locks"),
+                   ) -> List[Finding]:
+    """Run the selected engines over one module's source."""
+    if not include_skipped and file_skipped(source):
+        return []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [Finding("HVD000", path, exc.lineno or 1, exc.offset or 0,
+                        f"could not parse: {exc.msg}")]
+    findings: List[Finding] = []
+    if "user" in engines:
+        findings.extend(user_rules.check_module(tree, path))
+    if "locks" in engines:
+        findings.extend(lock_order.check_module(tree, path))
+    findings = apply_suppressions(findings, iter_suppressions(source))
+    findings.sort(key=lambda f: (f.line, f.col, f.code))
+    return findings
+
+
+def analyze_paths(paths: Sequence[str], include_skipped: bool = False,
+                  engines: Iterable[str] = ("user", "locks"),
+                  select: Optional[Sequence[str]] = None,
+                  ) -> List[Finding]:
+    """Walk ``paths`` (files or directories) and analyze every .py file."""
+    return analyze_files(collect_files(paths), include_skipped, engines,
+                         select)
+
+
+def analyze_files(files: Sequence[str], include_skipped: bool = False,
+                  engines: Iterable[str] = ("user", "locks"),
+                  select: Optional[Sequence[str]] = None,
+                  ) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in files:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+        except OSError as exc:
+            findings.append(Finding("HVD000", path, 1, 0,
+                                    f"could not read: {exc}"))
+            continue
+        findings.extend(analyze_source(
+            source, path, include_skipped=include_skipped, engines=engines))
+    if select:
+        wanted = {c.strip().upper() for c in select}
+        findings = [f for f in findings if f.code in wanted]
+    return findings
+
+
+def _list_rules() -> str:
+    lines = ["hvdlint rules:"]
+    for code, (title, fixit) in sorted(RULES.items()):
+        lines.append(f"  {code}  {title}")
+        lines.append(f"         fix: {fixit}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis",
+        description="hvdlint: static collective-consistency and lock-order "
+                    "analyzer for horovod_tpu training scripts")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to analyze")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--select", metavar="CODES",
+                        help="comma-separated rule codes to report "
+                             "(default: all)")
+    parser.add_argument("--engine", choices=("user", "locks", "all"),
+                        default="all",
+                        help="user-script rules, framework lock-order "
+                             "self-check, or both (default)")
+    parser.add_argument("--include-skipped", action="store_true",
+                        help="analyze files marked '# hvdlint: skip-file' "
+                             "(for linting the lint fixtures themselves)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+    if not args.paths:
+        parser.error("no paths given (try: horovod_tpu/ examples/)")
+
+    engines = ("user", "locks") if args.engine == "all" else (args.engine,)
+    select = None
+    if args.select:
+        select = [c.strip().upper() for c in args.select.split(",")
+                  if c.strip()]
+        unknown = [c for c in select if c not in RULES]
+        if unknown:
+            # a typo'd code would otherwise filter out every finding and
+            # exit 0 — fatal in a CI gate
+            parser.error(f"unknown rule code(s): {', '.join(unknown)} "
+                         f"(see --list-rules)")
+    files = collect_files(args.paths)
+    findings = analyze_files(files, engines=engines,
+                             include_skipped=args.include_skipped,
+                             select=select)
+
+    if args.format == "json":
+        print(json.dumps({"findings": [f.as_dict() for f in findings],
+                          "count": len(findings)}, indent=2))
+    else:
+        for f in findings:
+            print(f.format_text())
+        n_files = len(files)
+        if findings:
+            print(f"\nhvdlint: {len(findings)} finding(s) in {n_files} "
+                  f"file(s) — see docs/analysis.md for the rule catalog; "
+                  f"suppress a false positive with "
+                  f"'# hvdlint: disable=<code>'")
+        else:
+            print(f"hvdlint: {n_files} file(s) clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,143 @@
+"""Finding type, rule catalog, and suppression-comment handling."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+# code -> (title, default fix-it).  The fix-it is the actionable half of
+# every message: what to change so the job cannot deadlock/diverge.
+RULES: Dict[str, Tuple[str, str]] = {
+    "HVD000": (
+        "file could not be parsed",
+        "fix the syntax error so the analyzer (and Python) can read it"),
+    "HVD001": (
+        "collective inside a rank-conditional branch",
+        "hoist the collective out of the `if hvd.rank()` branch — every "
+        "process must submit the same collectives in the same order, or "
+        "the other ranks deadlock waiting for this one"),
+    "HVD002": (
+        "DistributedOptimizer without an initial-state broadcast",
+        "call hvd.broadcast_parameters(...) (or broadcast_object / an "
+        "elastic State) after hvd.init() so every worker starts from "
+        "rank 0's weights; without it the replicas silently diverge"),
+    "HVD003": (
+        "collective on a path not executed by all ranks",
+        "move the collective out of the except/early-return path — an "
+        "exception or early exit taken on a subset of ranks leaves the "
+        "others blocked in the collective"),
+    "HVD004": (
+        "grouped collective fed from an unordered iteration",
+        "sort the tensors (e.g. sorted(names)) before the grouped call — "
+        "set/dict iteration order can differ across processes, and the "
+        "fusion planner requires an identical submission order everywhere"),
+    "HVD005": (
+        "tensor name reused with a different op/reduction",
+        "give each distinct collective its own name= — the negotiation "
+        "matches tensors by name, and one name with two signatures "
+        "diverges the ranks"),
+    "HVD006": (
+        "blocking collective/sync inside a jit-traced function",
+        "use the in-jit forms (hvd.allreduce_p etc.) inside jax.jit / "
+        "shard_map — the eager API blocks on the background engine, which "
+        "deadlocks under tracing; handles cannot be awaited in-graph"),
+    "HVD101": (
+        "inconsistent lock acquisition order",
+        "acquire these locks in one global order everywhere (document it "
+        "next to the lock definitions) — opposite nestings on two threads "
+        "deadlock"),
+    "HVD102": (
+        "condition wait while holding another lock",
+        "release the outer lock before cv.wait() — wait() only releases "
+        "the condition's own lock, so the notifier blocks on the outer "
+        "lock and neither thread proceeds"),
+    "HVD103": (
+        "re-acquiring a non-reentrant lock already held",
+        "use threading.RLock, or restructure so the inner path does not "
+        "re-enter — a plain Lock self-deadlocks on re-acquisition"),
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    code: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    @property
+    def fixit(self) -> str:
+        return RULES.get(self.code, ("", ""))[1]
+
+    def format_text(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.code} "
+                f"{self.message}\n    fix: {self.fixit}")
+
+    def as_dict(self) -> dict:
+        return {"code": self.code, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "fixit": self.fixit}
+
+
+_DISABLE_RE = re.compile(r"#\s*hvdlint:\s*disable=([A-Za-z0-9,\s]+)")
+_SKIP_FILE_RE = re.compile(r"#\s*hvdlint:\s*skip-file\b")
+
+
+def _comments(source: str):
+    """Yield ``(lineno, text, own_line)`` for every REAL comment token.
+
+    Tokenizing (instead of regexing raw source) keeps markers quoted in
+    docstrings or string literals inert — otherwise a file merely
+    *documenting* ``# hvdlint: skip-file`` would disable its own
+    analysis.  Tokenization errors (bad encoding, unterminated strings)
+    yield whatever comments were seen before the error; the parse error
+    itself is reported separately as HVD000.
+    """
+    import io
+    import tokenize
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                line_prefix = tok.line[:tok.start[1]]
+                yield tok.start[0], tok.string, line_prefix.strip() == ""
+    except (tokenize.TokenError, IndentationError, SyntaxError,
+            ValueError):
+        return
+
+
+def file_skipped(source: str) -> bool:
+    """True when the file opts out wholesale (``# hvdlint: skip-file``)."""
+    return any(_SKIP_FILE_RE.search(text) for _, text, _ in _comments(source))
+
+
+def iter_suppressions(source: str) -> Dict[int, Set[str]]:
+    """Map line number -> suppressed codes for that line.
+
+    ``# hvdlint: disable=HVD001`` at the end of a line suppresses that
+    line; on a line of its own it suppresses the next line (matching the
+    ``# noqa`` idiom users already know).  ``disable=all`` suppresses
+    every rule.
+    """
+    out: Dict[int, Set[str]] = {}
+    for lineno, text, own_line in _comments(source):
+        m = _DISABLE_RE.search(text)
+        if not m:
+            continue
+        codes = {c.strip().upper() for c in m.group(1).split(",")
+                 if c.strip()}
+        out.setdefault(lineno + 1 if own_line else lineno,
+                       set()).update(codes)
+    return out
+
+
+def apply_suppressions(findings: Iterable[Finding],
+                       suppressions: Dict[int, Set[str]]) -> List[Finding]:
+    kept = []
+    for f in findings:
+        codes = suppressions.get(f.line, set())
+        if "ALL" in codes or f.code.upper() in codes:
+            continue
+        kept.append(f)
+    return kept

@@ -197,23 +197,49 @@ std::vector<std::vector<long long>> plan(const std::vector<Sig> &sigs,
   // ungrouped ones within a bucket key so a threshold flush can never
   // split a group (group_table.cc all-or-nothing), and groups order by
   // their MINIMUM MEMBER NAME — never by group_id, which is a
-  // per-process counter (mirrors ops/fusion.py plan_fusion).
-  std::map<long long, const std::string *> group_min_name;
-  for (const Sig &s : sigs) {
+  // per-process counter (mirrors ops/fusion.py plan_fusion).  Two
+  // groups can share a minimum member name (grouped submissions expand
+  // to name.0/name.1, so two groups under one explicit name= collide);
+  // the tie breaks on the group's full sorted member-name tuple so tied
+  // groups stay contiguous instead of interleaving by bare name.
+  // the sorted member tuple IS the ordering key: its first element is
+  // the minimum member name, the rest break ties.  Identical tuples
+  // (the same name= submitted twice in one cycle) order by first
+  // submission index — the same cross-process contract the controller
+  // uses to pair duplicate tokens (instance k with peer instance k).
+  std::map<long long, std::vector<const std::string *>> group_names;
+  std::map<long long, size_t> group_first;
+  for (size_t i = 0; i < sigs.size(); ++i) {
+    const Sig &s = sigs[i];
     if (s.group_id == -1) continue;
-    auto it = group_min_name.find(s.group_id);
-    if (it == group_min_name.end() || s.name < *it->second)
-      group_min_name[s.group_id] = &s.name;
+    group_names[s.group_id].push_back(&s.name);
+    group_first.emplace(s.group_id, i);
   }
+  for (auto &kv : group_names)
+    std::sort(kv.second.begin(), kv.second.end(),
+              [](const std::string *a, const std::string *b) {
+                return *a < *b;
+              });
+  auto names_cmp = [&](long long gx, long long gy) {
+    const auto &a = group_names[gx], &b = group_names[gy];
+    for (size_t i = 0; i < a.size() && i < b.size(); ++i) {
+      int c = a[i]->compare(*b[i]);
+      if (c) return c;
+    }
+    if (a.size() != b.size()) return a.size() < b.size() ? -1 : 1;
+    return 0;
+  };
   std::stable_sort(order.begin(), order.end(), [&](size_t x, size_t y) {
     int c = key_cmp(sigs[x], sigs[y]);
     if (c) return c < 0;
     bool gx = sigs[x].group_id != -1, gy = sigs[y].group_id != -1;
     if (gx != gy) return gx;  // grouped first
     if (gx && sigs[x].group_id != sigs[y].group_id) {
-      c = group_min_name[sigs[x].group_id]->compare(
-          *group_min_name[sigs[y].group_id]);
+      c = names_cmp(sigs[x].group_id, sigs[y].group_id);
       if (c) return c < 0;
+      size_t fx = group_first[sigs[x].group_id];
+      size_t fy = group_first[sigs[y].group_id];
+      if (fx != fy) return fx < fy;
     }
     c = sigs[x].name.compare(sigs[y].name);
     if (c) return c < 0;

@@ -89,19 +89,33 @@ def plan_fusion(entries: Sequence[EntrySig],
     MINIMUM MEMBER NAME, never by ``group_id`` — group ids are
     per-process counters (a joined process renumbers synthesized groups,
     see engine join synthesis), and the whole point of this sort is an
-    identical plan on every process.
+    identical plan on every process.  Two groups CAN share a minimum
+    member name (grouped submissions expand to ``name.0``, ``name.1``,
+    so two groups submitted under one explicit ``name=`` collide), so
+    the tie breaks on the group's full sorted member-name tuple — still
+    cross-process stable, and it keeps each group contiguous instead of
+    interleaving the tied groups' members by bare name.
     """
-    group_min_name = {}
-    for e in entries:
+    group_names: Dict[int, List[str]] = {}
+    group_first: Dict[int, int] = {}
+    for idx, e in enumerate(entries):
         if e.group_id != -1:
-            cur = group_min_name.get(e.group_id)
-            if cur is None or e.name < cur:
-                group_min_name[e.group_id] = e.name
+            group_names.setdefault(e.group_id, []).append(e.name)
+            group_first.setdefault(e.group_id, idx)
+    # the sorted member tuple IS the ordering key: its first element is
+    # the minimum member name, and the remaining elements break ties.
+    # Two groups with IDENTICAL member tuples (the same name= submitted
+    # twice in one cycle) order by first submission index — the same
+    # cross-process contract the controller's counts-based negotiation
+    # uses to pair duplicate tokens (instance k with every peer's
+    # instance k), so the plan still matches on every process.
+    group_key = {g: (tuple(sorted(names)), group_first[g])
+                 for g, names in group_names.items()}
     order = sorted(
         range(len(entries)),
         key=lambda i: (entries[i].bucket_key(),
-                       (0, group_min_name[entries[i].group_id])
-                       if entries[i].group_id != -1 else (1, ""),
+                       (0,) + group_key[entries[i].group_id]
+                       if entries[i].group_id != -1 else (1, (), -1),
                        entries[i].name, i))
     buckets: List[List[int]] = []
     cur: List[int] = []

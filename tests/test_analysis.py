@@ -1,0 +1,673 @@
+"""hvdlint unit + end-to-end tests (analysis package, docs/analysis.md).
+
+Each rule gets a fixture that triggers it, a near-miss that must stay
+clean, and a suppression-comment check; the framework self-check must
+run clean over horovod_tpu/ itself (that clean run is CI stage 8).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.analysis import analyze_paths, analyze_source
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(src, **kw):
+    return [f.code for f in analyze_source(src, "fixture.py", **kw)]
+
+
+# ---------------------------------------------------------------------------
+# HVD001 — collective inside a rank-conditional branch
+# ---------------------------------------------------------------------------
+
+def test_hvd001_rank_branch():
+    src = """
+import horovod_tpu as hvd
+if hvd.rank() == 0:
+    hvd.allreduce(x)
+"""
+    assert codes(src) == ["HVD001"]
+
+
+def test_hvd001_through_rank_variable():
+    src = """
+import horovod_tpu as hvd
+r, n = hvd.rank(), hvd.size()
+if r == 0:
+    hvd.barrier()
+"""
+    assert codes(src) == ["HVD001"]
+
+
+def test_hvd001_local_rank_and_ternary():
+    src = """
+import horovod_tpu as hvd
+x = hvd.broadcast(t, 0) if hvd.local_rank() == 0 else t
+"""
+    assert codes(src) == ["HVD001"]
+
+
+def test_hvd001_clean_print_under_rank():
+    # rank-gated logging is the idiom every example uses — never flagged
+    src = """
+import horovod_tpu as hvd
+if hvd.rank() == 0:
+    print("loss", loss)
+hvd.allreduce(x)
+"""
+    assert codes(src) == []
+
+
+def test_hvd001_size_branch_is_uniform():
+    # size() is identical on every process: branching on it is safe
+    src = """
+import horovod_tpu as hvd
+n = hvd.size()
+if n < 2:
+    hvd.allreduce(x)
+"""
+    assert codes(src) == []
+
+
+def test_hvd001_thread_join_not_confused():
+    # ``join`` only counts on a horovod alias — never str/thread join
+    src = """
+import horovod_tpu as hvd
+if hvd.rank() == 0:
+    worker.join()
+    s = ",".join(names)
+"""
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD002 — DistributedOptimizer without initial-state broadcast
+# ---------------------------------------------------------------------------
+
+def test_hvd002_missing_broadcast():
+    src = """
+import horovod_tpu as hvd
+hvd.init()
+opt = hvd.DistributedOptimizer(base, axis_name="w")
+"""
+    assert codes(src) == ["HVD002"]
+
+
+def test_hvd002_clean_with_broadcast_parameters():
+    src = """
+import horovod_tpu as hvd
+hvd.init()
+params = hvd.broadcast_parameters(params, root_rank=0)
+opt = hvd.DistributedOptimizer(base, axis_name="w")
+"""
+    assert codes(src) == []
+
+
+def test_hvd002_clean_with_elastic_state():
+    src = """
+import horovod_tpu as hvd
+hvd.init()
+opt = hvd.DistributedOptimizer(base, axis_name="w")
+state = hvd.elastic.TorchState(model=m, optimizer=opt, epoch=0)
+"""
+    assert codes(src) == []
+
+
+def test_hvd002_no_init_no_finding():
+    # a library module defining helpers around DistributedOptimizer is
+    # not a training script
+    src = """
+import horovod_tpu as hvd
+def make_opt(base):
+    return hvd.DistributedOptimizer(base, axis_name="w")
+"""
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD003 — collective on a path not executed by all ranks
+# ---------------------------------------------------------------------------
+
+def test_hvd003_collective_in_except():
+    src = """
+import horovod_tpu as hvd
+try:
+    step()
+except Exception:
+    hvd.allreduce(x)
+"""
+    assert codes(src) == ["HVD003"]
+
+
+def test_hvd003_after_rank_early_return():
+    src = """
+import horovod_tpu as hvd
+def save(x):
+    if hvd.rank() != 0:
+        return None
+    return hvd.broadcast(x, 0)
+"""
+    assert codes(src) == ["HVD003"]
+
+
+def test_hvd003_clean_reraise_and_uniform_return():
+    src = """
+import horovod_tpu as hvd
+def f(x):
+    if hvd.size() < 2:
+        return x
+    try:
+        step()
+    except Exception:
+        raise
+    return hvd.allreduce(x)
+"""
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD004 — grouped collective fed from unordered iteration
+# ---------------------------------------------------------------------------
+
+def test_hvd004_set_literal_and_comprehension():
+    src = """
+import horovod_tpu as hvd
+hvd.grouped_allreduce([g[k] for k in set(names)])
+"""
+    assert codes(src) == ["HVD004"]
+    src2 = """
+import horovod_tpu as hvd
+hvd.grouped_allgather({a, b})
+"""
+    assert codes(src2) == ["HVD004"]
+
+
+def test_hvd004_sorted_is_clean():
+    src = """
+import horovod_tpu as hvd
+hvd.grouped_allreduce([g[k] for k in sorted(set(names))])
+hvd.grouped_allreduce(list(tensors))
+"""
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD005 — tensor name reused with a different signature
+# ---------------------------------------------------------------------------
+
+def test_hvd005_name_reuse_across_ops():
+    src = """
+import horovod_tpu as hvd
+hvd.allreduce(x, name="t", op=hvd.Sum)
+hvd.allgather(y, name="t")
+"""
+    assert codes(src) == ["HVD005"]
+
+
+def test_hvd005_name_reuse_different_reduce_op():
+    src = """
+import horovod_tpu as hvd
+hvd.allreduce(x, name="t", op=hvd.Sum)
+hvd.allreduce(y, name="t", op=hvd.Average)
+"""
+    assert codes(src) == ["HVD005"]
+
+
+def test_hvd005_consistent_reuse_is_clean():
+    # same call site submitting the same signature every step is the
+    # steady-state response-cache pattern — fine
+    src = """
+import horovod_tpu as hvd
+hvd.allreduce(x, name="t", op=hvd.Sum)
+hvd.allreduce(y, name="t", op=hvd.Sum)
+"""
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD006 — blocking collective/sync inside a jit-traced function
+# ---------------------------------------------------------------------------
+
+def test_hvd006_eager_collective_under_jit_decorator():
+    src = """
+import jax
+import horovod_tpu as hvd
+@jax.jit
+def step(x):
+    return hvd.allreduce(x)
+"""
+    assert codes(src) == ["HVD006"]
+
+
+def test_hvd006_function_passed_to_jit_and_handle_sync():
+    src = """
+import jax
+import horovod_tpu as hvd
+def step(x):
+    h = hvd.allreduce_async(x)
+    return h.synchronize()
+step_c = jax.jit(step)
+"""
+    found = codes(src)
+    assert found == ["HVD006", "HVD006"]  # the submit and the sync
+
+
+def test_hvd006_in_jit_forms_are_clean():
+    src = """
+import jax
+import horovod_tpu as hvd
+@jax.jit
+def step(x):
+    return hvd.allreduce_p(x, "workers")
+"""
+    assert codes(src) == []
+
+
+def test_hvd006_eager_outside_jit_is_clean():
+    src = """
+import horovod_tpu as hvd
+def step(x):
+    return hvd.allreduce(x).block_until_ready()
+"""
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD101/HVD102/HVD103 — lock-order self-check engine
+# ---------------------------------------------------------------------------
+
+LOCK_ORDER_BAD = """
+import threading
+class Engine:
+    def __init__(self):
+        self._queue_lock = threading.Lock()
+        self._table_lock = threading.Lock()
+    def submit(self):
+        with self._queue_lock:
+            with self._table_lock:
+                pass
+    def drain(self):
+        with self._table_lock:
+            with self._queue_lock:
+                pass
+"""
+
+
+def test_hvd101_opposite_lock_orders():
+    assert codes(LOCK_ORDER_BAD) == ["HVD101"]
+
+
+def test_hvd101_consistent_order_is_clean():
+    src = """
+import threading
+class Engine:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def submit(self):
+        with self._a:
+            with self._b:
+                pass
+    def drain(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+    assert codes(src) == []
+
+
+def test_hvd101_through_intraclass_call():
+    # drain() holds _b and calls _push(), which takes _a: an order edge
+    # the per-method view alone would miss
+    src = """
+import threading
+class Engine:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def _push(self):
+        with self._a:
+            pass
+    def submit(self):
+        with self._a:
+            with self._b:
+                pass
+    def drain(self):
+        with self._b:
+            self._push()
+"""
+    assert codes(src) == ["HVD101"]
+
+
+def test_hvd102_wait_holding_second_lock():
+    src = """
+import threading
+class Engine:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._cv = threading.Condition()
+    def drain(self):
+        with self._state_lock:
+            with self._cv:
+                self._cv.wait()
+"""
+    assert codes(src) == ["HVD102"]
+
+
+def test_hvd102_wait_on_own_lock_is_clean():
+    # the engine's own pattern: Condition(self._lock); waiting while
+    # holding only the condition's underlying lock is the correct idiom
+    src = """
+import threading
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+    def drain(self):
+        with self._cv:
+            self._cv.wait(timeout=0.1)
+"""
+    assert codes(src) == []
+
+
+def test_hvd103_reacquire_plain_lock():
+    src = """
+import threading
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+    def submit(self):
+        with self._cv:
+            with self._lock:
+                pass
+"""
+    assert codes(src) == ["HVD103"]
+
+
+def test_hvd103_rlock_reentry_is_clean():
+    src = """
+import threading
+class Engine:
+    def __init__(self):
+        self._lock = threading.RLock()
+    def submit(self):
+        with self._lock:
+            self._push()
+    def _push(self):
+        with self._lock:
+            pass
+"""
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression comments + skip-file
+# ---------------------------------------------------------------------------
+
+def test_suppression_same_line():
+    src = """
+import horovod_tpu as hvd
+if hvd.rank() == 0:
+    hvd.allreduce(x)  # hvdlint: disable=HVD001
+"""
+    assert codes(src) == []
+
+
+def test_suppression_previous_line_and_all():
+    src = """
+import horovod_tpu as hvd
+if hvd.rank() == 0:
+    # hvdlint: disable=all
+    hvd.allreduce(x)
+"""
+    assert codes(src) == []
+
+
+def test_suppression_wrong_code_keeps_finding():
+    src = """
+import horovod_tpu as hvd
+if hvd.rank() == 0:
+    hvd.allreduce(x)  # hvdlint: disable=HVD002
+"""
+    assert codes(src) == ["HVD001"]
+
+
+def test_lock_rule_suppression():
+    # the finding anchors at the first inner acquisition (submit's
+    # ``with self._table_lock:``); the disable goes there
+    src = LOCK_ORDER_BAD.replace(
+        "            with self._table_lock:",
+        "            with self._table_lock:  # hvdlint: disable=HVD101")
+    assert codes(src) == []
+
+
+def test_skip_file_pragma():
+    src = "# hvdlint: skip-file\nimport horovod_tpu as hvd\n" \
+          "if hvd.rank() == 0:\n    hvd.allreduce(x)\n"
+    assert codes(src) == []
+    assert codes(src, include_skipped=True) == ["HVD001"]
+
+
+def test_syntax_error_reports_hvd000():
+    assert codes("def broken(:\n") == ["HVD000"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: our own tree is clean, the antipatterns fixture is not
+# ---------------------------------------------------------------------------
+
+def test_self_check_clean_on_horovod_tpu():
+    # the lock-order engine over every framework module: CI stage 8's
+    # core guarantee, pinned here so a lock regression fails fast
+    findings = analyze_paths([os.path.join(REPO, "horovod_tpu")],
+                             engines=("locks",))
+    assert findings == [], [f.format_text() for f in findings]
+
+
+def test_full_lint_clean_on_framework_and_examples():
+    findings = analyze_paths([os.path.join(REPO, "horovod_tpu"),
+                              os.path.join(REPO, "examples")])
+    assert findings == [], [f.format_text() for f in findings]
+
+
+def test_antipatterns_fixture_trips_every_user_rule():
+    path = os.path.join(REPO, "examples", "antipatterns.py")
+    # skip-file honored by default (CI stage 8 stays green) ...
+    assert analyze_paths([path]) == []
+    # ... and every documented antipattern fires under --include-skipped
+    found = [f.code for f in analyze_paths([path], include_skipped=True)]
+    assert sorted(set(found)) == [
+        "HVD001", "HVD002", "HVD003", "HVD004", "HVD005", "HVD006"]
+
+
+def test_cli_json_output_and_exit_codes():
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis", "--format=json",
+         "--include-skipped", os.path.join("examples", "antipatterns.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["count"] >= 6
+    for f in payload["findings"]:
+        assert f["code"] and f["fixit"] and f["line"] > 0
+
+    clean = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis",
+         os.path.join("examples", "antipatterns.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+
+# ---------------------------------------------------------------------------
+# review regressions: markers in strings, foreign jits, bare init
+# ---------------------------------------------------------------------------
+
+def test_skip_file_inside_docstring_is_inert():
+    # documenting the pragma must not disable analysis of the file
+    src = '''
+"""Opt out with `# hvdlint: skip-file` if you must."""
+import horovod_tpu as hvd
+if hvd.rank() == 0:
+    hvd.allreduce(x)
+'''
+    assert codes(src) == ["HVD001"]
+
+
+def test_disable_inside_string_literal_is_inert():
+    src = """
+import horovod_tpu as hvd
+HELP = "# hvdlint: disable=HVD001"
+if hvd.rank() == 0:
+    hvd.allreduce(x)  # the string above must not suppress this
+"""
+    assert codes(src) == ["HVD001"]
+
+
+def test_analyzer_own_files_are_not_skipped():
+    # the analysis package documents the pragmas in docstrings; those
+    # mentions must not opt its own files out of CI stage 8
+    from horovod_tpu.analysis.report import file_skipped
+    for mod in ("__init__.py", "report.py", "cli.py", "user_rules.py"):
+        path = os.path.join(REPO, "horovod_tpu", "analysis", mod)
+        with open(path) as f:
+            assert not file_skipped(f.read()), mod
+
+
+def test_foreign_jit_decorators_do_not_trip_hvd006():
+    # numba.jit / tf.function compile the python body where the eager
+    # API works; only jax tracing counts — and generic .wait() is never
+    # flagged in modules that do not import horovod at all
+    src = """
+import numba
+@numba.jit
+def f(x):
+    ev.wait()
+    torch.cuda.synchronize()
+    return x
+"""
+    assert codes(src) == []
+    src2 = """
+import jax
+@jax.jit
+def f(x):
+    ev.wait()
+    return x
+"""
+    assert codes(src2) == []  # no horovod import -> receiver unprovable
+
+
+def test_hvd006_via_jax_submodule_and_bare_import():
+    src = """
+from jax import jit
+import horovod_tpu as hvd
+@jit
+def step(x):
+    return hvd.allreduce(x)
+"""
+    assert codes(src) == ["HVD006"]
+
+
+def test_hvd002_with_bare_init_import():
+    src = """
+from horovod_tpu import init, DistributedOptimizer
+init()
+opt = DistributedOptimizer(base, axis_name="w")
+"""
+    assert codes(src) == ["HVD002"]
+
+
+def test_match_case_bodies_are_walked():
+    src = """
+import horovod_tpu as hvd
+match mode:
+    case "train":
+        if hvd.rank() == 0:
+            hvd.allreduce(x)
+"""
+    assert codes(src) == ["HVD001"]
+    # rank-dependent match subject makes every case rank-conditional
+    src2 = """
+import horovod_tpu as hvd
+match hvd.rank():
+    case 0:
+        hvd.barrier()
+"""
+    assert codes(src2) == ["HVD001"]
+    # lock engine sees nestings inside case bodies too
+    src3 = """
+import threading
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def f(self, mode):
+        match mode:
+            case "x":
+                with self._a:
+                    with self._b:
+                        pass
+    def g(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+    assert codes(src3) == ["HVD101"]
+
+
+def test_hvd004_aliased_bare_import():
+    src = """
+from horovod_tpu import grouped_allreduce as ga
+ga([g[k] for k in set(g)])
+"""
+    assert codes(src) == ["HVD004"]
+
+
+def test_hvd002_not_satisfied_by_foreign_broadcast():
+    # an unrelated .broadcast()/State() must not count as the initial
+    # sync — only provably-horovod calls move HVD002 state
+    src = """
+import horovod_tpu as hvd
+hvd.init()
+udp_sock.broadcast(msg)
+app = State()
+opt = hvd.DistributedOptimizer(base, axis_name="w")
+"""
+    assert codes(src) == ["HVD002"]
+
+
+def test_hvd002_not_triggered_by_foreign_distributed_optimizer():
+    src = """
+import horovod_tpu as hvd
+import deepspeed
+hvd.init()
+opt = deepspeed.DistributedOptimizer(base)
+"""
+    assert codes(src) == []
+
+
+def test_hvd005_async_variant_shares_base_op():
+    # allreduce and allreduce_async are the same negotiated op; a shared
+    # name across them is the steady-state pattern, not a conflict
+    src = """
+import horovod_tpu as hvd
+hvd.allreduce(x, name="t", op=hvd.Sum)
+hvd.allreduce_async(y, name="t", op=hvd.Sum)
+"""
+    assert codes(src) == []
+
+
+def test_cli_rejects_unknown_select_codes():
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis", "--select=HVD01",
+         os.path.join("examples", "mnist.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+    assert "unknown rule code" in proc.stderr

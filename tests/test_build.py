@@ -24,5 +24,8 @@ def test_wheel_builds_and_carries_the_package(tmp_path):
     assert any(n == "horovod_tpu/__init__.py" for n in names)
     assert any(n.startswith("horovod_tpu/native/_hvd_core") for n in names)
     assert any(n.startswith("horovod_tpu/runner/") for n in names)
+    # the static analyzer ships in the wheel (CI stage 8 runs it from
+    # the installed tree on user machines too)
+    assert any(n == "horovod_tpu/analysis/__init__.py" for n in names)
     meta = [n for n in names if n.endswith("entry_points.txt")]
     assert meta, names

@@ -100,3 +100,60 @@ def test_zero_capacity_disables_cache():
     cache = ResponseCache(capacity=0)
     cache.put([sig("a")], [[0]])
     assert cache.get([sig("a")]) is None
+
+
+def test_group_min_name_tie_breaks_on_member_tuple():
+    # Two groups CAN share a minimum member name: grouped submissions
+    # expand to name.0/name.1, so two groups under one explicit name=
+    # collide on the minimum.  The tie must break on the full sorted
+    # member-name tuple (cross-process stable) and keep each group
+    # contiguous — interleaving by bare name would let a threshold
+    # flush split a group (all-or-nothing would break).
+    entries = [sig("g.0", group=1), sig("g.2", group=1),
+               sig("g.0", group=2), sig("g.1", group=2),
+               sig("solo")]
+    for threshold in (1, 40, 1 << 20):
+        plan = plan_fusion(entries, threshold)
+        for bucket in plan:
+            groups = {entries[i].group_id for i in bucket}
+            if groups & {1, 2}:
+                # a bucket holding grouped entries holds whole groups
+                for g in groups & {1, 2}:
+                    members = [i for i, e in enumerate(entries)
+                               if e.group_id == g]
+                    assert set(members) <= set(bucket)
+    # ("g.0","g.1") < ("g.0","g.2"): group 2 sorts first, deterministically
+    tight = plan_fusion(entries, 1)
+    assert tight[0] == [2, 3] and tight[1] == [0, 1]
+
+
+def test_group_tie_break_native_parity():
+    from horovod_tpu.native import loader
+    core = loader.load()
+    if core is None:
+        import pytest
+        pytest.skip("native core not built")
+    entries = [sig("g.0", group=1), sig("g.2", group=1),
+               sig("g.0", group=2), sig("g.1", group=2),
+               sig("solo")]
+    for threshold in (1, 40, 1 << 20):
+        assert core.plan_fusion_sigs(entries, threshold) == \
+            plan_fusion(entries, threshold)
+
+
+def test_identical_group_tuples_stay_atomic_in_submission_order():
+    # Two equal-size grouped submissions under ONE explicit name= expand
+    # to identical member tuples (g.0, g.1).  The final tie-break is
+    # first submission index — the same contract negotiation uses to
+    # pair duplicate tokens — so each group must stay whole and the
+    # first-submitted group dispatches first.
+    entries = [sig("g.0", group=1), sig("g.1", group=1),
+               sig("g.0", group=2), sig("g.1", group=2)]
+    assert plan_fusion(entries, 40) == [[0, 1], [2, 3]]
+    assert plan_fusion(entries, 1 << 20) == [[0, 1, 2, 3]]
+    from horovod_tpu.native import loader
+    core = loader.load()
+    if core is not None:
+        for threshold in (40, 1 << 20):
+            assert core.plan_fusion_sigs(entries, threshold) == \
+                plan_fusion(entries, threshold)

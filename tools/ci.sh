@@ -5,29 +5,45 @@
 #   bash tools/ci.sh [--quick]
 #
 # Stages:
-#   1. package: wheel + sdist build (no isolation - deps are baked in),
-#               then install the wheel into a scratch --target dir and
-#               run an eager-collectives smoke from OUTSIDE the repo
-#               (catches wheels that build but don't ship runnable code)
-#   2. native:  build the C++ core in place, run its parity tests
-#   3. purepy:  the HOROVOD_TPU_NATIVE_CORE=0 fallback paths
-#   4. noctl:   single-process semantics with the controller disabled
-#   5. full:    the whole suite (skipped with --quick)
+#   1. package: wheel + sdist build (no isolation - deps are baked in).
+#      dist/ artifacts are BUILD OUTPUTS, rebuilt fresh here every run —
+#      they are not committed to git (they went stale against planner
+#      fixes once; see CHANGES.md).
+#   2. wheel install smoke: install the wheel into a scratch --target dir
+#      and run an eager-collectives smoke from OUTSIDE the repo (catches
+#      wheels that build but don't ship runnable code)
+#   3. sdist install smoke: same, building from source (skipped --quick)
+#   4. native:  build the C++ core in place, run its parity tests
+#   5. purepy:  the HOROVOD_TPU_NATIVE_CORE=0 fallback paths
+#   6. noctl:   single-process semantics with the controller disabled
+#   7. full:    the whole suite (skipped with --quick)
+#   8. hvdlint: static collective-consistency + lock-order analysis over
+#      the framework and examples (docs/analysis.md)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/5 package: wheel + sdist =="
+echo "== 1/8 package: wheel + sdist =="
 rm -rf dist/
 python -m build --no-isolation --outdir dist/ . > /tmp/ci_build.log 2>&1 \
   || { tail -30 /tmp/ci_build.log; exit 1; }
 ls -l dist/
 
-echo "== 1b/5 wheel install smoke (scratch target, run from /tmp) =="
+echo "== 2/8 wheel install smoke (scratch target, run from /tmp) =="
 WHEEL_TGT=$(mktemp -d)
 trap 'rm -rf "$WHEEL_TGT"' EXIT
 REPO_DIR="$(pwd)"
 
-dist_smoke() {  # $1 = a wheel or sdist under dist/
+dist_smoke() {  # $1 = a wheel or sdist under dist/ (exactly one)
+  if [ "$#" -ne 1 ]; then
+    # the caller passes a glob: more than one match means stale
+    # artifacts are lying around and we could smoke-test the wrong one
+    echo "dist_smoke: expected exactly one artifact, got $#: $*" >&2
+    exit 1
+  fi
+  if [ ! -f "$1" ]; then
+    echo "dist_smoke: no such artifact: $1" >&2
+    exit 1
+  fi
   rm -rf "$WHEEL_TGT"/*
   pip install --no-deps --no-build-isolation --quiet \
     --target "$WHEEL_TGT" "$1"
@@ -57,24 +73,28 @@ PYEOF
 
 dist_smoke dist/*.whl
 if [ "${1:-}" != "--quick" ]; then
-  echo "== 1c/5 sdist install smoke (builds from source) =="
+  echo "== 3/8 sdist install smoke (builds from source) =="
   dist_smoke dist/*.tar.gz
 fi
 
-echo "== 2/5 native core build + parity tests =="
+echo "== 4/8 native core build + parity tests =="
 python setup.py build_ext --inplace > /tmp/ci_native.log 2>&1 \
   || { tail -30 /tmp/ci_native.log; exit 1; }
 python -m pytest tests/test_native_core.py -q
 
-echo "== 3/5 pure-python fallback (native core disabled) =="
+echo "== 5/8 pure-python fallback (native core disabled) =="
 HOROVOD_TPU_NATIVE_CORE=0 python -m pytest \
   tests/test_basics.py tests/test_fusion.py -q
 
-echo "== 4/5 controller disabled (single-process semantics) =="
+echo "== 6/8 controller disabled (single-process semantics) =="
 HOROVOD_TPU_CONTROLLER=0 python -m pytest tests/test_basics.py -q
 
 if [ "${1:-}" != "--quick" ]; then
-  echo "== 5/5 full suite =="
+  echo "== 7/8 full suite =="
   python -m pytest tests/ -q
 fi
+
+echo "== 8/8 hvdlint static analysis =="
+python -m horovod_tpu.analysis horovod_tpu/ examples/
+
 echo "CI matrix: all stages green"
