@@ -62,6 +62,9 @@ from .optim import (  # noqa: F401
 )
 
 from . import elastic  # noqa: F401
+# deterministic fault injection (docs/env.md "Chaos engineering"); pure
+# stdlib, already loaded by the RPC layer's injection points
+from . import chaos  # noqa: F401
 
 
 def __getattr__(name):

@@ -16,4 +16,5 @@ from .runner import run  # noqa: F401
 from .sampler import ElasticSampler  # noqa: F401
 from .discovery import (  # noqa: F401
     HostDiscovery, HostDiscoveryScript, FixedHostDiscovery,
+    NotifiedPreemptionDiscovery,
 )

@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from .. import chaos as _chaos
 from ..runner import spawn
 from ..runner import secret as _secret
 from ..runner.hosts import HostInfo, assign_slots
@@ -91,6 +92,12 @@ class ElasticDriver:
         self.verbose = verbose
         self.network_interface = network_interface
         self.registry = registration.WorkerStateRegistry(blacklist_threshold)
+        # hosts_updated pushes are retried: a lost notification leaves an
+        # incumbent training on the stale epoch until its own collective
+        # failure detection fires — the leader-join flake (see
+        # tests/test_chaos.py leader-join regression).  Kept small so a
+        # genuinely dead worker can't stall the re-form loop for long.
+        self.notify_retries = 2
 
         self._lock = threading.Lock()
         # serializes discover→apply sequences: concurrent reform requests
@@ -239,6 +246,11 @@ class ElasticDriver:
     def _handle_assignment(self, payload):
         wid = int(payload["worker_id"])
         min_epoch = int(payload.get("min_epoch", 0))
+        if _chaos.ACTIVE:
+            # delay = a slow assignment reply; error/drop = a reply the
+            # worker's poll loop must absorb
+            _chaos.fire("elastic.assignment", worker_id=wid,
+                        min_epoch=min_epoch)
         release = None
         with self._lock:
             if self._epoch < min_epoch:
@@ -493,6 +505,10 @@ class ElasticDriver:
         # epoch behind every re-form (user-set values win)
         env.setdefault("HOROVOD_ELASTIC_INIT_TIMEOUT",
                        str(max(5, int(self.start_timeout))))
+        if _chaos.ACTIVE:
+            _chaos.fire("elastic.spawn", worker_id=wid,
+                        hostname=slot.hostname, rank=slot.rank,
+                        epoch=epoch)
         proc = self._launch(slot, coord_addr, coord_port, env)
         with self._lock:
             self._workers[wid] = _Worker(wid, slot, proc, epoch)
@@ -505,14 +521,38 @@ class ElasticDriver:
             prefix_output=True, base_env=env)[0]
 
     def _notify_workers(self, targets, update_res: int):
+        """Push ``hosts_updated`` to every registered worker, in
+        parallel.  Retried (notify_retries, jittered backoff): a lost
+        push strands the worker on the stale epoch until its own failure
+        detection — the leader-join flake.  Parallel + a short
+        per-attempt timeout keep the worst case (black-holed workers
+        that swallow packets without RST) bounded by ONE retry chain,
+        not one per worker — this runs under _reform_lock, and a slow
+        push here would stall reform requests and the monitor."""
         ts = time.time()
-        for wid, (addr, port) in targets:
+
+        def push(wid, addr, port):
             try:
+                # idempotent=False: a lost-REPLY retry must not deliver
+                # the update twice — a duplicate landing after the
+                # worker's reset would re-arm its host-message queue and
+                # trigger a spurious HostsUpdatedInterrupt
                 json_request(addr, port, "hosts_updated",
                              {"timestamp": ts, "res": update_res},
-                             timeout=5.0)
+                             timeout=2.0, retries=self.notify_retries,
+                             idempotent=False)
             except Exception:  # noqa: BLE001 - worker may be mid-restart
-                logger.debug("notify worker %d failed", wid, exc_info=True)
+                logger.warning("could not notify worker %d of host "
+                               "update; relying on its failure detection",
+                               wid, exc_info=True)
+
+        threads = [threading.Thread(target=push, args=(wid, addr, port),
+                                    name=f"hvd-notify-{wid}", daemon=True)
+                   for wid, (addr, port) in targets]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
 
     # --- monitoring loop ---------------------------------------------------
 
@@ -529,10 +569,17 @@ class ElasticDriver:
                 else HostUpdateResult.REMOVED)
 
     def run(self) -> int:
-        # wait for enough capacity to start
+        # wait for enough capacity to start; a transient discovery flake
+        # here must not crash the driver before the job ever forms — the
+        # start_timeout already bounds how long we keep trying
         deadline = time.monotonic() + self.start_timeout
         while True:
-            hosts = self._discover()
+            try:
+                hosts = self._discover()
+            except Exception:  # noqa: BLE001 - startup discovery flake
+                logger.warning("host discovery failed (startup); "
+                               "retrying", exc_info=True)
+                hosts = {}
             if self._total_slots(hosts) >= self.min_np:
                 break
             if time.monotonic() > deadline:

@@ -18,6 +18,7 @@ import socket
 import time
 from typing import Optional
 
+from .. import chaos as _chaos
 from ..runner.rpc import JsonRpcServer, json_request
 
 logger = logging.getLogger("horovod_tpu")
@@ -63,8 +64,14 @@ def fetch_assignment(min_epoch: Optional[int] = None,
     deadline = time.monotonic() + timeout
     while True:
         try:
+            if _chaos.ACTIVE:
+                _chaos.fire("worker.poll", worker_id=wid, min_epoch=want)
+            # retries=0: this loop IS the retry policy (deadline-bounded
+            # polling); stacking the transport's backoff under it would
+            # only skew the poll cadence
             reply = json_request(ep[0], ep[1], "assignment",
-                                 {"worker_id": wid, "min_epoch": want})
+                                 {"worker_id": wid, "min_epoch": want},
+                                 retries=0)
         except Exception:  # noqa: BLE001 - transient RPC failure (driver
             # busy re-forming / network blip): the deadline absorbs it
             logger.debug("assignment poll failed; retrying", exc_info=True)
@@ -92,9 +99,12 @@ def request_reform():
     if ep is None or wid is None:
         return
     try:
+        # retries=1: this sits on the collective-failure recovery path —
+        # a long retry chain against an unreachable driver would delay
+        # re-rendezvous more than a second request_reform ever could
         json_request(ep[0], ep[1], "request_reform",
                      {"worker_id": wid, "seen_epoch": _last_epoch},
-                     timeout=10.0)
+                     timeout=10.0, retries=1)
     except Exception:  # noqa: BLE001
         logger.debug("reform request failed", exc_info=True)
 
@@ -113,8 +123,15 @@ def record_running():
     if ep is None or wid is None:
         return
     try:
+        if _chaos.ACTIVE:
+            # crash here = the worker dying between rendezvous and its
+            # running report (the churn/failure classification boundary)
+            _chaos.fire("worker.running", worker_id=wid,
+                        epoch=_last_epoch)
         # carry the epoch this worker rendezvoused into so the driver can
-        # drop reports that raced with a newer re-form
+        # drop reports that raced with a newer re-form.  Retried: a lost
+        # running report would leave a later real crash of this worker
+        # misclassified as rendezvous churn (never fed to the blacklist).
         json_request(ep[0], ep[1], "running",
                      {"worker_id": wid, "epoch": _last_epoch},
                      timeout=5.0)
@@ -129,10 +146,17 @@ def record_result(status: str):
     if ep is None or wid is None:
         return
     try:
+        # idempotent=False: a FAILURE report that is retried (or chaos-
+        # duplicated) after reaching the handler once must not count the
+        # host failure twice toward the blacklist — the server dedupes
+        # on the per-call token
+        # bounded timeout: this is a dying worker's best-effort goodbye;
+        # a black-holed driver must not pin the exit for 4 x 30s
         json_request(ep[0], ep[1], "result",
                      {"worker_id": wid, "status": status,
                       "hostname": os.environ.get("HOROVOD_HOSTNAME",
-                                                 socket.gethostname())})
+                                                 socket.gethostname())},
+                     timeout=5.0, idempotent=False)
     except Exception:  # noqa: BLE001 - driver may already be gone
         logger.debug("result report failed", exc_info=True)
 

@@ -53,6 +53,7 @@ import dataclasses
 import hashlib
 import json
 import logging
+import random
 import threading
 import time
 from collections import Counter, OrderedDict
@@ -60,6 +61,7 @@ from typing import Dict, List, Optional, Tuple
 
 import jax
 
+from .. import chaos as _chaos
 from ..exceptions import HorovodInternalError, StallError
 
 logger = logging.getLogger("horovod_tpu")
@@ -97,11 +99,45 @@ def _native_core():
     return _NATIVE
 
 
+_KV_SET_ATTEMPTS = 3
+_KV_SET_BACKOFF_S = 0.05
+_KV_SET_MAX_BACKOFF_S = 0.5
+_kv_jitter = random.Random()
+
+
 def _kv_set(client, key: str, value: str):
-    try:
-        client.key_value_set(key, value, allow_overwrite=True)
-    except TypeError:  # older jax without allow_overwrite
-        client.key_value_set(key, value)
+    """KV publish with bounded jittered retry (the RPC client's backoff
+    shape, via ``runner.rpc.jittered_backoff_s``, at KV-scale constants:
+    negotiation rounds poll at 0.25s, so seconds-long waits would stall
+    the cycle more than a re-raise would).
+
+    A set is an idempotent overwrite (``allow_overwrite=True``), so
+    retrying a transient coordination-service error is always safe; a
+    failure that persists past the attempts propagates and surfaces as a
+    collective failure (the elastic layer's recovery path).
+    """
+    for attempt in range(_KV_SET_ATTEMPTS):
+        try:
+            if _chaos.ACTIVE:
+                _chaos.fire("kv.set", key=key, attempt=attempt)
+            try:
+                client.key_value_set(key, value, allow_overwrite=True)
+            except TypeError:  # older jax without allow_overwrite
+                client.key_value_set(key, value)
+            return
+        except Exception:  # noqa: BLE001 - transient service error
+            if attempt == _KV_SET_ATTEMPTS - 1:
+                raise
+            # lazy import on the retry path only: module scope would
+            # pull horovod_tpu.runner (api/launch) into controller's
+            # import chain and risk a partial-init cycle via runtime
+            from ..runner.rpc import jittered_backoff_s
+            delay = jittered_backoff_s(attempt, _KV_SET_BACKOFF_S,
+                                       _KV_SET_MAX_BACKOFF_S, _kv_jitter)
+            logger.debug("kv set %s failed; retry %d/%d in %.2fs", key,
+                         attempt + 1, _KV_SET_ATTEMPTS - 1, delay,
+                         exc_info=True)
+            time.sleep(delay)
 
 
 @dataclasses.dataclass
@@ -568,8 +604,16 @@ class Controller:
         while True:
             with self._lock:
                 self.kv_dir_gets += 1
+            stale = False
+            if _chaos.ACTIVE:
+                try:
+                    act = _chaos.fire("kv.dir_get", dir=dirkey, seq=seq)
+                except Exception:  # noqa: BLE001 - injected transient
+                    act, stale = None, True   # read failed: no data
+                stale = stale or (act is not None and act.kind == "stale")
             try:
-                entries = client.key_value_dir_get(dirkey)
+                entries = ([] if stale
+                           else client.key_value_dir_get(dirkey))
             except Exception:  # noqa: BLE001 - nothing published yet
                 entries = []
             for k, v in entries:

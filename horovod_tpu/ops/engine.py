@@ -29,6 +29,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 
+from .. import chaos as _chaos
 from ..exceptions import HorovodInternalError
 from ..runtime import ReduceOp
 from . import collectives
@@ -304,6 +305,13 @@ class CollectiveEngine:
                 self.stall.check()
             return
         try:
+            if _chaos.ACTIVE:
+                # delay = a slow collective cycle (exercises the stall
+                # inspector's enqueue→complete latency tracking); error
+                # = a failed cycle — inside this try so injected
+                # failures fail the drained handles like real ones
+                _chaos.fire("engine.cycle", cycle=self._cycle_count + 1,
+                            entries=len(entries))
             # top-level framework span: one per drained batch, nesting the
             # NEGOTIATE range and the per-bucket dispatch annotations
             with jax.profiler.TraceAnnotation(

@@ -9,22 +9,82 @@ Requests are HMAC-signed with the per-job secret (``secret.py``, parity
 with upstream's request signing in ``runner/common/service``): when a
 secret is configured — always, under the launcher/elastic driver — the
 server rejects unsigned or tampered POSTs with 403 before dispatch.
+
+Failure semantics (docs/elastic.md): ``json_request`` retries transient
+transport failures (connection refused/reset, timeouts, 5xx) with
+jittered exponential backoff; permanent failures (403/404) surface
+immediately.  Non-idempotent calls pass ``idempotent=False`` and carry a
+per-call idempotency token the server dedupes, so a retry whose first
+attempt *did* reach the handler cannot double-apply (e.g. a FAILURE
+report double-counting toward the host blacklist).  Both paths carry
+chaos injection points (``rpc.request`` / ``rpc.server``) so fault
+schedules can drop/delay/duplicate/5xx any control-plane message
+deterministically (docs/env.md "Chaos engineering").
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
+import os
+import random
 import threading
+import time
+import urllib.error
 import urllib.request
+import uuid
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
+from .. import chaos as _chaos
 from . import secret as _secret
 
 logger = logging.getLogger("horovod_tpu")
 
 _ENV = object()  # sentinel: resolve the secret from the environment
+
+# Retry defaults (docs/env.md).  Read per call so tests and operators can
+# adjust without reimporting; an env read is one dict lookup.
+RETRIES_ENV = "HOROVOD_RPC_RETRIES"
+BACKOFF_ENV = "HOROVOD_RPC_BACKOFF_S"
+MAX_BACKOFF_ENV = "HOROVOD_RPC_MAX_BACKOFF_S"
+
+#: Idempotency-token replies remembered per server (LRU).
+_IDEM_CACHE_SIZE = 4096
+
+_jitter = random.Random()
+
+
+def _default_retries() -> int:
+    try:
+        return int(os.environ.get(RETRIES_ENV, "3"))
+    except ValueError:
+        return 3
+
+
+def _default_backoff() -> float:
+    try:
+        return float(os.environ.get(BACKOFF_ENV, "0.1"))
+    except ValueError:
+        return 0.1
+
+
+def _default_max_backoff() -> float:
+    try:
+        return float(os.environ.get(MAX_BACKOFF_ENV, "2.0"))
+    except ValueError:
+        return 2.0
+
+
+def jittered_backoff_s(attempt: int, base: float, cap: float,
+                       rng: random.Random = _jitter) -> float:
+    """Exponential backoff delay for retry ``attempt`` (0-based):
+    ``base * 2**attempt`` capped at ``cap``, scaled by a uniform 0.5–1.5
+    jitter.  Shared by the RPC client and the controller's KV retry so
+    the backoff shape is defined once."""
+    return min(cap, base * (2 ** attempt)) * (0.5 + rng.random())
 
 
 class JsonRpcServer:
@@ -33,6 +93,11 @@ class JsonRpcServer:
 
     ``secret`` defaults to the job secret from ``HOROVOD_SECRET_KEY``;
     pass ``None`` explicitly to run unauthenticated (unit tests only).
+
+    Requests carrying an ``_idem`` token (sent by ``json_request(...,
+    idempotent=False)``) are deduplicated: a token seen before returns
+    the cached reply without re-invoking the handler, so client retries
+    of non-idempotent methods are safe.
     """
 
     def __init__(self, handlers: Dict[str, Callable],
@@ -41,9 +106,18 @@ class JsonRpcServer:
         self._handlers = dict(handlers)
         self._secret = (_secret.get_secret_key()
                         if secret is _ENV else secret)
+        self._idem: "OrderedDict[str, bytes]" = OrderedDict()
+        self._idem_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _reply(self, body: bytes):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_POST(self):  # noqa: N802 (stdlib API name)
                 name = self.path.strip("/")
                 fn = outer._handlers.get(name)
@@ -61,19 +135,83 @@ class JsonRpcServer:
                     self.send_error(
                         403, "missing or invalid request signature")
                     return
+                drop_reply = False
+                if _chaos.ACTIVE:
+                    try:
+                        act = _chaos.fire("rpc.server", method=name)
+                    except Exception as e:  # noqa: BLE001 - injected 5xx
+                        self.send_error(500, f"chaos: {e}")
+                        return
+                    if act is not None and act.kind == "drop":
+                        # lost REQUEST: the handler never runs; the
+                        # client sees the connection close with no
+                        # status line and retries
+                        self.close_connection = True
+                        return
+                    if act is not None and act.kind == "drop-reply":
+                        # lost REPLY: the handler DOES run (and its
+                        # reply is cached for an idempotency token), the
+                        # connection then closes unanswered — the
+                        # faithful simulation of a retry whose first
+                        # attempt was applied
+                        drop_reply = True
+                marker = None
                 try:
                     payload = json.loads(raw)
+                    idem = (payload.pop("_idem", None)
+                            if isinstance(payload, dict) else None)
+                    if idem is not None:
+                        # claim-or-replay under the lock: a duplicate
+                        # arriving while the first delivery's handler is
+                        # still running must WAIT for its reply, not
+                        # dispatch the handler a second time
+                        entry = None
+                        with outer._idem_lock:
+                            entry = outer._idem.get(idem)
+                            if entry is None:
+                                marker = threading.Event()
+                                outer._idem[idem] = marker
+                        if isinstance(entry, bytes):
+                            self._reply(entry)
+                            return
+                        if entry is not None:      # in flight elsewhere
+                            entry.wait(70.0)
+                            with outer._idem_lock:
+                                done = outer._idem.get(idem)
+                            if isinstance(done, bytes):
+                                self._reply(done)
+                            else:
+                                # first delivery failed or is wedged:
+                                # tell the client to retry later rather
+                                # than double-dispatching
+                                self.send_error(
+                                    503, "duplicate of an in-flight "
+                                         "or failed request; retry")
+                            return
                     resp = fn(payload) or {}
                     body = json.dumps(resp).encode()
+                    if idem is not None:
+                        with outer._idem_lock:
+                            outer._idem[idem] = body
+                            outer._idem.move_to_end(idem)
+                            while len(outer._idem) > _IDEM_CACHE_SIZE:
+                                outer._idem.popitem(last=False)
+                        marker.set()
+                        marker = None
                 except Exception as e:  # noqa: BLE001 - report to caller
                     logger.exception("rpc handler %s failed", name)
                     self.send_error(500, str(e))
                     return
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                finally:
+                    if marker is not None:   # handler failed: release
+                        with outer._idem_lock:
+                            if outer._idem.get(idem) is marker:
+                                del outer._idem[idem]
+                        marker.set()
+                if drop_reply:
+                    self.close_connection = True
+                    return
+                self._reply(body)
 
             def log_message(self, *args):  # quiet
                 pass
@@ -89,21 +227,88 @@ class JsonRpcServer:
         self._httpd.server_close()
 
 
-def json_request(addr: str, port: int, name: str,
-                 payload: Optional[dict] = None,
-                 timeout: float = 30.0, secret=_ENV) -> dict:
-    """POST ``payload`` to http://addr:port/<name>; returns the JSON reply.
-
-    The body is HMAC-signed with the job secret when one is configured
-    (``HOROVOD_SECRET_KEY``); ``secret=None`` sends unsigned.
-    """
-    if secret is _ENV:
-        secret = _secret.get_secret_key()
-    body = json.dumps(payload or {}).encode()
+def _post_once(addr: str, port: int, name: str, body: bytes,
+               secret, timeout: float) -> dict:
     headers = {"Content-Type": "application/json"}
     if secret is not None:
+        # re-signed per attempt: retries get a fresh timestamp, so a
+        # long backoff chain cannot drift past the freshness window
         headers.update(_secret.sign_headers(secret, name, body))
     req = urllib.request.Request(
         f"http://{addr}:{port}/{name}", data=body, headers=headers)
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         return json.loads(resp.read() or b"{}")
+
+
+def json_request(addr: str, port: int, name: str,
+                 payload: Optional[dict] = None,
+                 timeout: float = 30.0, secret=_ENV,
+                 retries: Optional[int] = None,
+                 backoff: Optional[float] = None,
+                 max_backoff: Optional[float] = None,
+                 idempotent: bool = True) -> dict:
+    """POST ``payload`` to http://addr:port/<name>; returns the JSON reply.
+
+    The body is HMAC-signed with the job secret when one is configured
+    (``HOROVOD_SECRET_KEY``); ``secret=None`` sends unsigned.
+
+    Transient transport failures (connection refused/reset, timeouts,
+    HTTP 5xx) are retried up to ``retries`` times (default
+    ``HOROVOD_RPC_RETRIES``, 3) with jittered exponential backoff
+    (``backoff * 2**attempt``, capped at ``max_backoff``, scaled by a
+    uniform 0.5–1.5 jitter).  ``retries=0`` opts out for callers with
+    their own poll loop.  Permanent failures (4xx: bad signature,
+    unknown endpoint) raise immediately.
+
+    ``idempotent=False`` attaches a per-call idempotency token that
+    every retry reuses and the server dedupes — required for methods
+    whose double-delivery is not a no-op (failure reports feeding the
+    blacklist).  Token dedup also defuses chaos-injected duplicate
+    sends (``action=dup``).
+    """
+    if secret is _ENV:
+        secret = _secret.get_secret_key()
+    if retries is None:
+        retries = _default_retries()
+    if backoff is None:
+        backoff = _default_backoff()
+    if max_backoff is None:
+        max_backoff = _default_max_backoff()
+    data = dict(payload or {})
+    if not idempotent:
+        data["_idem"] = uuid.uuid4().hex
+    body = json.dumps(data).encode()
+
+    last_exc: Optional[Exception] = None
+    for attempt in range(retries + 1):
+        try:
+            act = None
+            if _chaos.ACTIVE:
+                act = _chaos.fire("rpc.request", method=name, addr=addr,
+                                  port=port, attempt=attempt)
+            reply = _post_once(addr, port, name, body, secret, timeout)
+            if act is not None and act.kind == "dup":
+                # duplicate delivery: the reply that "counts" is the
+                # second — idempotency tokens make both land identically
+                reply = _post_once(addr, port, name, body, secret,
+                                   timeout)
+            return reply
+        except urllib.error.HTTPError as e:
+            if e.code < 500:
+                raise  # permanent: auth/unknown-endpoint; retry is futile
+            last_exc = e
+        except (urllib.error.URLError, OSError,
+                http.client.HTTPException,
+                _chaos.ChaosError) as e:
+            # ChaosError: an injected generic fault at this site is
+            # transient by definition — the retry path must absorb it
+            # like the transport faults it stands in for
+            last_exc = e
+        if attempt >= retries:
+            raise last_exc
+        delay = jittered_backoff_s(attempt, backoff, max_backoff)
+        logger.debug("rpc %s to %s:%d failed (%s); retry %d/%d in %.2fs",
+                     name, addr, port, last_exc, attempt + 1, retries,
+                     delay)
+        time.sleep(delay)
+    raise last_exc  # pragma: no cover - loop always returns or raises

@@ -209,6 +209,91 @@ def test_lifecycle_events_formed_and_listener(nospawn):
         nospawn.wait_event("epoch_formed", timeout=0.05, since=i + 1)
 
 
+class _CrashableDriver(_NoSpawnDriver):
+    """Stub-spawn driver whose workers can be crashed by the test (the
+    reaper then runs its real churn/failure classification)."""
+
+    class _KillableProc:
+        class _P:
+            def __init__(self):
+                self.rc = None
+
+            def poll(self):
+                return self.rc
+
+            def terminate(self):
+                self.rc = -15
+
+            def kill(self):
+                self.rc = -9
+
+        def __init__(self):
+            self.popen = self._P()
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.procs = {}
+
+    def _launch(self, slot, coord_addr, coord_port, env):
+        super()._launch(slot, coord_addr, coord_port, env)
+        proc = self._KillableProc()
+        self.procs[int(env["HOROVOD_ELASTIC_WORKER_ID"])] = proc
+        return proc
+
+
+def test_blacklist_fed_by_repeated_started_crashes():
+    """Injected repeated crashes of workers that completed rendezvous
+    (reported running) count against the host; at blacklist_threshold
+    the host is excluded from discovery and, with no capacity left, the
+    driver gives up cleanly."""
+    d = _CrashableDriver(
+        discovery.FixedHostDiscovery({"hostA": 1}), ["true"],
+        min_np=1, port=free_port(), blacklist_threshold=2)
+    try:
+        d._apply_hosts({"hostA": 1}, HostUpdateResult.ADDED)
+        d._handle_running({"worker_id": 0, "epoch": 0})
+        d.procs[0].popen.rc = 1                      # crash #1
+        assert d._reap_workers() is None             # re-forms, respawns
+        assert d.registry.failure_count("hostA") == 1
+        assert not d.registry.is_blacklisted("hostA")
+        assert 1 in d.procs                          # replacement spawned
+
+        d._handle_running({"worker_id": 1, "epoch": 1})
+        d.procs[1].popen.rc = 1                      # crash #2: threshold
+        rc = d._reap_workers()
+        assert d.registry.is_blacklisted("hostA")
+        assert d._discover() == {}                   # host excluded
+        assert rc == 1                               # no capacity left
+    finally:
+        d._server.close()
+
+
+def test_rendezvous_churn_does_not_feed_blacklist():
+    """Workers dying BEFORE their running report (stale-epoch
+    registration FATALs, dead-leader disconnects) are re-rendezvous
+    churn: respawned, never counted toward the blacklist or the reset
+    budget."""
+    d = _CrashableDriver(
+        discovery.FixedHostDiscovery({"hostA": 1}), ["true"],
+        min_np=1, port=free_port(), blacklist_threshold=2)
+    try:
+        d._apply_hosts({"hostA": 1}, HostUpdateResult.ADDED)
+        for _ in range(4):                 # well past the threshold
+            wid = max(d.procs)
+            d.procs[wid].popen.rc = 1      # dies mid-rendezvous
+            assert d._reap_workers() is None
+        assert d.registry.failure_count("hostA") == 0
+        assert not d.registry.is_blacklisted("hostA")
+        assert d._reset_count == 0         # churn spends no reset budget
+        assert len(d.procs) == 5           # every death was respawned
+        exits = [e for e, i in d._events if e == "worker_exit"]
+        assert len(exits) == 4
+        kinds = [i["kind"] for e, i in d._events if e == "worker_exit"]
+        assert kinds == ["churn"] * 4
+    finally:
+        d._server.close()
+
+
 def test_driver_blacklisted_host_excluded(nospawn):
     for _ in range(3):
         nospawn.registry.record_result(99, registration.FAILURE, "badhost")

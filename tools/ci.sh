@@ -19,16 +19,19 @@
 #   7. full:    the whole suite (skipped with --quick)
 #   8. hvdlint: static collective-consistency + lock-order analysis over
 #      the framework and examples (docs/analysis.md)
+#   9. chaos:   the elastic join path under pinned fault-injection seeds
+#      must converge, and the leader-join regression stays pinned
+#      (docs/env.md "Chaos engineering")
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/8 package: wheel + sdist =="
+echo "== 1/9 package: wheel + sdist =="
 rm -rf dist/
 python -m build --no-isolation --outdir dist/ . > /tmp/ci_build.log 2>&1 \
   || { tail -30 /tmp/ci_build.log; exit 1; }
 ls -l dist/
 
-echo "== 2/8 wheel install smoke (scratch target, run from /tmp) =="
+echo "== 2/9 wheel install smoke (scratch target, run from /tmp) =="
 WHEEL_TGT=$(mktemp -d)
 trap 'rm -rf "$WHEEL_TGT"' EXIT
 REPO_DIR="$(pwd)"
@@ -73,28 +76,32 @@ PYEOF
 
 dist_smoke dist/*.whl
 if [ "${1:-}" != "--quick" ]; then
-  echo "== 3/8 sdist install smoke (builds from source) =="
+  echo "== 3/9 sdist install smoke (builds from source) =="
   dist_smoke dist/*.tar.gz
 fi
 
-echo "== 4/8 native core build + parity tests =="
+echo "== 4/9 native core build + parity tests =="
 python setup.py build_ext --inplace > /tmp/ci_native.log 2>&1 \
   || { tail -30 /tmp/ci_native.log; exit 1; }
 python -m pytest tests/test_native_core.py -q
 
-echo "== 5/8 pure-python fallback (native core disabled) =="
+echo "== 5/9 pure-python fallback (native core disabled) =="
 HOROVOD_TPU_NATIVE_CORE=0 python -m pytest \
   tests/test_basics.py tests/test_fusion.py -q
 
-echo "== 6/8 controller disabled (single-process semantics) =="
+echo "== 6/9 controller disabled (single-process semantics) =="
 HOROVOD_TPU_CONTROLLER=0 python -m pytest tests/test_basics.py -q
 
 if [ "${1:-}" != "--quick" ]; then
-  echo "== 7/8 full suite =="
+  echo "== 7/9 full suite =="
   python -m pytest tests/ -q
 fi
 
-echo "== 8/8 hvdlint static analysis =="
+echo "== 8/9 hvdlint static analysis =="
 python -m horovod_tpu.analysis horovod_tpu/ examples/
+
+echo "== 9/9 chaos smoke: elastic join under fixed fault seeds =="
+python -m pytest tests/test_chaos.py -q \
+  -k "converges_under_fault_seed or leader_join"
 
 echo "CI matrix: all stages green"
