@@ -59,9 +59,17 @@ import time
 import urllib.error
 from typing import Optional
 
+from .. import metrics as _metrics
 from .schedule import Action, FaultRule, FaultSchedule  # noqa: F401
 
 logger = logging.getLogger("horovod_tpu")
+
+# chaos→metrics bridge: injections counted per RULE so a fault seed can
+# be asserted to have actually fired (a silently inert HVD_CHAOS rule
+# otherwise passes CI stage 9 without injecting anything)
+_m_injections = _metrics.counter(
+    "hvd_chaos_injections_total", "Chaos injections fired, by rule",
+    labels=("rule", "site", "action"))
 
 ENV_SPEC = "HVD_CHAOS"
 ENV_SEED = "HVD_CHAOS_SEED"
@@ -142,6 +150,11 @@ def fire(site: str, **ctx) -> Optional[Action]:
     if act is None:
         return None
     logger.info("chaos: %s at %s %s", act.kind, site, ctx)
+    if _metrics.ACTIVE:
+        _m_injections.inc(rule=act.rule, site=site, action=act.kind)
+    if _metrics.RECORDING:
+        _metrics.event("chaos.injection", site=site, action=act.kind,
+                       rule=act.rule)
     kind = act.kind
     if kind == "delay":
         time.sleep(act.arg_float(0.05))

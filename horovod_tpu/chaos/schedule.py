@@ -57,15 +57,17 @@ KNOWN_ACTIONS = frozenset((
 class Action:
     """A fault decision handed back to (or executed for) an injection
     point.  ``kind`` is the action name; ``arg`` its optional ``:arg``
-    suffix, unparsed."""
+    suffix, unparsed; ``rule`` the text of the rule that fired (the
+    chaos→metrics bridge labels injection counts with it)."""
 
-    __slots__ = ("kind", "arg", "site")
+    __slots__ = ("kind", "arg", "site", "rule")
 
     def __init__(self, kind: str, arg: Optional[str] = None,
-                 site: str = ""):
+                 site: str = "", rule: str = ""):
         self.kind = kind
         self.arg = arg
         self.site = site
+        self.rule = rule
 
     def arg_float(self, default: float) -> float:
         try:
@@ -271,7 +273,8 @@ class FaultSchedule:
                 if not rule.should_fire():
                     continue
                 rule.count_fired += 1
-                act = Action(rule.action, rule.action_arg, site)
+                act = Action(rule.action, rule.action_arg, site,
+                             rule=rule.text)
                 self.fired.append((site, act.kind, dict(ctx)))
                 return act
         return None

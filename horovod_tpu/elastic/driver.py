@@ -30,6 +30,7 @@ Two additions beyond the reference:
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import queue
@@ -39,6 +40,7 @@ import time
 from typing import Dict, List, Optional
 
 from .. import chaos as _chaos
+from .. import metrics as _metrics
 from ..runner import spawn
 from ..runner import secret as _secret
 from ..runner.hosts import HostInfo, assign_slots
@@ -48,6 +50,22 @@ from .discovery import HostDiscovery, HostDiscoveryScript
 from .worker import HostUpdateResult
 
 logger = logging.getLogger("horovod_tpu")
+
+# -- metric families (docs/metrics.md; sites guard on _metrics.ACTIVE) --------
+_m_epochs = _metrics.counter(
+    "hvd_elastic_epochs_total", "Rendezvous epochs applied by the driver")
+_m_epoch_dur = _metrics.histogram(
+    "hvd_elastic_epoch_duration_seconds",
+    "Epoch apply → every member running", lo=-7, hi=10)
+_m_blacklist = _metrics.gauge(
+    "hvd_elastic_blacklist_size", "Hosts currently blacklisted")
+_m_restarts = _metrics.counter(
+    "hvd_elastic_worker_restarts_total",
+    "Worker respawns by cause (churn = rendezvous death, failure = "
+    "post-running death)", labels=("kind",))
+_m_discovery_failures = _metrics.counter(
+    "hvd_elastic_discovery_failures_total",
+    "Host-discovery poll failures absorbed by the driver")
 
 DEFAULT_DISCOVERY_INTERVAL = float(
     os.environ.get("HOROVOD_ELASTIC_DISCOVERY_INTERVAL", "1.0"))
@@ -128,6 +146,10 @@ class ElasticDriver:
         self._gate_polled: set = set()
         self._gate_deadline = 0.0
         self._gate_open = True
+        # latched once per epoch: a retried/duplicated 'running' report
+        # must not re-form the epoch (double epoch_formed emission and
+        # an inflated second duration observation)
+        self._epoch_formed = False
         # observable lifecycle: (event, info) log + condition for waiters
         # (tests and tooling wait on precise events instead of wall-clock
         # windows); callbacks in _listeners fire on every event
@@ -152,13 +174,25 @@ class ElasticDriver:
         # workers inherit it through the spawn env, and every RPC in both
         # directions is HMAC-verified (upstream runner request signing)
         os.environ.setdefault(_secret.SECRET_ENV, _secret.make_secret_key())
+        self._epoch_t0 = time.monotonic()
         self._server = JsonRpcServer({
             "assignment": self._handle_assignment,
             "result": self._handle_result,
             "running": self._handle_running,
             "register_notification": self._handle_register_notification,
             "request_reform": self._handle_request_reform,
-        }, port=self.port)
+        }, port=self.port, get_routes={
+            # job-level view: every registered worker scraped and merged
+            # (histograms bucket-wise, gauges per-worker min/max/sum) so
+            # one scrape answers "which worker is the straggler"
+            "metrics/job": self._metrics_job_route,
+        })
+
+    def _metrics_job_route(self):
+        with self._lock:
+            endpoints = {str(wid): ep for wid, ep in self._notif.items()}
+        body = _metrics.aggregate.scrape_and_merge(endpoints)
+        return (200, "text/plain; version=0.0.4; charset=utf-8", body)
 
     # --- lifecycle events --------------------------------------------------
 
@@ -202,6 +236,10 @@ class ElasticDriver:
         return done.wait(timeout)
 
     def _emit(self, event: str, **info):
+        # flight-recorder bridge: the driver's lifecycle IS the elastic
+        # event stream a post-mortem needs (epoch churn before a crash)
+        if _metrics.RECORDING:
+            _metrics.event(f"elastic.{event}", **info)
         if self._listeners:
             while True:
                 try:
@@ -291,8 +329,22 @@ class ElasticDriver:
             # a worker removed by scale-down errors out on its way down;
             # that is not a host failure and must not feed the blacklist
             return {"ok": True}
+        if payload["status"] == registration.FAILURE:
+            # black-box playback: a crashed worker's FAILURE report
+            # carries the last events of its flight recorder — log them
+            # so "worker 3 died" comes with what led there
+            flight = payload.get("flight") or []
+            if flight:
+                tail = "\n".join(
+                    "  " + json.dumps(ev, separators=(",", ":"))
+                    for ev in flight)
+                logger.warning(
+                    "worker %d FAILURE flight recorder (last %d "
+                    "events):\n%s", wid, len(flight), tail)
         self.registry.record_result(wid, payload["status"],
                                     payload.get("hostname"))
+        if _metrics.ACTIVE:
+            _m_blacklist.set(len(self.registry.blacklisted_hosts()))
         if payload["status"] == registration.SUCCESS and not expected:
             # the training function returned: the job is complete — peers
             # stop at the same step, so don't re-form on their way out
@@ -332,12 +384,20 @@ class ElasticDriver:
                 self._last_progress = time.monotonic()
                 members = {m.worker_id: m for m in self._workers.values()
                            if not m.expected_exit}
-                if epoch == self._epoch and all(
-                        wid_ in members and members[wid_].started
-                        for wid_ in self._assignment):
-                    formed = (epoch, len(self._assignment))
+                if (epoch == self._epoch and not self._epoch_formed
+                        and all(wid_ in members
+                                and members[wid_].started
+                                for wid_ in self._assignment)):
+                    # duration captured under the SAME lock that proved
+                    # this epoch formed: a concurrent _apply_hosts for a
+                    # newer epoch resets _epoch_t0 and would record ~0
+                    self._epoch_formed = True
+                    formed = (epoch, len(self._assignment),
+                              time.monotonic() - self._epoch_t0)
         self._emit("worker_running", worker_id=wid, epoch=epoch)
         if formed is not None:
+            if _metrics.ACTIVE:
+                _m_epoch_dur.observe(formed[2])
             self._emit("epoch_formed", epoch=formed[0], size=formed[1])
         return {"ok": True}
 
@@ -360,6 +420,8 @@ class ElasticDriver:
         try:
             return self._discover()
         except Exception:  # noqa: BLE001 - discovery flake
+            if _metrics.ACTIVE:
+                _m_discovery_failures.inc()
             logger.warning("host discovery failed (%s)", context,
                            exc_info=True)
             with self._lock:
@@ -410,6 +472,7 @@ class ElasticDriver:
         coord_addr, driver_addrs = self._resolve_addrs(slots)
         with self._lock:
             self._epoch += 1
+            self._epoch_t0 = time.monotonic()
             self._hosts = dict(hosts)
             # the new epoch gets a fresh rendezvous window: churn deaths
             # are tolerated until start_timeout from THIS re-form, not
@@ -465,12 +528,15 @@ class ElasticDriver:
             self._gate_polled = set()
             self._gate_open = not assigned_wids
             self._gate_deadline = time.monotonic() + self.start_timeout
+            self._epoch_formed = False
         if self.verbose:
             print(f"elastic: epoch {epoch} — {np_} slots on "
                   f"{list(hosts)}", file=sys.stderr)
         for wid, slot in to_spawn:
             self._spawn_worker(wid, slot, coord_addr, coord_port, epoch,
                                driver_addrs[slot.hostname])
+        if _metrics.ACTIVE:
+            _m_epochs.inc()
         self._notify_workers(notify, update_res)
         self._emit("epoch_applied", epoch=epoch, size=np_,
                    hosts=dict(hosts),
@@ -577,6 +643,8 @@ class ElasticDriver:
             try:
                 hosts = self._discover()
             except Exception:  # noqa: BLE001 - startup discovery flake
+                if _metrics.ACTIVE:
+                    _m_discovery_failures.inc()
                 logger.warning("host discovery failed (startup); "
                                "retrying", exc_info=True)
                 hosts = {}
@@ -682,6 +750,8 @@ class ElasticDriver:
                             "(rc=%d); respawning", w.worker_id,
                             w.slot.hostname, rc)
                 respawn_needed = True
+                if _metrics.ACTIVE:
+                    _m_restarts.inc(kind="churn")
                 self._emit("worker_exit", worker_id=w.worker_id, rc=rc,
                            kind="churn")
             else:
@@ -691,6 +761,10 @@ class ElasticDriver:
                                w.worker_id, w.slot.hostname, rc)
                 respawn_needed = True
                 counted_failure = True
+                if _metrics.ACTIVE:
+                    _m_restarts.inc(kind="failure")
+                    _m_blacklist.set(
+                        len(self.registry.blacklisted_hosts()))
                 self._emit("worker_exit", worker_id=w.worker_id, rc=rc,
                            kind="failure")
 

@@ -19,9 +19,17 @@ import time
 from typing import Optional
 
 from .. import chaos as _chaos
+from .. import metrics as _metrics
 from ..runner.rpc import JsonRpcServer, json_request
 
 logger = logging.getLogger("horovod_tpu")
+
+_m_rendezvous = _metrics.counter(
+    "hvd_elastic_rendezvous_epochs_total",
+    "Epoch assignments this worker accepted")
+_m_reforms = _metrics.counter(
+    "hvd_elastic_reform_requests_total",
+    "Re-form requests this worker sent after collective failures")
 
 
 class HostUpdateResult:
@@ -82,6 +90,13 @@ def fetch_assignment(min_epoch: Optional[int] = None,
                 f"(worker_id={wid})")
         if reply.get("ready"):
             _last_epoch = reply["epoch"]
+            if _metrics.ACTIVE:
+                _m_rendezvous.inc()
+            if _metrics.RECORDING:
+                _metrics.event("elastic.assignment", worker_id=wid,
+                               epoch=reply["epoch"],
+                               rank=reply.get("rank"),
+                               size=reply.get("size"))
             return reply
         if time.monotonic() > deadline:
             raise TimeoutError(
@@ -98,6 +113,11 @@ def request_reform():
     wid = worker_id()
     if ep is None or wid is None:
         return
+    if _metrics.ACTIVE:
+        _m_reforms.inc()
+    if _metrics.RECORDING:
+        _metrics.event("elastic.reform_requested", worker_id=wid,
+                       seen_epoch=_last_epoch)
     try:
         # retries=1: this sits on the collective-failure recovery path —
         # a long retry chain against an unreachable driver would delay
@@ -122,6 +142,9 @@ def record_running():
     wid = worker_id()
     if ep is None or wid is None:
         return
+    if _metrics.RECORDING:
+        _metrics.event("elastic.running_reported", worker_id=wid,
+                       epoch=_last_epoch)
     try:
         if _chaos.ACTIVE:
             # crash here = the worker dying between rendezvous and its
@@ -145,6 +168,15 @@ def record_result(status: str):
     wid = worker_id()
     if ep is None or wid is None:
         return
+    payload = {"worker_id": wid, "status": status,
+               "hostname": os.environ.get("HOROVOD_HOSTNAME",
+                                          socket.gethostname())}
+    if status != "SUCCESS" and _metrics.RECORDING:
+        # attach the black box: the driver logs the last events of a
+        # crashed worker, turning "worker N died" into a recording of
+        # the elastic/RPC/chaos events that led there
+        payload["flight"] = _metrics.flight_events(
+            limit=_metrics.FAILURE_REPORT_EVENTS)
     try:
         # idempotent=False: a FAILURE report that is retried (or chaos-
         # duplicated) after reaching the handler once must not count the
@@ -152,10 +184,7 @@ def record_result(status: str):
         # on the per-call token
         # bounded timeout: this is a dying worker's best-effort goodbye;
         # a black-holed driver must not pin the exit for 4 x 30s
-        json_request(ep[0], ep[1], "result",
-                     {"worker_id": wid, "status": status,
-                      "hostname": os.environ.get("HOROVOD_HOSTNAME",
-                                                 socket.gethostname())},
+        json_request(ep[0], ep[1], "result", payload,
                      timeout=5.0, idempotent=False)
     except Exception:  # noqa: BLE001 - driver may already be gone
         logger.debug("result report failed", exc_info=True)

@@ -62,11 +62,27 @@ from typing import Dict, List, Optional, Tuple
 import jax
 
 from .. import chaos as _chaos
+from .. import metrics as _metrics
 from ..exceptions import HorovodInternalError, StallError
 
 logger = logging.getLogger("horovod_tpu")
 
 _KEY_PREFIX = "hvdctl"
+
+# -- metric families (docs/metrics.md; sites guard on _metrics.ACTIVE) --------
+_m_neg_rounds = _metrics.counter(
+    "hvd_negotiation_rounds_total",
+    "Negotiation rounds by outcome (fast = hash-only steady state)",
+    labels=("kind",))
+_m_neg_dur = _metrics.histogram(
+    "hvd_negotiation_duration_seconds",
+    "Wall time of one negotiation round", labels=("kind",), lo=-17, hi=6)
+_m_kv_ops = _metrics.counter(
+    "hvd_kv_ops_total", "Coordination-service KV operations",
+    labels=("op",))
+_m_kv_retries = _metrics.counter(
+    "hvd_kv_retries_total",
+    "KV publishes retried after transient coordination-service errors")
 
 
 def _client():
@@ -124,10 +140,16 @@ def _kv_set(client, key: str, value: str):
                 client.key_value_set(key, value, allow_overwrite=True)
             except TypeError:  # older jax without allow_overwrite
                 client.key_value_set(key, value)
+            if _metrics.ACTIVE:
+                _m_kv_ops.inc(op="set")
             return
         except Exception:  # noqa: BLE001 - transient service error
             if attempt == _KV_SET_ATTEMPTS - 1:
+                if _metrics.ACTIVE:
+                    _m_kv_ops.inc(op="set_failed")
                 raise
+            if _metrics.ACTIVE:
+                _m_kv_retries.inc()
             # lazy import on the retry path only: module scope would
             # pull horovod_tpu.runner (api/launch) into controller's
             # import chain and risk a partial-init cycle via runtime
@@ -353,6 +375,22 @@ class Controller:
         rides hash-only fast rounds too, so it may change while the
         cycle signature stays cached.
         """
+        if not _metrics.ACTIVE:
+            return self._negotiate_impl(tokens, procs, params, aux)
+        t0 = time.monotonic()
+        kind = "error"
+        try:
+            res = self._negotiate_impl(tokens, procs, params, aux)
+            kind = ("joined" if res.all_joined
+                    else "fast" if res.fast else "full")
+            return res
+        finally:
+            _m_neg_rounds.inc(kind=kind)
+            _m_neg_dur.observe(time.monotonic() - t0, kind=kind)
+
+    def _negotiate_impl(self, tokens: List[str], procs: Tuple[int, ...],
+                        params: Optional[dict] = None,
+                        aux: Optional[dict] = None) -> NegotiationResult:
         me = jax.process_index()
         if me not in procs:
             raise HorovodInternalError(
@@ -557,6 +595,8 @@ class Controller:
         me = jax.process_index()
         with self._lock:
             self.kv_left_gets += 1
+        if _metrics.ACTIVE:
+            _m_kv_ops.inc(op="left_get")
         try:
             entries = client.key_value_dir_get(
                 f"{_KEY_PREFIX}/{self.namespace}/left/")
@@ -604,6 +644,8 @@ class Controller:
         while True:
             with self._lock:
                 self.kv_dir_gets += 1
+            if _metrics.ACTIVE:
+                _m_kv_ops.inc(op="dir_get")
             stale = False
             if _chaos.ACTIVE:
                 try:
@@ -647,6 +689,11 @@ class Controller:
                     and waited > self._peer_wait_abort_s):
                 names = sorted({n for t in pending_tokens
                                 for n in token_names(t)})
+                if _metrics.RECORDING:
+                    _metrics.event("stall.abort", where="negotiation",
+                                   seq=seq, waiting_for=sorted(need),
+                                   tensors=names)
+                    _metrics.flight_dump("StallError: negotiation")
                 raise StallError(
                     f"negotiation round {seq} waited {waited:.0f}s for "
                     f"processes {sorted(need)} (> "
@@ -702,6 +749,11 @@ class Controller:
                     "the same collectives).", seq, waited, q, names)
             if (self._peer_wait_abort_s > 0
                     and waited > self._peer_wait_abort_s):
+                if _metrics.RECORDING:
+                    _metrics.event("stall.abort", where="negotiation",
+                                   seq=seq, waiting_for=[q],
+                                   tensors=names)
+                    _metrics.flight_dump("StallError: negotiation")
                 raise StallError(
                     f"negotiation round {seq} waited {waited:.0f}s for "
                     f"process {q} (> HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
@@ -716,6 +768,8 @@ class Controller:
         for phase in ("a", "b"):
             with self._lock:
                 self.kv_deletes += 1
+            if _metrics.ACTIVE:
+                _m_kv_ops.inc(op="delete")
             try:
                 client.key_value_delete(self._key(gk, f"{old}/{phase}/{me}"))
             except Exception:  # noqa: BLE001 - may not exist

@@ -30,6 +30,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import jax
 
 from .. import chaos as _chaos
+from .. import metrics as _metrics
 from ..exceptions import HorovodInternalError
 from ..runtime import ReduceOp
 from . import collectives
@@ -37,6 +38,29 @@ from .controller import (NegotiationResult, entry_token, token_fields)
 from .fusion import EntrySig, get_planner
 
 logger = logging.getLogger("horovod_tpu")
+
+# -- metric families (docs/metrics.md; sites guard on _metrics.ACTIVE) --------
+_m_cycles = _metrics.counter(
+    "hvd_engine_cycles_total", "Background cycles that drained entries")
+_m_cycle_dur = _metrics.histogram(
+    "hvd_cycle_duration_seconds",
+    "Wall time of one drain→negotiate→dispatch cycle", lo=-17, hi=6)
+_m_tensors = _metrics.counter(
+    "hvd_engine_tensors_total", "Tensor signatures processed")
+_m_bytes = _metrics.counter(
+    "hvd_engine_bytes_reduced_total", "Payload bytes through dispatches")
+_m_dispatch_tensors = _metrics.histogram(
+    "hvd_dispatch_tensors", "Tensors per fused dispatch",
+    labels=("op",), lo=0, hi=12)
+_m_dispatch_bytes = _metrics.histogram(
+    "hvd_dispatch_bytes", "Payload bytes per fused dispatch",
+    labels=("op",), lo=6, hi=31)
+_m_fusion_util = _metrics.histogram(
+    "hvd_fusion_utilization_ratio",
+    "Fused allreduce bucket bytes / fusion threshold", lo=-14, hi=1)
+_m_plan_cache = _metrics.counter(
+    "hvd_response_cache_total",
+    "Fusion-plan (response) cache lookups", labels=("result",))
 
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -170,11 +194,15 @@ class CollectiveEngine:
     # -- lifecycle ----------------------------------------------------------
     def start(self):
         self._stop = False
+        if _metrics.RECORDING:
+            _metrics.event("engine.start")
         self._thread = threading.Thread(
             target=self._loop, name="hvd-background", daemon=True)
         self._thread.start()
 
     def stop(self):
+        if _metrics.RECORDING:
+            _metrics.event("engine.stop", cycles=self._cycle_count)
         if self._controller is not None:
             # tell peers mid-negotiation we are gone, so they diagnose
             # instead of waiting out the stall timeout
@@ -285,6 +313,15 @@ class CollectiveEngine:
                 # never let the background thread die silently: fail every
                 # pending handle so synchronize() raises instead of hanging
                 logger.exception("background cycle failed")
+                # black-box the failure: the events that LED here (elastic
+                # churn, RPC retries, chaos injections, stall warnings)
+                # are exactly what the stack trace cannot show
+                if _metrics.RECORDING:
+                    _metrics.event("engine.fatal",
+                                   cycle=self._cycle_count,
+                                   error=str(exc))
+                    _metrics.flight_dump(
+                        f"engine-fatal: {type(exc).__name__}")
                 with self._lock:
                     stuck, self._queue = self._queue, []
                 for e in stuck:
@@ -304,6 +341,11 @@ class CollectiveEngine:
             if self.stall:
                 self.stall.check()
             return
+        # cycle clock: from the batching-window start when the background
+        # loop set it (the sleep is part of the latency users see), else
+        # from the drain (synchronous mode)
+        t_cycle = (self._cycle_started if self._cycle_started is not None
+                   else time.monotonic())
         try:
             if _chaos.ACTIVE:
                 # delay = a slow collective cycle (exercises the stall
@@ -330,6 +372,9 @@ class CollectiveEngine:
                     e.handle._fail(exc)
             raise
         finally:
+            if _metrics.ACTIVE:
+                _m_cycles.inc()
+                _m_cycle_dur.observe(time.monotonic() - t_cycle)
             with self._lock:
                 self._cycle_active = False
 
@@ -588,6 +633,9 @@ class CollectiveEngine:
             self._cache.clear()
             self._last_threshold = threshold
         plan = self._cache.get(sigs) if use_cache else None
+        if _metrics.ACTIVE and use_cache:
+            _m_plan_cache.inc(result="hit" if plan is not None
+                              else "miss")
         if plan is None:
             plan = self._plan_fn(sigs, threshold)
             if use_cache:
@@ -637,6 +685,9 @@ class CollectiveEngine:
         if failed is None:
             nbytes = sum(s.nbytes for s in sigs)
             self._bytes_reduced += nbytes
+            if _metrics.ACTIVE:
+                _m_bytes.inc(nbytes)
+                _m_tensors.inc(len(sigs))
             # multi-process: only the leader's tuner learns — follower
             # cycles execute under the NEGOTIATED parameters, so feeding
             # a follower's GP would attribute those scores to local
@@ -686,6 +737,14 @@ class CollectiveEngine:
     def _dispatch_bucket(self, entries, sigs, owner, base, bucket, results):
         first = sigs[bucket[0]]
         op_type = first.op_type
+        if _metrics.ACTIVE:
+            nbytes = sum(sigs[si].nbytes for si in bucket)
+            _m_dispatch_tensors.observe(len(bucket), op=op_type)
+            _m_dispatch_bytes.observe(nbytes, op=op_type)
+            if op_type == "allreduce" and self._last_threshold > 0:
+                # fusion efficiency: how full the bucket ran relative to
+                # the threshold the planner packed against
+                _m_fusion_util.observe(nbytes / self._last_threshold)
         # profiler range per fused dispatch (reference: nvtx_op_range.cc —
         # the NVTX analog; lands inside any active jax.profiler trace so
         # framework spans merge with the XLA device trace, SURVEY §5.1)
@@ -747,6 +806,7 @@ class CollectiveEngine:
             "cycles": self._cycle_count,
             "bytes_reduced": self._bytes_reduced,
             "cache": self._cache.stats(),
+            "metrics": _metrics.snapshot(),
         }
         if self._controller is not None:
             out["negotiation"] = self._controller.stats()

@@ -39,9 +39,34 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
 from .. import chaos as _chaos
+from .. import metrics as _metrics
 from . import secret as _secret
 
 logger = logging.getLogger("horovod_tpu")
+
+# -- metric families (docs/metrics.md; sites guard on _metrics.ACTIVE) --------
+_m_client_reqs = _metrics.counter(
+    "hvd_rpc_client_requests_total",
+    "RPC client calls by method and outcome", labels=("method", "outcome"))
+_m_client_retries = _metrics.counter(
+    "hvd_rpc_client_retries_total",
+    "RPC client retry attempts after transient failures",
+    labels=("method",))
+_m_client_backoff = _metrics.counter(
+    "hvd_rpc_client_backoff_seconds_total",
+    "Total seconds the RPC client slept in retry backoff",
+    labels=("method",))
+_m_client_latency = _metrics.histogram(
+    "hvd_rpc_request_duration_seconds",
+    "RPC client request latency (successful attempt)",
+    labels=("method",), lo=-17, hi=6)
+_m_server_reqs = _metrics.counter(
+    "hvd_rpc_server_requests_total",
+    "RPC server POSTs dispatched by method and status",
+    labels=("method", "status"))
+_m_server_replays = _metrics.counter(
+    "hvd_rpc_server_idem_replays_total",
+    "Duplicate deliveries answered from the idempotency-token cache")
 
 _ENV = object()  # sentinel: resolve the secret from the environment
 
@@ -98,12 +123,23 @@ class JsonRpcServer:
     idempotent=False)``) are deduplicated: a token seen before returns
     the cached reply without re-invoking the handler, so client retries
     of non-idempotent methods are safe.
+
+    GET routes: every server also answers ``GET /metrics`` (Prometheus
+    text exposition of the process registry) and ``GET /healthz``
+    (JSON liveness) — read-only introspection, served unauthenticated
+    because scrapers cannot HMAC-sign (POST dispatch stays signed).
+    ``get_routes`` adds/overrides routes; a route is a zero-arg callable
+    returning ``(status, content_type, body)``.
     """
 
     def __init__(self, handlers: Dict[str, Callable],
                  port: int = 0, host: str = "0.0.0.0",
-                 secret=_ENV):
+                 secret=_ENV,
+                 get_routes: Optional[Dict[str, Callable]] = None):
         self._handlers = dict(handlers)
+        self._get_routes = dict(_metrics.get_routes())
+        if get_routes:
+            self._get_routes.update(get_routes)
         self._secret = (_secret.get_secret_key()
                         if secret is _ENV else secret)
         self._idem: "OrderedDict[str, bytes]" = OrderedDict()
@@ -117,6 +153,26 @@ class JsonRpcServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                name = self.path.split("?", 1)[0].strip("/")
+                route = outer._get_routes.get(name)
+                if route is None:
+                    self.send_error(404, f"no GET route: {name}")
+                    return
+                try:
+                    status, ctype, body = route()
+                except Exception as e:  # noqa: BLE001 - report to caller
+                    logger.exception("GET route %s failed", name)
+                    self.send_error(500, str(e))
+                    return
+                data = (body if isinstance(body, bytes)
+                        else body.encode("utf-8"))
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
 
             def do_POST(self):  # noqa: N802 (stdlib API name)
                 name = self.path.strip("/")
@@ -172,6 +228,10 @@ class JsonRpcServer:
                                 marker = threading.Event()
                                 outer._idem[idem] = marker
                         if isinstance(entry, bytes):
+                            if _metrics.ACTIVE:
+                                _m_server_replays.inc()
+                                _m_server_reqs.inc(method=name,
+                                                   status="replay")
                             self._reply(entry)
                             return
                         if entry is not None:      # in flight elsewhere
@@ -179,6 +239,10 @@ class JsonRpcServer:
                             with outer._idem_lock:
                                 done = outer._idem.get(idem)
                             if isinstance(done, bytes):
+                                if _metrics.ACTIVE:
+                                    _m_server_replays.inc()
+                                    _m_server_reqs.inc(method=name,
+                                                       status="replay")
                                 self._reply(done)
                             else:
                                 # first delivery failed or is wedged:
@@ -200,6 +264,11 @@ class JsonRpcServer:
                         marker = None
                 except Exception as e:  # noqa: BLE001 - report to caller
                     logger.exception("rpc handler %s failed", name)
+                    if _metrics.ACTIVE:
+                        _m_server_reqs.inc(method=name, status="error")
+                    if _metrics.RECORDING:
+                        _metrics.event("rpc.handler_failed", method=name,
+                                       error=str(e))
                     self.send_error(500, str(e))
                     return
                 finally:
@@ -208,6 +277,8 @@ class JsonRpcServer:
                             if outer._idem.get(idem) is marker:
                                 del outer._idem[idem]
                         marker.set()
+                if _metrics.ACTIVE:
+                    _m_server_reqs.inc(method=name, status="ok")
                 if drop_reply:
                     self.close_connection = True
                     return
@@ -286,15 +357,22 @@ def json_request(addr: str, port: int, name: str,
             if _chaos.ACTIVE:
                 act = _chaos.fire("rpc.request", method=name, addr=addr,
                                   port=port, attempt=attempt)
+            t0 = time.monotonic()
             reply = _post_once(addr, port, name, body, secret, timeout)
             if act is not None and act.kind == "dup":
                 # duplicate delivery: the reply that "counts" is the
                 # second — idempotency tokens make both land identically
                 reply = _post_once(addr, port, name, body, secret,
                                    timeout)
+            if _metrics.ACTIVE:
+                _m_client_latency.observe(time.monotonic() - t0,
+                                          method=name)
+                _m_client_reqs.inc(method=name, outcome="ok")
             return reply
         except urllib.error.HTTPError as e:
             if e.code < 500:
+                if _metrics.ACTIVE:
+                    _m_client_reqs.inc(method=name, outcome="permanent")
                 raise  # permanent: auth/unknown-endpoint; retry is futile
             last_exc = e
         except (urllib.error.URLError, OSError,
@@ -305,8 +383,21 @@ def json_request(addr: str, port: int, name: str,
             # like the transport faults it stands in for
             last_exc = e
         if attempt >= retries:
+            if _metrics.ACTIVE:
+                _m_client_reqs.inc(method=name, outcome="exhausted")
+            if _metrics.RECORDING:
+                _metrics.event("rpc.failed", method=name, addr=addr,
+                               port=port, attempts=attempt + 1,
+                               error=str(last_exc))
             raise last_exc
         delay = jittered_backoff_s(attempt, backoff, max_backoff)
+        if _metrics.ACTIVE:
+            _m_client_retries.inc(method=name)
+            _m_client_backoff.inc(delay, method=name)
+        if _metrics.RECORDING:
+            _metrics.event("rpc.retry", method=name, addr=addr,
+                           port=port, attempt=attempt + 1,
+                           error=str(last_exc))
         logger.debug("rpc %s to %s:%d failed (%s); retry %d/%d in %.2fs",
                      name, addr, port, last_exc, attempt + 1, retries,
                      delay)

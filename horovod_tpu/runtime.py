@@ -428,6 +428,15 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
                     ps, _STATE.devices, cfg.worker_axis)
 
         # Observability subsystems.
+        from . import metrics as _metrics
+        # metrics exposition + flight recorder env contract (SIGUSR1
+        # dump handler, HOROVOD_METRICS_DUMP snapshots,
+        # HOROVOD_METRICS_PORT scrape server); idempotent across
+        # elastic re-inits
+        _metrics.init_from_env()
+        if _metrics.RECORDING:
+            _metrics.event("runtime.init", process=jax.process_index(),
+                           processes=jax.process_count())
         from .timeline import Timeline
         from .stall import StallInspector
         _STATE.timeline = Timeline(
@@ -488,6 +497,10 @@ def shutdown():
         if not _STATE.initialized:
             return
         try:
+            from . import metrics as _metrics
+            if _metrics.RECORDING:
+                _metrics.event("runtime.shutdown")
+            _metrics.stop_exposition()
             if _STATE.engine is not None:
                 _STATE.engine.stop()
             if _STATE.timeline is not None:

@@ -18,9 +18,14 @@ import logging
 import time
 from typing import Dict
 
+from . import metrics as _metrics
 from .exceptions import StallError
 
 logger = logging.getLogger("horovod_tpu")
+
+_m_warnings = _metrics.counter(
+    "hvd_stall_warnings_total",
+    "Stall-inspector warning batches issued")
 
 
 class StallInspector:
@@ -70,11 +75,16 @@ class StallInspector:
         if self.disabled:
             return
         self._missing.pop(name, None)
+        # _warned is cleared on BOTH paths: the native tracker keeps its
+        # own warned set, but _warn() mirrors warned names into this dict
+        # (so warnings_issued bookkeeping is path-independent) — a tensor
+        # that completes after warning must reset either way, or a later
+        # genuine re-stall of the same name would go unwarned
+        self._warned.pop(name, None)
         if self._native is not None:
             self._native.record_complete(name)
         else:
             self._pending.pop(name, None)
-            self._warned.pop(name, None)
 
     def check(self, now: float = None):
         """Scan pending tensors; warn on stalls, raise past the shutdown bar.
@@ -89,24 +99,29 @@ class StallInspector:
             stalled, shutdown = self._native.check(now)
             if shutdown is not None:
                 name, age = shutdown
-                raise StallError(
-                    f"tensor {self._describe(name, age)} stalled past "
-                    f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
-                    f"{self.shutdown_time:.0f}; aborting")
-            self._warn(stalled)
+                self._abort(name, age)
+            self._warn(stalled, now)
             return
         stalled = []
         for name, t0 in self._pending.items():
             age = now - t0
             if age > self.check_time and name not in self._warned:
                 stalled.append((name, age))
-                self._warned[name] = now
             if self.shutdown_time > 0 and age > self.shutdown_time:
-                raise StallError(
-                    f"tensor {self._describe(name, age)} stalled past "
-                    f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
-                    f"{self.shutdown_time:.0f}; aborting")
-        self._warn(stalled)
+                self._abort(name, age)
+        self._warn(stalled, now)
+
+    def _abort(self, name: str, age: float):
+        """Raise the shutdown-bar StallError, dumping the flight
+        recorder first (the black-box read of what led to the stall)."""
+        if _metrics.RECORDING:
+            _metrics.event("stall.abort", tensor=name, age_s=round(age, 1),
+                           missing=self._missing.get(name, []))
+            _metrics.flight_dump("StallError: stalled tensor")
+        raise StallError(
+            f"tensor {self._describe(name, age)} stalled past "
+            f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
+            f"{self.shutdown_time:.0f}; aborting")
 
     def _describe(self, name: str, age: float) -> str:
         missing = self._missing.get(name)
@@ -114,10 +129,20 @@ class StallInspector:
             return f"{name} ({age:.0f}s; missing on processes {missing})"
         return f"{name} ({age:.0f}s)"
 
-    def _warn(self, stalled):
+    def _warn(self, stalled, now: float = None):
         if not stalled:
             return
+        now = time.monotonic() if now is None else now
+        # mirror warned names on both paths so record_complete's reset
+        # (and tests over the bookkeeping) see one source of truth
+        for n, _ in stalled:
+            self._warned.setdefault(n, now)
         self.warnings_issued += 1
+        if _metrics.ACTIVE:
+            _m_warnings.inc()
+        if _metrics.RECORDING:
+            _metrics.event("stall.warning",
+                           tensors=[n for n, _ in stalled])
         names = ", ".join(self._describe(n, a) for n, a in stalled)
         logger.warning(
             "One or more tensors were submitted to be reduced/gathered "

@@ -72,7 +72,6 @@ class Timeline:
             self._file = open(path, "w")
             self._file.write("[\n")
         self._first = True
-        self._stop = False
         self._thread = threading.Thread(
             target=self._writer_loop, name="hvd-timeline", daemon=True)
         self._thread.start()

@@ -643,9 +643,15 @@ def _sweep_schedule(seed):
 def test_join_converges_under_fault_seed(seed):
     """The elastic join path (form → scale-up → complete) must converge
     under each pinned fault seed; exercised by CI stage 9."""
+    import horovod_tpu.metrics as metrics
     d = SimDriver(discovery.FixedHostDiscovery({"localhost": 2}),
                   ["true"], min_np=2, port=free_port(),
                   start_timeout=60.0, worker_steps=40)
+    flake_rule = "rpc.request:hosts_updated nth=1 action=drop"
+    inj = metrics.registry().counter("hvd_chaos_injections_total",
+                                     labels=("rule", "site", "action"))
+    inj_before = inj.value(rule=flake_rule, site="rpc.request",
+                           action="drop")
     try:
         chaos.install(_sweep_schedule(seed))
         d._apply_hosts({"localhost": 2}, HostUpdateResult.ADDED)
@@ -657,6 +663,13 @@ def test_join_converges_under_fault_seed(seed):
         codes = _drain(d, timeout=30)
         assert codes == {0: 0, 1: 0, 2: 0}, (
             codes, chaos.current().stats())
+        # the schedule actually FIRED — a silently inert HVD_CHAOS spec
+        # must not pass as a chaos run (ISSUE 3 chaos→metrics bridge);
+        # the deterministic nth=1 flake rule is the guaranteed witness
+        assert chaos.current().fired, chaos.current().stats()
+        if metrics.ACTIVE:   # counter only updates with metrics on
+            assert inj.value(rule=flake_rule, site="rpc.request",
+                             action="drop") == inj_before + 1
         # every worker's SUCCESS landed despite the fault schedule
         from horovod_tpu.elastic import registration
         for wid in codes:

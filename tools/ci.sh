@@ -68,8 +68,31 @@ assert hvd.size() == 8
 x = hvd.worker_values(lambda r: np.full((3,), float(r)))
 np.testing.assert_allclose(
     np.asarray(hvd.allreduce(x, op=hvd.Sum)), np.full((3,), 28.0))
+
+# hvdmetrics smoke: scrape /metrics + /healthz from a live server in the
+# installed process; the core families must be present and the body must
+# parse as Prometheus text format (docs/metrics.md)
+import json
+from horovod_tpu.metrics import aggregate
+from horovod_tpu.runner.rpc import JsonRpcServer
+srv = JsonRpcServer({}, secret=None)
+health = json.loads(aggregate.scrape("127.0.0.1", srv.port,
+                                     route="healthz"))
+assert health["status"] == "ok", health
+fams = aggregate.parse_prometheus(aggregate.scrape("127.0.0.1", srv.port))
+for fam in ("hvd_engine_cycles_total", "hvd_cycle_duration_seconds",
+            "hvd_negotiation_duration_seconds",
+            "hvd_rpc_request_duration_seconds",
+            "hvd_response_cache_total"):
+    assert fam in fams, f"missing metric family {fam}"
+assert fams["hvd_cycle_duration_seconds"]["type"] == "histogram"
+cycles = [v for n, _, v in fams["hvd_engine_cycles_total"]["samples"]]
+assert cycles and cycles[0] >= 1, cycles
+srv.close()
+
 hvd.shutdown()
-print("dist smoke OK, imported from", os.path.dirname(hvd.__file__))
+print("dist smoke OK (incl. /metrics + /healthz scrape), imported from",
+      os.path.dirname(hvd.__file__))
 PYEOF
   )
 }
