@@ -17,7 +17,12 @@ and the comment shows the corrected form.  The bugs:
 * HVD004 — grouped collective fed from a set (order divergence)
 * HVD005 — one tensor name, two signatures
 * HVD006 — eager collective inside a jit-traced function
+* HVD110/111/113/114 — RacyMetricsSink: shared state half-guarded by its
+           lock (the guarded-by race detector's teaching fixture)
 """
+
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -91,6 +96,65 @@ def eager_collective_in_jit(metrics):
         return hvd.allreduce(x, name="jit.grads")
 
     return train_step(metrics)
+
+
+class RacyMetricsSink:
+    """Every guarded-by antipattern in one class (HVD110–HVD115 family).
+
+    The lock exists and guards *most* accesses — exactly the shape the
+    background-thread bugs in real Horovod took: a coordination thread
+    mutating state the training thread reads, with the guard applied on
+    one side only.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}
+        self._total = 0
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+        # HVD114: the drain thread is already running and reads
+        # self._interval — it can wake up before this line executes.
+        # Fix: assign every attribute the thread touches before start().
+        self._interval = 0.5
+
+    def _drain(self):
+        while True:
+            time.sleep(self._interval)
+            with self._lock:
+                self._total += len(self._counts)
+                self._counts.clear()
+
+    def record(self, name):
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + 1
+
+    def total(self):
+        with self._lock:
+            return self._total + len(self._counts)
+
+    def flush(self):
+        with self._lock:   # the correct form: swap under the guard
+            total, self._total = self._total, 0
+            self._counts.clear()
+        return total
+
+    def bump_total(self):
+        # HVD111: read-modify-write outside the guard — an increment
+        # racing _drain()'s guarded one loses updates.  Fix: take
+        # self._lock, like the majority of _total's access sites do.
+        self._total += 1
+
+    def clear_unsafe(self):
+        # HVD110: write without the inferred guard (self._lock protects
+        # the majority of _total's accesses).  Fix: take the lock.
+        self._total = 0
+
+    def snapshot(self):
+        # HVD113: _counts is written under the lock everywhere but read
+        # here without it — the read can see the dict mid-resize.
+        # Fix: with self._lock: return dict(self._counts)
+        return dict(self._counts)
 
 
 def main():

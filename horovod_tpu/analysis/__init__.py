@@ -7,13 +7,21 @@ rank-conditional branches, missing initial-state broadcast, mismatched
 submission order — are statically detectable in user scripts, so this
 package catches them in CI instead of on a TPU reservation.
 
-Two engines:
+Three engines:
 
 * **user-script rules** (``user_rules.py``): HVD001–HVD006, AST checks
-  over training scripts for the deadlock/divergence hazard taxonomy.
-* **framework self-check** (``lock_order.py``): HVD101–HVD103, a
-  lock-acquisition-graph race detector over our own threaded modules
+  over training scripts for the deadlock/divergence hazard taxonomy —
+  rank/except/jit hazards see through one level of helper functions.
+* **lock-order self-check** (``lock_order.py``): HVD101–HVD103, a
+  lock-acquisition-graph deadlock detector over our own threaded modules
   (engine, controller, elastic driver, stall inspector).
+* **guarded-by self-check** (``guarded_by.py`` over ``callgraph.py``):
+  HVD110–HVD115, Eraser-style lock-set race detection — each shared
+  attribute's guard is inferred from the lock held at the majority of
+  its access sites, and unguarded writes / read-modify-writes / torn
+  reads / init-time publication races are reported.  A findings
+  baseline (``tools/hvdlint_baseline.json``, ``--baseline`` /
+  ``--update-baseline``) lets CI fail only on NEW findings.
 
 CLI::
 

@@ -1,0 +1,106 @@
+"""Findings baseline: a ratchet so CI fails only on *new* findings.
+
+``tools/hvdlint_baseline.json`` records the accepted findings of a tree
+(near-empty by policy — every real race gets fixed or suppressed inline
+with a justification).  ``--baseline FILE`` subtracts baselined findings
+from a run; ``--baseline FILE --update-baseline`` rewrites the file from
+the current findings (the explicit ratchet step, reviewed in the diff).
+
+Entries match on a **fingerprint** — ``code | path | message with digit
+runs collapsed`` — so line-number drift from unrelated edits does not
+invalidate the baseline, while a genuinely new finding (different
+attribute, class, or rule) never matches.  Each fingerprint carries a
+count: the baseline tolerates at most that many occurrences.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .report import Finding
+
+_DIGITS = re.compile(r"\d+")
+
+_REPO_ROOT: Optional[str] = None
+
+
+def _repo_root() -> str:
+    """The enclosing git toplevel ('' when not in a repository)."""
+    global _REPO_ROOT
+    if _REPO_ROOT is None:
+        import subprocess
+        try:
+            out = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                                 capture_output=True, text=True)
+            _REPO_ROOT = (out.stdout.strip()
+                          if out.returncode == 0 else "")
+        except OSError:
+            _REPO_ROOT = ""
+    return _REPO_ROOT
+
+
+def _canonical_path(path: str) -> str:
+    """One spelling per file: repo-root-relative with forward slashes
+    when inside a git checkout, absolute otherwise — so the same finding
+    fingerprints identically whether hvdlint was invoked with absolute
+    paths, from a subdirectory (``--changed`` relpaths), or from CI's
+    repo-root-relative arguments."""
+    p = os.path.abspath(path)
+    root = _repo_root()
+    if root and (p == root or p.startswith(root + os.sep)):
+        p = os.path.relpath(p, root)
+    return p.replace("\\", "/")
+
+
+def fingerprint(finding: Finding) -> str:
+    path = _canonical_path(finding.path)
+    return f"{finding.code}|{path}|{_DIGITS.sub('#', finding.message)}"
+
+
+def load(path: str) -> Dict[str, int]:
+    """fingerprint -> tolerated occurrence count."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out: Dict[str, int] = {}
+    for entry in data.get("findings", []):
+        fp = entry["fingerprint"]
+        out[fp] = out.get(fp, 0) + int(entry.get("count", 1))
+    return out
+
+
+def save(path: str, findings: Iterable[Finding]) -> int:
+    """Write the baseline for ``findings``; returns the entry count."""
+    counts = Counter()
+    meta: Dict[str, Tuple[str, str]] = {}
+    for f in findings:
+        fp = fingerprint(f)
+        counts[fp] += 1
+        meta.setdefault(fp, (f.code, _canonical_path(f.path)))
+    entries = [{"code": meta[fp][0], "path": meta[fp][1],
+                "count": n, "fingerprint": fp}
+               for fp, n in sorted(counts.items())]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": entries}, f, indent=2,
+                  sort_keys=False)
+        f.write("\n")
+    return len(entries)
+
+
+def apply(findings: List[Finding], allowed: Dict[str, int]
+          ) -> Tuple[List[Finding], int]:
+    """(new findings, count suppressed by the baseline)."""
+    remaining = dict(allowed)
+    new: List[Finding] = []
+    suppressed = 0
+    for f in findings:
+        fp = fingerprint(f)
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+            suppressed += 1
+        else:
+            new.append(f)
+    return new, suppressed

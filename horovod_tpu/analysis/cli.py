@@ -6,15 +6,29 @@ import argparse
 import ast
 import json
 import os
+import re
 import sys
-from typing import Iterable, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-from . import lock_order, user_rules
+from . import baseline as baseline_mod
+from . import guarded_by, lock_order, user_rules
 from .report import (Finding, RULES, apply_suppressions, file_skipped,
                      iter_suppressions)
 
 _SKIP_DIRS = {"__pycache__", ".git", "build", "dist", "node_modules",
               ".pytest_cache", ".hypothesis"}
+
+#: All engines, in run order.  "guards" is the HVD110–115 guarded-by
+#: race detector (guarded_by.py) added alongside the original two.
+ENGINES = ("user", "locks", "guards")
+
+#: Parsed-AST cache keyed by absolute path: every pass (user rules,
+#: lock-order, guarded-by) and every re-run in one process (e.g. the
+#: framework-wide pytest pins) reuses one parse per file revision.  The
+#: entry is validated against the SOURCE CONTENT (size + crc32), never
+#: against mtime — a file edited between read and stat can not poison
+#: the cache with a stale tree.
+_AST_CACHE: Dict[str, Tuple[Tuple[int, int], ast.Module]] = {}
 
 
 def collect_files(paths: Sequence[str]) -> List[str]:
@@ -33,30 +47,71 @@ def collect_files(paths: Sequence[str]) -> List[str]:
     return out
 
 
+def changed_files(base: str = "HEAD",
+                  paths: Optional[Sequence[str]] = None) -> List[str]:
+    """Python files changed in the working tree against ``base`` (the
+    ``--changed`` pre-commit mode: ``git diff --name-only``).
+
+    git emits repo-root-relative names; they are resolved against the
+    repository toplevel so the mode works from any subdirectory."""
+    import subprocess
+    top = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                         capture_output=True, text=True)
+    if top.returncode != 0:
+        raise RuntimeError(
+            f"not inside a git repository: {top.stderr.strip()}")
+    toplevel = top.stdout.strip()
+    proc = subprocess.run(
+        ["git", "diff", "--name-only", "--diff-filter=d", base, "--"],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"git diff --name-only {base} failed: "
+            f"{proc.stderr.strip() or proc.stdout.strip()}")
+    roots = [os.path.abspath(p) for p in (paths or [])]
+    out = []
+    for name in proc.stdout.splitlines():
+        name = name.strip()
+        if not name.endswith(".py"):
+            continue
+        full = os.path.join(toplevel, name)
+        if not os.path.exists(full):
+            continue
+        if roots and not any(
+                full == r or full.startswith(r + os.sep) for r in roots):
+            continue
+        out.append(os.path.relpath(full))
+    return sorted(out)
+
+
 def analyze_source(source: str, path: str = "<string>",
                    include_skipped: bool = False,
-                   engines: Iterable[str] = ("user", "locks"),
+                   engines: Iterable[str] = ENGINES,
+                   tree: Optional[ast.Module] = None,
                    ) -> List[Finding]:
     """Run the selected engines over one module's source."""
     if not include_skipped and file_skipped(source):
         return []
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as exc:
-        return [Finding("HVD000", path, exc.lineno or 1, exc.offset or 0,
-                        f"could not parse: {exc.msg}")]
+    if tree is None:
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            return [Finding("HVD000", path, exc.lineno or 1, exc.offset or 0,
+                            f"could not parse: {exc.msg}")]
     findings: List[Finding] = []
     if "user" in engines:
         findings.extend(user_rules.check_module(tree, path))
     if "locks" in engines:
         findings.extend(lock_order.check_module(tree, path))
+    if "guards" in engines:
+        findings.extend(guarded_by.check_module(tree, path))
     findings = apply_suppressions(findings, iter_suppressions(source))
     findings.sort(key=lambda f: (f.line, f.col, f.code))
     return findings
 
 
 def analyze_paths(paths: Sequence[str], include_skipped: bool = False,
-                  engines: Iterable[str] = ("user", "locks"),
+                  engines: Iterable[str] = ENGINES,
                   select: Optional[Sequence[str]] = None,
                   ) -> List[Finding]:
     """Walk ``paths`` (files or directories) and analyze every .py file."""
@@ -64,8 +119,27 @@ def analyze_paths(paths: Sequence[str], include_skipped: bool = False,
                          select)
 
 
+def _parse_cached(path: str, source: str) -> Optional[ast.Module]:
+    """Parse ``source``, reusing the cached AST while the content is
+    unchanged (size + crc32 of the source actually read).  Returns None
+    on syntax errors — the caller reports HVD000."""
+    import zlib
+    data = source.encode("utf-8", errors="surrogatepass")
+    key = (len(data), zlib.crc32(data))
+    cache_key = os.path.abspath(path)
+    hit = _AST_CACHE.get(cache_key)
+    if hit is not None and hit[0] == key:
+        return hit[1]
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return None
+    _AST_CACHE[cache_key] = (key, tree)
+    return tree
+
+
 def analyze_files(files: Sequence[str], include_skipped: bool = False,
-                  engines: Iterable[str] = ("user", "locks"),
+                  engines: Iterable[str] = ENGINES,
                   select: Optional[Sequence[str]] = None,
                   ) -> List[Finding]:
     findings: List[Finding] = []
@@ -78,11 +152,40 @@ def analyze_files(files: Sequence[str], include_skipped: bool = False,
                                     f"could not read: {exc}"))
             continue
         findings.extend(analyze_source(
-            source, path, include_skipped=include_skipped, engines=engines))
+            source, path, include_skipped=include_skipped, engines=engines,
+            tree=_parse_cached(path, source)))
     if select:
         wanted = {c.strip().upper() for c in select}
         findings = [f for f in findings if f.code in wanted]
     return findings
+
+
+_RANGE_RE = re.compile(r"^HVD(\d+)-(?:HVD)?(\d+)$")
+
+
+def expand_select(spec: str) -> Tuple[List[str], List[str]]:
+    """Parse a ``--select`` spec with ranges (``HVD110-HVD115``).
+    Returns (codes, unknown tokens)."""
+    codes: List[str] = []
+    unknown: List[str] = []
+    for tok in spec.split(","):
+        tok = tok.strip().upper()
+        if not tok:
+            continue
+        m = _RANGE_RE.match(tok)
+        if m:
+            lo, hi = int(m.group(1)), int(m.group(2))
+            ends = {f"HVD{lo:03d}", f"HVD{hi:03d}"}
+            if hi < lo or not ends <= set(RULES):
+                unknown.append(tok)
+                continue
+            codes.extend(f"HVD{n:03d}" for n in range(lo, hi + 1)
+                         if f"HVD{n:03d}" in RULES)
+        elif tok in RULES:
+            codes.append(tok)
+        else:
+            unknown.append(tok)
+    return codes, unknown
 
 
 def _list_rules() -> str:
@@ -93,64 +196,152 @@ def _list_rules() -> str:
     return "\n".join(lines)
 
 
+def _docs_path() -> str:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, "docs", "analysis.md")
+
+
+def explain_rule(code: str) -> str:
+    """The docs/analysis.md catalog entry for ``code`` (falls back to the
+    built-in title + fix-it when the docs tree is not installed)."""
+    code = code.strip().upper()
+    if code not in RULES:
+        return f"unknown rule code: {code} (see --list-rules)"
+    section: List[str] = []
+    try:
+        with open(_docs_path(), "r", encoding="utf-8") as f:
+            in_section = False
+            for line in f:
+                if line.startswith("### "):
+                    if in_section:
+                        break
+                    in_section = line.startswith(f"### {code}")
+                elif in_section and line.startswith("## "):
+                    break
+                if in_section:
+                    section.append(line.rstrip("\n"))
+    except OSError:
+        section = []
+    if section:
+        return "\n".join(section).strip()
+    title, fixit = RULES[code]
+    return f"### {code} — {title}\n\nfix: {fixit}"
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m horovod_tpu.analysis",
-        description="hvdlint: static collective-consistency and lock-order "
-                    "analyzer for horovod_tpu training scripts")
+        description="hvdlint: static collective-consistency, lock-order "
+                    "and guarded-by race analyzer for horovod_tpu")
     parser.add_argument("paths", nargs="*",
                         help="files or directories to analyze")
     parser.add_argument("--format", choices=("text", "json"),
                         default="text")
     parser.add_argument("--select", metavar="CODES",
-                        help="comma-separated rule codes to report "
-                             "(default: all)")
-    parser.add_argument("--engine", choices=("user", "locks", "all"),
+                        help="comma-separated rule codes to report; "
+                             "ranges allowed (HVD110-HVD115)")
+    parser.add_argument("--engine",
+                        choices=("user", "locks", "guards", "all"),
                         default="all",
-                        help="user-script rules, framework lock-order "
-                             "self-check, or both (default)")
+                        help="user-script rules, the lock-order "
+                             "self-check, the guarded-by race detector, "
+                             "or all three (default)")
     parser.add_argument("--include-skipped", action="store_true",
                         help="analyze files marked '# hvdlint: skip-file' "
                              "(for linting the lint fixtures themselves)")
+    parser.add_argument("--baseline", metavar="FILE",
+                        help="subtract findings recorded in this baseline "
+                             "file; only NEW findings are reported "
+                             "(tools/hvdlint_baseline.json in CI)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the --baseline file from the "
+                             "current findings and exit 0")
+    parser.add_argument("--changed", action="store_true",
+                        help="lint only files changed against --base "
+                             "(git diff --name-only); positional paths "
+                             "then act as filters")
+    parser.add_argument("--base", metavar="REF", default="HEAD",
+                        help="base ref for --changed (default: HEAD)")
+    parser.add_argument("--explain", metavar="CODE",
+                        help="print the docs/analysis.md entry for a rule "
+                             "and exit")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         print(_list_rules())
         return 0
-    if not args.paths:
+    if args.explain:
+        text = explain_rule(args.explain)
+        print(text)
+        return 0 if not text.startswith("unknown rule code") else 2
+    if args.update_baseline and not args.baseline:
+        parser.error("--update-baseline requires --baseline FILE")
+    if args.update_baseline and (args.changed or args.select
+                                 or args.engine != "all"):
+        # rewriting the ratchet from a filtered subset would silently
+        # drop every entry the filter excluded
+        parser.error("--update-baseline must record a full run; drop "
+                     "--changed/--select/--engine")
+    if not args.paths and not args.changed:
         parser.error("no paths given (try: horovod_tpu/ examples/)")
 
-    engines = ("user", "locks") if args.engine == "all" else (args.engine,)
+    engines = ENGINES if args.engine == "all" else (args.engine,)
     select = None
     if args.select:
-        select = [c.strip().upper() for c in args.select.split(",")
-                  if c.strip()]
-        unknown = [c for c in select if c not in RULES]
+        select, unknown = expand_select(args.select)
         if unknown:
             # a typo'd code would otherwise filter out every finding and
             # exit 0 — fatal in a CI gate
             parser.error(f"unknown rule code(s): {', '.join(unknown)} "
                          f"(see --list-rules)")
-    files = collect_files(args.paths)
+    if args.changed:
+        try:
+            files = changed_files(args.base, args.paths)
+        except RuntimeError as exc:
+            parser.error(str(exc))
+    else:
+        files = collect_files(args.paths)
     findings = analyze_files(files, engines=engines,
                              include_skipped=args.include_skipped,
                              select=select)
 
+    if args.update_baseline:
+        n = baseline_mod.save(args.baseline, findings)
+        print(f"hvdlint: baseline {args.baseline} updated "
+              f"({n} entr{'y' if n == 1 else 'ies'}, "
+              f"{len(findings)} finding(s))")
+        return 0
+
+    baselined = 0
+    if args.baseline:
+        try:
+            allowed = baseline_mod.load(args.baseline)
+        except OSError as exc:
+            parser.error(f"could not read baseline {args.baseline}: {exc}")
+        except (ValueError, KeyError) as exc:
+            parser.error(f"malformed baseline {args.baseline}: {exc}")
+        findings, baselined = baseline_mod.apply(findings, allowed)
+
     if args.format == "json":
         print(json.dumps({"findings": [f.as_dict() for f in findings],
-                          "count": len(findings)}, indent=2))
+                          "count": len(findings),
+                          "baselined": baselined}, indent=2))
     else:
         for f in findings:
             print(f.format_text())
         n_files = len(files)
+        note = (f" ({baselined} baselined finding(s) not shown)"
+                if baselined else "")
         if findings:
-            print(f"\nhvdlint: {len(findings)} finding(s) in {n_files} "
-                  f"file(s) — see docs/analysis.md for the rule catalog; "
-                  f"suppress a false positive with "
+            new = "NEW " if args.baseline else ""
+            print(f"\nhvdlint: {len(findings)} {new}finding(s) in "
+                  f"{n_files} file(s){note} — see docs/analysis.md for "
+                  f"the rule catalog; suppress a false positive with "
                   f"'# hvdlint: disable=<code>'")
         else:
-            print(f"hvdlint: {n_files} file(s) clean")
+            print(f"hvdlint: {n_files} file(s) clean{note}")
     return 1 if findings else 0
 
 

@@ -56,6 +56,36 @@ RULES: Dict[str, Tuple[str, str]] = {
         "re-acquiring a non-reentrant lock already held",
         "use threading.RLock, or restructure so the inner path does not "
         "re-enter — a plain Lock self-deadlocks on re-acquisition"),
+    "HVD110": (
+        "shared attribute written without its inferred guard",
+        "hold the lock that guards this attribute's other access sites "
+        "around the write, or suppress with an inline justification if "
+        "the write provably cannot race (e.g. before any thread starts)"),
+    "HVD111": (
+        "non-atomic read-modify-write outside the inferred guard",
+        "wrap the increment / swap / check-then-act in 'with <guard>:' — "
+        "two threads interleaving between the read and the write lose an "
+        "update (or act on a stale decision)"),
+    "HVD112": (
+        "guarded container escapes its lock scope by reference",
+        "return or store a copy (list(x), dict(x)) — handing out the raw "
+        "container lets callers iterate/mutate it after the guard is "
+        "released"),
+    "HVD113": (
+        "guard held for writes but not for reads",
+        "take the same lock on the read side — an unguarded read can "
+        "observe a torn or stale update; if the racy read is intentional, "
+        "add an inline disable comment stating why it is safe"),
+    "HVD114": (
+        "attribute published after a thread already started in __init__",
+        "assign every attribute the thread reads BEFORE Thread.start() / "
+        "server construction — the new thread can run before __init__ "
+        "finishes and observe the attribute missing"),
+    "HVD115": (
+        "split guard: no lock protects a majority of access sites",
+        "pick ONE lock to guard this attribute and hold it at every "
+        "access site; two locks each covering part of the accesses "
+        "exclude nothing"),
 }
 
 

@@ -92,6 +92,9 @@ class UserScriptChecker:
         # relative imports only count as horovod-ish when analyzing the
         # package itself; user scripts' own relative modules stay inert
         self._trust_relative = "horovod_tpu" in path.replace("\\", "/")
+        # one-level interprocedural view: module-level helpers that
+        # directly submit a collective.  name -> (base op, def line)
+        self.helper_collectives: Dict[str, Tuple[str, int]] = {}
 
     # -- pre-passes ----------------------------------------------------------
     def _collect_imports(self):
@@ -148,6 +151,32 @@ class UserScriptChecker:
                 elif isinstance(target, ast.Name) \
                         and self._is_rank_expr(node.value):
                     self.rank_vars.add(target.id)
+
+    def _collect_helpers(self):
+        """Module-level functions that directly submit a collective —
+        HVD001/003/006 see through ONE level of these: calling such a
+        helper inside a rank branch / except handler / jit trace is the
+        same hazard as calling the collective there directly.  Nested
+        defs/lambdas are skipped: a factory that merely *defines* a
+        collective-bearing closure submits nothing when called."""
+        def own_calls(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(child, ast.Call):
+                    yield child
+                yield from own_calls(child)
+
+        for node in self.tree.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for call in own_calls(node):
+                coll = self._collective_name(call)
+                if coll is not None:
+                    self.helper_collectives[node.name] = (
+                        COLLECTIVES[coll], node.lineno)
+                    break
 
     def _collect_jit_wrapped(self):
         # functions passed positionally into jax.jit(f) / shard_map(f, ...)
@@ -248,6 +277,7 @@ class UserScriptChecker:
     # -- the walk ------------------------------------------------------------
     def run(self) -> List[Finding]:
         self._collect_imports()
+        self._collect_helpers()
         self._collect_rank_vars()
         self._collect_jit_wrapped()
         self._walk_stmts(self.tree.body, _Ctx(func={"divergent": None}))
@@ -383,6 +413,8 @@ class UserScriptChecker:
 
         coll = self._collective_name(call)
         if coll is None:
+            if isinstance(fn, ast.Name) and fn.id in self.helper_collectives:
+                self._check_helper_call(call, fn.id, ctx)
             return
 
         if ctx.rank_line is not None:
@@ -414,6 +446,36 @@ class UserScriptChecker:
                       f"unordered set iteration; member order can differ "
                       f"across processes, diverging the fusion plan")
         self._check_hvd005(call, COLLECTIVES[coll])
+
+    def _check_helper_call(self, call: ast.Call, name: str, ctx: _Ctx):
+        """HVD001/003/006 through one helper level: ``name`` is a
+        module-level function that directly submits a collective."""
+        base_op, def_line = self.helper_collectives[name]
+        via = (f"via helper '{name}' (line {def_line}), which submits "
+               f"'{base_op}'")
+        if ctx.rank_line is not None:
+            self._add("HVD001", call,
+                      f"collective submitted {via}, inside a branch "
+                      f"conditioned on the process rank (branch at line "
+                      f"{ctx.rank_line}); ranks skipping the branch never "
+                      f"submit it and the others deadlock")
+        if ctx.except_line is not None:
+            self._add("HVD003", call,
+                      f"collective submitted {via}, inside an except "
+                      f"handler (line {ctx.except_line}); an exception "
+                      f"raised on a subset of ranks strands the rest")
+        elif ctx.func is not None and ctx.func["divergent"] is not None:
+            self._add("HVD003", call,
+                      f"collective submitted {via}, after a "
+                      f"rank-conditional early exit (line "
+                      f"{ctx.func['divergent']}); only the ranks that did "
+                      f"not exit reach this call")
+        if ctx.in_jit:
+            self._add("HVD006", call,
+                      f"eager collective submitted {via}, inside a "
+                      f"jit/shard_map-traced function; it blocks on the "
+                      f"background engine under tracing — use the in-jit "
+                      f"form (hvd.{base_op}_p)")
 
     def _check_hvd005(self, call: ast.Call, base_op: str):
         name = None

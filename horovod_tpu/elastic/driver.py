@@ -217,7 +217,11 @@ class ElasticDriver:
             if event is None:   # flush marker: info is an Event to set
                 info.set()
                 continue
-            for cb in list(self._listeners):
+            # snapshot under the same lock add_listener appends under: an
+            # unguarded list() can observe the append mid-resize (HVD113)
+            with self._lock:
+                listeners = list(self._listeners)
+            for cb in listeners:
                 try:
                     cb(event, info)
                 except Exception:  # noqa: BLE001 - observer must not
@@ -229,8 +233,9 @@ class ElasticDriver:
         """Block until every event emitted so far has been delivered to
         the callbacks (the dispatch thread is asynchronous; terminal
         events like ``job_done`` would otherwise race driver exit)."""
-        if self._listener_thread is None:
-            return True
+        with self._lock:   # guarded like add_listener's write (HVD113)
+            if self._listener_thread is None:
+                return True
         done = threading.Event()
         self._listener_q.put((None, done))
         return done.wait(timeout)
@@ -240,7 +245,9 @@ class ElasticDriver:
         # event stream a post-mortem needs (epoch churn before a crash)
         if _metrics.RECORDING:
             _metrics.event(f"elastic.{event}", **info)
-        if self._listeners:
+        with self._lock:   # see _listener_loop: reads take the guard too
+            has_listeners = bool(self._listeners)
+        if has_listeners:
             while True:
                 try:
                     self._listener_q.put_nowait((event, info))

@@ -79,7 +79,8 @@ class FlightRecorder:
 
     @property
     def dumps(self) -> int:
-        return self._dumps
+        with self._lock:   # written under the lock in dump() (HVD113)
+            return self._dumps
 
     def dump(self, reason: str, path: Optional[str] = None,
              limit: Optional[int] = None) -> int:

@@ -193,7 +193,11 @@ class CollectiveEngine:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
-        self._stop = False
+        # under the guard like every other _stop access: a start() racing
+        # a concurrent stop() (elastic teardown/restart overlap) must not
+        # interleave with the cv-protected stop flag handshake (HVD110)
+        with self._cv:
+            self._stop = False
         if _metrics.RECORDING:
             _metrics.event("engine.start")
         self._thread = threading.Thread(

@@ -15,6 +15,7 @@ name and surface the same warning/abort behavior.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Dict
 
@@ -34,6 +35,12 @@ class StallInspector:
         self.check_time = check_time
         self.shutdown_time = shutdown_time
         self.disabled = disabled or check_time <= 0
+        # guards _pending/_warned/_missing/warnings_issued: record_enqueue
+        # runs on the submitting user thread while check() iterates the
+        # same dicts on the engine thread — unguarded, a submission racing
+        # a scan dies with "dictionary changed size during iteration"
+        # (found by hvdlint's guarded-by pass, HVD110/HVD113 family)
+        self._lock = threading.Lock()
         self._pending: Dict[str, float] = {}
         self._warned: Dict[str, float] = {}
         # tensor name -> processes that have not submitted it, reported by
@@ -59,32 +66,40 @@ class StallInspector:
         if self._native is not None:
             self._native.record_enqueue(name, t)
         else:
-            self._pending.setdefault(name, t)
+            with self._lock:
+                self._pending.setdefault(name, t)
 
     def record_missing(self, name: str, processes):
         """Record which processes have not announced ``name`` (from the
         cross-process controller's negotiation round)."""
         if self.disabled:
             return
-        self._missing[name] = sorted(set(int(p) for p in processes))
+        with self._lock:
+            self._missing[name] = sorted(set(int(p) for p in processes))
 
     def missing_processes(self, name: str):
-        return list(self._missing.get(name, []))
+        with self._lock:
+            return list(self._missing.get(name, []))
 
     def record_complete(self, name: str):
         if self.disabled:
             return
-        self._missing.pop(name, None)
-        # _warned is cleared on BOTH paths: the native tracker keeps its
-        # own warned set, but _warn() mirrors warned names into this dict
-        # (so warnings_issued bookkeeping is path-independent) — a tensor
-        # that completes after warning must reset either way, or a later
-        # genuine re-stall of the same name would go unwarned
-        self._warned.pop(name, None)
+        with self._lock:
+            self._missing.pop(name, None)
+            # _warned is cleared on BOTH paths: the native tracker keeps
+            # its own warned set, but _warn() mirrors warned names into
+            # this dict (so warnings_issued bookkeeping is path-
+            # independent) — a tensor that completes after warning must
+            # reset either way, or a later genuine re-stall of the same
+            # name would go unwarned.  _pending is popped in the SAME
+            # critical section: split sections would let check() observe
+            # the name still pending with its warned entry already gone
+            # and re-warn a completing tensor
+            self._warned.pop(name, None)
+            if self._native is None:
+                self._pending.pop(name, None)
         if self._native is not None:
             self._native.record_complete(name)
-        else:
-            self._pending.pop(name, None)
 
     def check(self, now: float = None):
         """Scan pending tensors; warn on stalls, raise past the shutdown bar.
@@ -102,10 +117,16 @@ class StallInspector:
                 self._abort(name, age)
             self._warn(stalled, now)
             return
+        # scan a snapshot: record_enqueue() on the submitting thread must
+        # not resize the dict mid-iteration (the race the guarded-by
+        # analyzer exists to catch)
+        with self._lock:
+            pending = list(self._pending.items())
+            warned = set(self._warned)
         stalled = []
-        for name, t0 in self._pending.items():
+        for name, t0 in pending:
             age = now - t0
-            if age > self.check_time and name not in self._warned:
+            if age > self.check_time and name not in warned:
                 stalled.append((name, age))
             if self.shutdown_time > 0 and age > self.shutdown_time:
                 self._abort(name, age)
@@ -116,7 +137,7 @@ class StallInspector:
         recorder first (the black-box read of what led to the stall)."""
         if _metrics.RECORDING:
             _metrics.event("stall.abort", tensor=name, age_s=round(age, 1),
-                           missing=self._missing.get(name, []))
+                           missing=self.missing_processes(name))
             _metrics.flight_dump("StallError: stalled tensor")
         raise StallError(
             f"tensor {self._describe(name, age)} stalled past "
@@ -124,7 +145,7 @@ class StallInspector:
             f"{self.shutdown_time:.0f}; aborting")
 
     def _describe(self, name: str, age: float) -> str:
-        missing = self._missing.get(name)
+        missing = self.missing_processes(name)
         if missing:
             return f"{name} ({age:.0f}s; missing on processes {missing})"
         return f"{name} ({age:.0f}s)"
@@ -135,9 +156,10 @@ class StallInspector:
         now = time.monotonic() if now is None else now
         # mirror warned names on both paths so record_complete's reset
         # (and tests over the bookkeeping) see one source of truth
-        for n, _ in stalled:
-            self._warned.setdefault(n, now)
-        self.warnings_issued += 1
+        with self._lock:
+            for n, _ in stalled:
+                self._warned.setdefault(n, now)
+            self.warnings_issued += 1
         if _metrics.ACTIVE:
             _m_warnings.inc()
         if _metrics.RECORDING:

@@ -480,10 +480,12 @@ def test_antipatterns_fixture_trips_every_user_rule():
     path = os.path.join(REPO, "examples", "antipatterns.py")
     # skip-file honored by default (CI stage 8 stays green) ...
     assert analyze_paths([path]) == []
-    # ... and every documented antipattern fires under --include-skipped
+    # ... and every documented antipattern fires under --include-skipped,
+    # including the RacyMetricsSink guarded-by fixture
     found = [f.code for f in analyze_paths([path], include_skipped=True)]
     assert sorted(set(found)) == [
-        "HVD001", "HVD002", "HVD003", "HVD004", "HVD005", "HVD006"]
+        "HVD001", "HVD002", "HVD003", "HVD004", "HVD005", "HVD006",
+        "HVD110", "HVD111", "HVD113", "HVD114"]
 
 
 def test_cli_json_output_and_exit_codes():
@@ -659,6 +661,89 @@ def test_hvd005_async_variant_shares_base_op():
 import horovod_tpu as hvd
 hvd.allreduce(x, name="t", op=hvd.Sum)
 hvd.allreduce_async(y, name="t", op=hvd.Sum)
+"""
+    assert codes(src) == []
+
+
+def test_hvd001_through_helper_function():
+    # one-level interprocedural upgrade: the helper submits the
+    # collective, the rank-conditional CALL site is the hazard
+    src = """
+import horovod_tpu as hvd
+def log_metrics(x):
+    return hvd.allreduce(x, name="metrics")
+if hvd.rank() == 0:
+    log_metrics(m)
+"""
+    assert codes(src) == ["HVD001"]
+
+
+def test_hvd003_through_helper_in_except():
+    src = """
+import horovod_tpu as hvd
+def sync():
+    hvd.barrier()
+try:
+    step()
+except Exception:
+    sync()
+"""
+    assert codes(src) == ["HVD003"]
+
+
+def test_hvd006_through_helper_in_jit():
+    src = """
+import jax
+import horovod_tpu as hvd
+def reduce_grads(g):
+    return hvd.allreduce(g)
+@jax.jit
+def step(g):
+    return reduce_grads(g)
+"""
+    assert codes(src) == ["HVD006"]
+
+
+def test_helper_call_outside_hazard_context_is_clean():
+    # the helper itself is fine, and an unconditional call site is fine;
+    # only ONE level is expanded (a helper-of-a-helper stays silent)
+    src = """
+import horovod_tpu as hvd
+def log_metrics(x):
+    return hvd.allreduce(x)
+def indirect(x):
+    return log_metrics(x)
+log_metrics(m)
+if hvd.rank() == 0:
+    indirect(m)
+"""
+    assert codes(src) == []
+
+
+def test_helper_factory_defining_closure_is_not_a_helper():
+    # review regression: a factory that only DEFINES a collective-bearing
+    # closure submits nothing when called — calling it under a rank
+    # branch is safe
+    src = """
+import horovod_tpu as hvd
+def make_hook():
+    def hook(x):
+        return hvd.allreduce(x)
+    return hook
+if hvd.rank() == 0:
+    h = make_hook()
+"""
+    assert codes(src) == []
+
+
+def test_helper_expansion_ignores_foreign_functions():
+    # a local function with no provable collective never expands
+    src = """
+import horovod_tpu as hvd
+def log_metrics(x):
+    return print(x)
+if hvd.rank() == 0:
+    log_metrics(m)
 """
     assert codes(src) == []
 

@@ -1,0 +1,834 @@
+"""hvdlint v2 tests: call graph, guarded-by inference (HVD110–115),
+baseline ratchet, CLI satellites, and the pre-fix shapes of the real
+races the detector caught in the framework core (docs/analysis.md)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.analysis import analyze_paths, analyze_source
+from horovod_tpu.analysis import baseline as baseline_mod
+from horovod_tpu.analysis import callgraph
+from horovod_tpu.analysis.cli import changed_files, expand_select
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def guard_codes(src, **kw):
+    return [f.code for f in analyze_source(
+        textwrap.dedent(src), "fixture.py", engines=("guards",), **kw)]
+
+
+def guard_findings(src, **kw):
+    return analyze_source(textwrap.dedent(src), "fixture.py",
+                          engines=("guards",), **kw)
+
+
+# ---------------------------------------------------------------------------
+# call graph: thread-entry detection and resolution
+# ---------------------------------------------------------------------------
+
+def build(src):
+    import ast
+    return callgraph.build_graph(ast.parse(textwrap.dedent(src)))
+
+
+def test_callgraph_thread_target_method():
+    g = build("""
+    import threading
+    class Engine:
+        def start(self):
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._t.start()
+        def _loop(self):
+            pass
+    """)
+    roots = g.thread_roots("Engine")
+    assert [r.qname for r in roots] == ["Engine._loop"]
+    assert roots[0].entry_via == "thread"
+
+
+def test_callgraph_handler_table_and_get_routes():
+    g = build("""
+    class Driver:
+        def __init__(self):
+            self._server = Server({"result": self._on_result},
+                                  get_routes={"metrics": self._metrics})
+        def _on_result(self, payload):
+            pass
+        def _metrics(self):
+            pass
+    """)
+    via = {r.qname: r.entry_via for r in g.thread_roots("Driver")}
+    assert via == {"Driver._on_result": "handler_table",
+                   "Driver._metrics": "handler_table"}
+
+
+def test_callgraph_executor_submit_and_nested_target():
+    g = build("""
+    import threading
+    class Pool:
+        def go(self, ex):
+            def work():
+                pass
+            ex.submit(self._task)
+            threading.Thread(target=work).start()
+        def _task(self):
+            pass
+    """)
+    via = {r.qname: r.entry_via for r in g.thread_roots("Pool")}
+    assert via == {"Pool._task": "executor",
+                   "Pool.go.<work>": "thread"}
+
+
+def test_callgraph_reachability_through_self_calls():
+    g = build("""
+    class C:
+        def _loop(self):
+            self._step()
+        def _step(self):
+            self._leaf()
+        def _leaf(self):
+            pass
+        def other(self):
+            pass
+    """)
+    assert g.reachable("C._loop") == {"C._loop", "C._step", "C._leaf"}
+
+
+def test_callgraph_module_function_edges():
+    g = build("""
+    def helper():
+        pass
+    def main():
+        helper()
+    """)
+    assert "helper" in g.functions["main"].calls
+
+
+# ---------------------------------------------------------------------------
+# guarded-by inference: one fixture per rule, plus the near-misses
+# ---------------------------------------------------------------------------
+
+RACY_COUNTER = """
+import threading
+class Sink:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+    def _guarded_a(self):
+        with self._lock:
+            self._total += 1
+            return self._total
+    def _guarded_b(self):
+        with self._lock:
+            return self._total
+    def read(self):
+        return self._total
+    def BUG(self):
+        pass
+"""
+
+
+def test_hvd110_unguarded_write_with_majority_guard():
+    src = RACY_COUNTER.replace("    def BUG(self):\n        pass\n", """
+    def BUG(self):
+        self._total = 0
+""")
+    found = guard_findings(src)
+    assert [f.code for f in found] == ["HVD110"]
+    assert "_total" in found[0].message and "_lock" in found[0].message
+
+
+def test_hvd111_unguarded_augassign():
+    src = RACY_COUNTER.replace("    def BUG(self):\n        pass\n", """
+    def BUG(self):
+        self._total += 1
+""")
+    assert guard_codes(src) == ["HVD111"]
+
+
+def test_hvd111_swap_assignment_is_rmw():
+    src = RACY_COUNTER.replace("    def BUG(self):\n        pass\n", """
+    def BUG(self):
+        t, self._total = self._total, 0
+        return t
+""")
+    assert guard_codes(src) == ["HVD111"]
+
+
+def test_hvd111_check_then_act_with_guarded_act():
+    src = """
+    import threading
+    class Lazy:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._conn = None
+        def get(self):
+            if self._conn is None:
+                with self._lock:
+                    self._conn = object()
+            with self._lock:
+                return self._conn
+    """
+    assert "HVD111" in guard_codes(src)
+
+
+def test_hvd112_container_returned_by_reference():
+    src = """
+    import threading
+    class Ring:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._events = []
+        def record(self, ev):
+            with self._lock:
+                self._events.append(ev)
+        def events(self):
+            with self._lock:
+                return self._events
+    """
+    assert guard_codes(src) == ["HVD112"]
+
+
+def test_hvd112_clean_when_copy_returned():
+    src = """
+    import threading
+    class Ring:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._events = []
+        def record(self, ev):
+            with self._lock:
+                self._events.append(ev)
+        def events(self):
+            with self._lock:
+                return list(self._events)
+    """
+    assert guard_codes(src) == []
+
+
+def test_hvd113_writes_guarded_reads_not():
+    src = """
+    import threading
+    class Reg:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._states = {}
+        def record(self, k, v):
+            with self._lock:
+                self._states[k] = v
+        def peek(self, k):
+            return self._states.get(k)
+    """
+    found = guard_findings(src)
+    assert [f.code for f in found] == ["HVD113"]
+    assert "peek" in found[0].message
+
+
+def test_hvd113_clean_when_reads_guarded():
+    src = """
+    import threading
+    class Reg:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._states = {}
+        def record(self, k, v):
+            with self._lock:
+                self._states[k] = v
+        def peek(self, k):
+            with self._lock:
+                return self._states.get(k)
+    """
+    assert guard_codes(src) == []
+
+
+def test_hvd114_attribute_published_after_thread_start():
+    src = """
+    import threading
+    class Loop:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._thread = threading.Thread(target=self._loop)
+            self._thread.start()
+            self._interval = 0.5
+        def _loop(self):
+            return self._interval
+    """
+    found = guard_findings(src)
+    assert [f.code for f in found] == ["HVD114"]
+    assert "_interval" in found[0].message
+
+
+def test_hvd114_clean_when_published_before_start():
+    src = """
+    import threading
+    class Loop:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._interval = 0.5
+            self._thread = threading.Thread(target=self._loop)
+            self._thread.start()
+        def _loop(self):
+            return self._interval
+    """
+    assert guard_codes(src) == []
+
+
+def test_hvd114_only_for_attrs_the_thread_reads():
+    src = """
+    import threading
+    class Loop:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._thread = threading.Thread(target=self._loop)
+            self._thread.start()
+            self._label = "x"      # never read by _loop: clean
+        def _loop(self):
+            pass
+        def label(self):
+            return self._label
+    """
+    assert guard_codes(src) == []
+
+
+def test_hvd114_handler_table_counts_as_spawn():
+    # the RPC-server idiom: constructing the server starts its serve
+    # thread inside its own __init__, so attributes assigned after the
+    # construction race the first incoming request
+    src = """
+    import threading
+    class Manager:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._server = Server({"hosts_updated": self._on_update})
+            self._listeners = []
+        def _on_update(self, payload):
+            return list(self._listeners)
+    """
+    found = guard_findings(src)
+    assert [f.code for f in found] == ["HVD114"]
+    assert "_listeners" in found[0].message
+
+
+def test_hvd115_split_guard():
+    src = """
+    import threading
+    class Split:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self._n = 0
+        def writer(self):
+            with self._a:
+                self._n += 1
+        def reader(self):
+            with self._b:
+                return self._n
+    """
+    found = guard_findings(src)
+    assert [f.code for f in found] == ["HVD115"]
+    assert "_a" in found[0].message and "_b" in found[0].message
+
+
+def test_no_guard_inferred_means_no_findings():
+    # the documented Eraser limitation: an attribute with zero guarded
+    # sites has no inferred guard to violate (single-writer counters)
+    src = """
+    import threading
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cycles = 0
+        def _loop(self):
+            self._cycles += 1
+        def stats(self):
+            return self._cycles
+    """
+    assert guard_codes(src) == []
+
+
+def test_ambient_held_through_private_helper():
+    # the registry idiom: a private helper documented "caller must hold
+    # self._lock" and only ever called with it held — no finding
+    src = """
+    import threading
+    class Reg:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._children = {}
+        def _child(self, k):
+            c = self._children.get(k)
+            if c is None:
+                c = []
+                self._children[k] = c
+            return c
+        def inc(self, k):
+            with self._lock:
+                self._child(k).append(1)
+        def snapshot(self):
+            with self._lock:
+                return dict(self._children)
+    """
+    assert guard_codes(src) == []
+
+
+def test_thread_root_gets_no_ambient_locks():
+    # review regression: a private method that IS a thread entry point
+    # runs with no lock held, even when an intra-class caller invokes it
+    # under the lock — the ambient must not silence its races
+    src = """
+    import threading
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            threading.Thread(target=self._work).start()
+        def _work(self):
+            self._count += 1
+        def kick(self):
+            with self._lock:
+                self._work()
+        def _guarded(self):
+            with self._lock:
+                self._count += 1
+                return self._count
+        def total(self):
+            with self._lock:
+                return self._count
+    """
+    found = guard_findings(src)
+    assert [f.code for f in found] == ["HVD111"]
+    assert "_work" in found[0].message
+
+
+def test_hvd114_nonthread_start_is_not_a_spawn():
+    # review regression: server/timer .start() before the real
+    # Thread.start() must not move the spawn line earlier
+    src = """
+    import threading
+    class M:
+        def __init__(self, server):
+            self._lock = threading.Lock()
+            server.start()
+            self._interval = 0.5
+            self._thread = threading.Thread(target=self._drain)
+            self._thread.start()
+        def _drain(self):
+            return self._interval
+    """
+    assert guard_codes(src) == []
+
+
+def test_condition_alias_counts_as_underlying_lock():
+    # Condition(self._lock): 'with self._cv:' holds the same lock, so
+    # mixed cv/lock guarding is consistent, not a split guard
+    src = """
+    import threading
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+            self._items = []
+        def put(self, x):
+            with self._cv:
+                self._items.append(x)
+        def drain(self):
+            with self._lock:
+                out, self._items = self._items, []
+                return out
+        def peek_len(self):
+            with self._cv:
+                return len(self._items)
+    """
+    assert guard_codes(src) == []
+
+
+def test_readonly_config_attr_is_silent():
+    src = """
+    import threading
+    class D:
+        def __init__(self, timeout):
+            self._lock = threading.Lock()
+            self.timeout = timeout
+            self._state = {}
+        def a(self):
+            with self._lock:
+                self._state["t"] = self.timeout
+        def b(self):
+            return self.timeout
+    """
+    assert guard_codes(src) == []
+
+
+def test_guard_rule_suppression_comment():
+    src = RACY_COUNTER.replace("    def BUG(self):\n        pass\n", """
+    def BUG(self):
+        self._total = 0  # hvdlint: disable=HVD110
+""")
+    assert guard_codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# real races fixed in this PR: the detector convicts the PRE-FIX shapes
+# ---------------------------------------------------------------------------
+
+def test_prefix_engine_start_stop_flag():
+    # ops/engine.py pre-fix: start() wrote _stop with no guard while
+    # every other access held the cv's underlying lock (HVD110)
+    src = """
+    import threading
+    class CollectiveEngine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+            self._stop = False
+        def start(self):
+            self._stop = False
+        def stop(self):
+            with self._cv:
+                self._stop = True
+                self._cv.notify_all()
+        def _loop(self):
+            with self._cv:
+                while not self._stop:
+                    self._cv.wait(timeout=0.1)
+        def submit(self):
+            with self._cv:
+                if self._stop:
+                    return False
+            return True
+    """
+    found = guard_findings(src)
+    assert [f.code for f in found] == ["HVD110"]
+    assert "start" in found[0].message and "_stop" in found[0].message
+
+
+def test_prefix_driver_listeners():
+    # elastic/driver.py pre-fix: add_listener appended under _lock, the
+    # dispatch loop and _emit read the list bare (HVD113)
+    src = """
+    import threading
+    class ElasticDriver:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._listeners = []
+            self._server = Server({"running": self._handle_running})
+        def add_listener(self, cb):
+            with self._lock:
+                self._listeners.append(cb)
+        def _handle_running(self, payload):
+            self._emit("running")
+        def _emit(self, event):
+            for cb in list(self._listeners):
+                cb(event)
+    """
+    assert guard_codes(src) == ["HVD113"]
+
+
+def test_prefix_flight_recorder_dumps():
+    # metrics/flight.py pre-fix: dump() incremented under the lock, the
+    # dumps property read bare (HVD113)
+    src = """
+    import threading
+    class FlightRecorder:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._dumps = 0
+        def dump(self):
+            with self._lock:
+                self._dumps += 1
+        @property
+        def dumps(self):
+            return self._dumps
+    """
+    assert guard_codes(src) == ["HVD113"]
+
+
+def test_stall_inspector_concurrent_enqueue_vs_check():
+    # stall.py pre-fix: record_enqueue (submit thread) resized _pending
+    # while check() (engine thread) iterated it.  Post-fix both sides
+    # take the inspector's lock; this hammer must stay green.
+    import threading
+
+    from horovod_tpu.stall import StallInspector
+
+    # check_time high: nothing ever counts as stalled, so check() stays a
+    # pure scan of _pending — the exact dict the producer resizes
+    insp = StallInspector(check_time=1e9, shutdown_time=0.0,
+                          use_native=False)
+    stop = threading.Event()
+    errors = []
+
+    def producer():
+        for i in range(200_000):
+            if stop.is_set():
+                return
+            insp.record_enqueue(f"t{i}", 0.0)
+            if i % 3 == 0:
+                insp.record_complete(f"t{i - 1}")
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        for _ in range(300):
+            try:
+                insp.check(now=1.0)
+            except RuntimeError as exc:   # dict resized during iteration
+                errors.append(exc)
+                break
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errors, errors
+
+
+def test_current_core_modules_are_clean_under_guards():
+    for rel in ("horovod_tpu/ops/engine.py", "horovod_tpu/stall.py",
+                "horovod_tpu/elastic/driver.py",
+                "horovod_tpu/runner/rpc.py",
+                "horovod_tpu/metrics/flight.py",
+                "horovod_tpu/metrics/registry.py"):
+        path = os.path.join(REPO, rel)
+        with open(path) as f:
+            findings = analyze_source(f.read(), rel, engines=("guards",))
+        assert findings == [], (rel, [f.format_text() for f in findings])
+
+
+# ---------------------------------------------------------------------------
+# acceptance pin: deleting a `with self._lock:` in a COPY of ops/engine.py
+# makes the detector convict that file
+# ---------------------------------------------------------------------------
+
+def test_lock_deletion_in_engine_copy_is_detected():
+    with open(os.path.join(REPO, "horovod_tpu", "ops", "engine.py")) as f:
+        src = f.read()
+    guarded = ("        with self._lock:\n"
+               "            entries, self._queue = self._queue, []\n"
+               "            self._cycle_active = bool(entries)\n")
+    assert guarded in src, "engine.py drain block changed; update fixture"
+    mutated = src.replace(guarded, (
+        "        entries, self._queue = self._queue, []\n"
+        "        self._cycle_active = bool(entries)\n"))
+    found = analyze_source(mutated, "engine_mutated.py",
+                           engines=("guards",))
+    codes = {f.code for f in found}
+    assert "HVD111" in codes    # the queue swap is a read-modify-write
+    assert "HVD110" in codes    # the _cycle_active flag write
+    attrs = " ".join(f.message for f in found)
+    assert "_queue" in attrs and "_cycle_active" in attrs
+
+
+# ---------------------------------------------------------------------------
+# framework-wide pin: the tree matches the shipped baseline (near-empty)
+# ---------------------------------------------------------------------------
+
+def test_framework_matches_shipped_baseline():
+    # fingerprints canonicalize paths to repo-root-relative, so the
+    # absolute analyze_paths invocation matches CI's relative one
+    findings = analyze_paths([os.path.join(REPO, "horovod_tpu"),
+                              os.path.join(REPO, "examples")],
+                             engines=("guards",))
+    allowed = baseline_mod.load(
+        os.path.join(REPO, "tools", "hvdlint_baseline.json"))
+    new, _ = baseline_mod.apply(findings, allowed)
+    assert new == [], [f.format_text() for f in new]
+
+
+def test_fingerprint_path_spelling_is_canonical():
+    # review regression: absolute, cwd-relative, and ../-style relative
+    # invocations must all fingerprint a repo file identically, or a
+    # populated baseline false-fails for anyone not in CI's cwd
+    from horovod_tpu.analysis.report import Finding
+    rel = Finding("HVD110", "horovod_tpu/stall.py", 1, 0, "m 3/5")
+    absolute = Finding("HVD110", os.path.join(REPO, "horovod_tpu",
+                                              "stall.py"), 9, 0, "m 4/6")
+    dotted = Finding("HVD110", os.path.join(REPO, "tests", "..",
+                                            "horovod_tpu", "stall.py"),
+                     2, 0, "m 1/2")
+    cwd = os.getcwd()
+    os.chdir(REPO)
+    try:
+        fps = {baseline_mod.fingerprint(f)
+               for f in (rel, absolute, dotted)}
+    finally:
+        os.chdir(cwd)
+    assert len(fps) == 1, fps
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet mechanics
+# ---------------------------------------------------------------------------
+
+def test_baseline_roundtrip_suppresses_and_ratchets(tmp_path):
+    racy = RACY_COUNTER.replace("    def BUG(self):\n        pass\n", """
+    def BUG(self):
+        self._total = 0
+""")
+    fixture = tmp_path / "racy.py"
+    fixture.write_text(textwrap.dedent(racy))
+    base = tmp_path / "baseline.json"
+
+    findings = analyze_paths([str(fixture)], engines=("guards",))
+    assert [f.code for f in findings] == ["HVD110"]
+    baseline_mod.save(str(base), findings)
+
+    allowed = baseline_mod.load(str(base))
+    new, suppressed = baseline_mod.apply(findings, allowed)
+    assert new == [] and suppressed == 1
+
+    # line drift does not invalidate the entry (digits are collapsed) …
+    drifted = textwrap.dedent("# a comment\n" + racy)
+    fixture.write_text(drifted)
+    findings2 = analyze_paths([str(fixture)], engines=("guards",))
+    new2, _ = baseline_mod.apply(findings2, baseline_mod.load(str(base)))
+    assert new2 == []
+
+    # … but a NEW finding (another attribute) is not matched
+    racy3 = """
+    import threading
+    class Sink:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._total = 0
+            self._other = 0
+        def _guarded_a(self):
+            with self._lock:
+                self._total += 1
+                self._other += 1
+                return self._total
+        def _guarded_b(self):
+            with self._lock:
+                return self._total + self._other
+        def read(self):
+            return self._total
+        def BUG(self):
+            self._total = 0
+            self._other = 0
+    """
+    fixture.write_text(textwrap.dedent(racy3))
+    findings3 = analyze_paths([str(fixture)], engines=("guards",))
+    assert len(findings3) > 1
+    new3, _ = baseline_mod.apply(findings3, baseline_mod.load(str(base)))
+    assert new3 and all("_other" in f.message for f in new3)
+
+
+def test_baseline_cli_update_and_gate(tmp_path):
+    racy = RACY_COUNTER.replace("    def BUG(self):\n        pass\n", """
+    def BUG(self):
+        self._total = 0
+""")
+    fixture = tmp_path / "racy.py"
+    fixture.write_text(textwrap.dedent(racy))
+    base = tmp_path / "baseline.json"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.analysis", *args],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+
+    # without a baseline: findings, exit 1
+    assert run(str(fixture)).returncode == 1
+    # --update-baseline records them, exit 0
+    proc = run("--baseline", str(base), "--update-baseline", str(fixture))
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(base.read_text())["findings"]
+    # gated on the baseline: clean, exit 0, counted as baselined
+    proc = run("--baseline", str(base), "--format=json", str(fixture))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["count"] == 0 and payload["baselined"] == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI satellites: --select ranges, --explain, --changed
+# ---------------------------------------------------------------------------
+
+def test_expand_select_ranges():
+    codes, unknown = expand_select("HVD110-HVD115")
+    assert codes == ["HVD110", "HVD111", "HVD112", "HVD113", "HVD114",
+                     "HVD115"] and not unknown
+    codes, unknown = expand_select("HVD001,HVD110-112")
+    assert codes == ["HVD001", "HVD110", "HVD111", "HVD112"]
+    _, unknown = expand_select("HVD110-HVD999")
+    assert unknown == ["HVD110-HVD999"]
+    _, unknown = expand_select("HVD115-HVD110")
+    assert unknown == ["HVD115-HVD110"]
+
+
+def test_select_range_cli_end_to_end():
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis",
+         "--select", "HVD110-HVD115", "--include-skipped", "--format=json",
+         os.path.join("examples", "antipatterns.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    codes = {f["code"] for f in json.loads(proc.stdout)["findings"]}
+    assert codes == {"HVD110", "HVD111", "HVD113", "HVD114"}
+
+
+def test_explain_cli():
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis",
+         "--explain", "HVD113"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    assert "HVD113" in proc.stdout and "lock" in proc.stdout.lower()
+
+
+def test_changed_files_against_base(tmp_path):
+    def git(*args):
+        subprocess.run(["git", "-C", str(tmp_path), *args], check=True,
+                       capture_output=True,
+                       env=dict(os.environ,
+                                GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+                                GIT_COMMITTER_NAME="t",
+                                GIT_COMMITTER_EMAIL="t@t"))
+    git("init", "-q")
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "a.py").write_text("x = 1\n")
+    (tmp_path / "b.py").write_text("y = 1\n")
+    (tmp_path / "c.txt").write_text("not python\n")
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    (tmp_path / "a.py").write_text("x = 2\n")
+    (tmp_path / "c.txt").write_text("still not python\n")
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        assert changed_files("HEAD") == ["a.py"]
+        assert changed_files("HEAD", ["b.py"]) == []
+        with pytest.raises(RuntimeError):
+            changed_files("no-such-ref")
+    finally:
+        os.chdir(cwd)
+    # review regression: git names are repo-root-relative — running from
+    # a subdirectory must still resolve (and lint) the changed files
+    os.chdir(tmp_path / "sub")
+    try:
+        assert changed_files("HEAD") == [os.path.join("..", "a.py")]
+    finally:
+        os.chdir(cwd)
+
+
+def test_update_baseline_rejects_filtered_runs(tmp_path):
+    # review regression: rewriting the ratchet from a filtered subset
+    # would silently drop every entry the filter excluded
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    base = tmp_path / "b.json"
+    for extra in (["--select", "HVD110"], ["--changed"],
+                  ["--engine", "user"]):
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.analysis",
+             "--baseline", str(base), "--update-baseline", *extra,
+             "horovod_tpu/"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 2
+        assert "full run" in proc.stderr

@@ -17,8 +17,9 @@
 #   5. purepy:  the HOROVOD_TPU_NATIVE_CORE=0 fallback paths
 #   6. noctl:   single-process semantics with the controller disabled
 #   7. full:    the whole suite (skipped with --quick)
-#   8. hvdlint: static collective-consistency + lock-order analysis over
-#      the framework and examples (docs/analysis.md)
+#   8. hvdlint: static collective-consistency, lock-order and guarded-by
+#      race analysis over the framework and examples, gated on the
+#      findings baseline (docs/analysis.md)
 #   9. chaos:   the elastic join path under pinned fault-injection seeds
 #      must converge, and the leader-join regression stays pinned
 #      (docs/env.md "Chaos engineering")
@@ -121,7 +122,12 @@ if [ "${1:-}" != "--quick" ]; then
 fi
 
 echo "== 8/9 hvdlint static analysis =="
-python -m horovod_tpu.analysis horovod_tpu/ examples/
+# all three engines (user rules, lock-order, guarded-by race detector);
+# --baseline: fail only on NEW findings vs the checked-in ratchet
+# (near-empty by policy — docs/analysis.md "Baseline workflow").  One
+# parse per file feeds every engine, keeping the stage well under 30s.
+python -m horovod_tpu.analysis \
+  --baseline tools/hvdlint_baseline.json horovod_tpu/ examples/
 
 echo "== 9/9 chaos smoke: elastic join under fixed fault seeds =="
 python -m pytest tests/test_chaos.py -q \
