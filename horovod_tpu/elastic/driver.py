@@ -175,6 +175,16 @@ class ElasticDriver:
         # directions is HMAC-verified (upstream runner request signing)
         os.environ.setdefault(_secret.SECRET_ENV, _secret.make_secret_key())
         self._epoch_t0 = time.monotonic()
+        # event-driven control-plane KV (runner/kv.py): ONE store for the
+        # driver's lifetime, shared by every epoch — workers namespace
+        # their negotiation keys per incarnation, so epochs never
+        # collide.  Assigned BEFORE the RPC server below goes live: its
+        # handlers (worker-env assembly) read the attribute
+        from ..runner import kv as _kv
+        self._kv_server = _kv.start_kv_server(
+            self.extra_env,
+            expected_procs=(self.max_np if self.max_np is not None
+                            else self.min_np))
         self._server = JsonRpcServer({
             "assignment": self._handle_assignment,
             "result": self._handle_result,
@@ -536,6 +546,14 @@ class ElasticDriver:
             self._gate_open = not assigned_wids
             self._gate_deadline = time.monotonic() + self.start_timeout
             self._epoch_formed = False
+        # epochs two re-forms back are unreachable: every worker either
+        # passed the intervening epoch's release gate (re-namespacing its
+        # negotiation keys to the new ``e{N}``) or died.  A crashed
+        # incarnation never runs controller.cleanup_keys(), so the driver
+        # — whose KvStore lives for the whole job — sweeps its namespace
+        # here; otherwise dead round keys accumulate and every
+        # watch/dir-get reply pays the full-store snapshot scan for them
+        self._prune_dead_epoch_keys(epoch)
         if self.verbose:
             print(f"elastic: epoch {epoch} — {np_} slots on "
                   f"{list(hosts)}", file=sys.stderr)
@@ -548,6 +566,32 @@ class ElasticDriver:
         self._emit("epoch_applied", epoch=epoch, size=np_,
                    hosts=dict(hosts),
                    spawned=[wid for wid, _ in to_spawn])
+
+    def _prune_dead_epoch_keys(self, epoch: int) -> None:
+        """Subtree-delete ``hvdctl/e{M}/`` for every M ≤ ``epoch`` - 2 in
+        the driver-hosted KV store.  Stateless: the (rare, per-reform)
+        root snapshot rediscovers surviving dead namespaces, so a sweep
+        needs no cross-reform bookkeeping and no extra lock discipline —
+        the store's own lock covers each call."""
+        srv = self._kv_server
+        if srv is None or epoch < 2:
+            return
+        from ..runner import kv as _kv
+        root = _kv.CTL_KEY_PREFIX + "/"
+        entries, _ver = srv.store.dir_get(root)
+        dead = set()
+        for key, _v in entries:
+            ns = key[len(root):].split("/", 1)[0]
+            if not ns.startswith("e"):
+                continue
+            try:
+                n = int(ns[1:])
+            except ValueError:
+                continue
+            if n <= epoch - 2:
+                dead.add(ns)
+        for ns in sorted(dead):
+            srv.store.delete(f"{root}{ns}/")
 
     def _spawn_worker(self, wid: int, slot, coord_addr, coord_port, epoch,
                       driver_addr: str):
@@ -562,6 +606,11 @@ class ElasticDriver:
             "HOROVOD_ELASTIC_DRIVER_PORT": str(self.port),
             "HOROVOD_HOSTNAME": slot.hostname,
         })
+        if self._kv_server is not None:
+            # same machine (and NIC-aware address) as the driver RPC
+            from ..runner import kv as _kv
+            env[_kv.KV_ADDR_ENV] = (
+                f"{driver_addr}:{self._kv_server.port}")
         if self.network_interface:
             # workers resolve their notification endpoint with the same
             # interface selection as the driver (docs/env.md contract);
@@ -673,6 +722,8 @@ class ElasticDriver:
             # before the daemon dispatch thread dies with the process
             self.flush_listeners()
             self._server.close()
+            if self._kv_server is not None:
+                self._kv_server.close()
 
     def _monitor(self) -> int:
         last_poll = 0.0

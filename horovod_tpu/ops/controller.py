@@ -53,6 +53,7 @@ import dataclasses
 import hashlib
 import json
 import logging
+import os
 import random
 import threading
 import time
@@ -67,25 +68,65 @@ from ..exceptions import HorovodInternalError, StallError
 
 logger = logging.getLogger("horovod_tpu")
 
+# must equal runner/kv.py CTL_KEY_PREFIX (pinned by tests/test_kv.py);
+# duplicated because the runner layer must not enter this module's
+# import chain
 _KEY_PREFIX = "hvdctl"
 
 # -- metric families (docs/metrics.md; sites guard on _metrics.ACTIVE) --------
 _m_neg_rounds = _metrics.counter(
     "hvd_negotiation_rounds_total",
-    "Negotiation rounds by outcome (fast = hash-only steady state)",
+    "Negotiation rounds by outcome (fast = hash-only steady state); "
+    "kind=watch is a transport marker counted alongside the outcome for "
+    "rounds whose peer gather rode the long-poll KV watch",
     labels=("kind",))
 _m_neg_dur = _metrics.histogram(
     "hvd_negotiation_duration_seconds",
     "Wall time of one negotiation round", labels=("kind",), lo=-17, hi=6)
 _m_kv_ops = _metrics.counter(
-    "hvd_kv_ops_total", "Coordination-service KV operations",
+    "hvd_kv_ops_total", "Negotiation-transport KV operations",
     labels=("op",))
 _m_kv_retries = _metrics.counter(
     "hvd_kv_retries_total",
     "KV publishes retried after transient coordination-service errors")
 
 
+_rpc_kv_cache: Dict[str, object] = {}
+_KV_ADDR_BAD = object()   # cached verdict: warn once, not once per round
+
+
+def _rpc_kv_client():
+    """The RPC KV client when the launcher exported ``HOROVOD_KV_ADDR``,
+    else None (jobs launched outside hvdrun — e.g. bare SPMD on a pod —
+    keep the coordination-service transport).  Cached per address —
+    including the malformed verdict — so the keep-alive pool warms once
+    per process and a bad address warns once, not once per round."""
+    # lazy import (see _kv_set): runner must not enter controller's
+    # module-scope import chain
+    from ..runner.kv import KV_ADDR_ENV, RpcKvClient
+    addr = os.environ.get(KV_ADDR_ENV)
+    if not addr or ":" not in addr:
+        return None
+    client = _rpc_kv_cache.get(addr)
+    if client is _KV_ADDR_BAD:
+        return None
+    if client is None:
+        host, port = addr.rsplit(":", 1)
+        try:
+            client = RpcKvClient(host, int(port))
+        except ValueError:
+            logger.warning("malformed %s=%r; using the coordination "
+                           "service", KV_ADDR_ENV, addr)
+            _rpc_kv_cache[addr] = _KV_ADDR_BAD
+            return None
+        _rpc_kv_cache[addr] = client
+    return client
+
+
 def _client():
+    client = _rpc_kv_client()
+    if client is not None:
+        return client
     from jax._src import distributed
     client = distributed.global_state.client
     if client is None:
@@ -256,6 +297,24 @@ class Controller:
         # dir-get each time), after a short grace so fast rounds pay zero
         self._left_check_grace_s = 0.5
         self._left_check_s = 2.0
+        # event-driven transport (docs/controller.md "Negotiation
+        # transport"): long-poll watches when the client has the verb and
+        # HOROVOD_KV_WATCH is on; sticky fallback to polled dir-gets for
+        # the rest of the incarnation once a watch call errors
+        from ..runner.kv import watch_deadline_s, watch_enabled
+        self._watch_enabled = watch_enabled()
+        self._watch_deadline_s = watch_deadline_s()
+        self._watch_ok = True
+        self._watch_used = False   # set per round under _lock
+        # last store version any watch reply carried (engine thread only):
+        # each gather's FIRST watch arms with it, so a leave marker that
+        # was already delivered does not satisfy the extra-dir predicate
+        # and wastes one immediate-return RPC on every later round
+        self._watch_cursor = 0
+        # leave markers from a reply that SATISFIED its gather (those are
+        # deliberately not scanned — publish-then-leave peers complete the
+        # round); the next gather scans them before arming its watch
+        self._watch_left: List = []
         self._forced_off = False
         if cfg is not None:
             self._forced_off = not getattr(cfg, "controller_enabled", True)
@@ -273,9 +332,11 @@ class Controller:
         # KV transport op counters (prove the O(N)-per-round bound)
         self.kv_sets = 0
         self.kv_dir_gets = 0
+        self.kv_dir_watches = 0
         self.kv_left_gets = 0
         self.kv_blocking_gets = 0   # legacy per-peer fallback only
         self.kv_deletes = 0
+        self.watch_fallbacks = 0    # watch errors that demoted to polling
 
     # -- lifecycle -----------------------------------------------------------
     @property
@@ -325,9 +386,11 @@ class Controller:
                 "cache_evictions": self.cache_evictions,
                 "kv_sets": self.kv_sets,
                 "kv_dir_gets": self.kv_dir_gets,
+                "kv_dir_watches": self.kv_dir_watches,
                 "kv_left_gets": self.kv_left_gets,
                 "kv_blocking_gets": self.kv_blocking_gets,
                 "kv_deletes": self.kv_deletes,
+                "watch_fallbacks": self.watch_fallbacks,
             }
 
     # -- steady-state cache (LRU set; caller must hold self._lock) -----------
@@ -387,6 +450,12 @@ class Controller:
         finally:
             _m_neg_rounds.inc(kind=kind)
             _m_neg_dur.observe(time.monotonic() - t0, kind=kind)
+            # transport marker, alongside the outcome kind: rounds whose
+            # peer gather rode the long-poll watch (docs/metrics.md)
+            with self._lock:
+                used = self._watch_used
+            if used:
+                _m_neg_rounds.inc(kind="watch")
 
     def _negotiate_impl(self, tokens: List[str], procs: Tuple[int, ...],
                         params: Optional[dict] = None,
@@ -407,6 +476,7 @@ class Controller:
             # below run with no lock held, so set_joined()/stats() from
             # user threads return promptly during a slow round.
             with self._lock:
+                self._watch_used = False
                 seq = self._seq.get(gk, 0)
                 self._seq[gk] = seq + 1
                 if self.joined and self._join_seq is None:
@@ -430,6 +500,12 @@ class Controller:
             with self._lock:
                 self.kv_sets += 1
 
+            # age out this process's seq-4 keys NOW, between publish and
+            # gather: the deletes' RPC latency overlaps the peer wait
+            # instead of adding to the round's critical path (they touch
+            # a four-rounds-dead directory, so ordering is free)
+            self._cleanup(client, gk, seq, me)
+
             vals: Dict[int, dict] = {me: val}
             for q, raw in self._gather_round(
                     client, gk, seq, "a", set(procs) - {me}, procs,
@@ -450,7 +526,6 @@ class Controller:
             if not active:
                 # every process has joined: resolve join() everywhere
                 last = max((vals[q].get("js", 0), q) for q in joined_ps)[1]
-                self._cleanup(client, gk, seq, me)
                 return NegotiationResult(all_joined=True, last_joiner=last)
 
             hashes = {vals[q]["h"] for q in active}
@@ -465,7 +540,6 @@ class Controller:
                         self.fast_rounds += 1
                     else:
                         self.full_rounds += 1
-                self._cleanup(client, gk, seq, me)
                 return NegotiationResult(counts=Counter(tokens), fast=fast,
                                          params=agreed_params,
                                          aux=aux_by_proc)
@@ -494,7 +568,6 @@ class Controller:
             result = self._decide(gk, full, active, joined_ps, vals, me)
             result.params = agreed_params
             result.aux = aux_by_proc
-            self._cleanup(client, gk, seq, me)
             return result
 
     # -- decision function (identical on every member) -----------------------
@@ -589,10 +662,28 @@ class Controller:
         return counts, missing, deferred
 
     # -- transport -----------------------------------------------------------
-    def _check_left(self, client, procs: Tuple[int, ...], seq: int,
-                    waiting_for) -> None:
+    def _scan_left_entries(self, entries, seq: int, waiting_for) -> None:
+        """Raise if a marker names a member we still WAIT ON.
+
+        The filter is ``waiting_for`` (the gather's live need set), not
+        the round's full member tuple: a peer that already published
+        everything this round needs from it and THEN left must not
+        abort a round that can complete — its departure surfaces at the
+        first gather that actually waits on it (markers are re-delivered
+        whole on every watch reply, so none is ever missed)."""
+        for k, _ in entries:
+            try:
+                p = int(k.rsplit("/", 1)[1])
+            except ValueError:
+                continue
+            if p in waiting_for:
+                raise HorovodInternalError(
+                    f"process {p} left the job while negotiation round "
+                    f"{seq} was waiting for {sorted(waiting_for)} (peer "
+                    f"shutdown or failure)")
+
+    def _check_left(self, client, seq: int, waiting_for) -> None:
         """ONE dir-get over the leave markers (not a get per peer)."""
-        me = jax.process_index()
         with self._lock:
             self.kv_left_gets += 1
         if _metrics.ACTIVE:
@@ -602,29 +693,33 @@ class Controller:
                 f"{_KEY_PREFIX}/{self.namespace}/left/")
         except Exception:  # noqa: BLE001 - none present
             return
-        for k, _ in entries:
-            try:
-                p = int(k.rsplit("/", 1)[1])
-            except ValueError:
-                continue
-            if p in procs and p != me:
-                raise HorovodInternalError(
-                    f"process {p} left the job while negotiation round "
-                    f"{seq} was waiting for {sorted(waiting_for)} (peer "
-                    f"shutdown or failure)")
+        self._scan_left_entries(entries, seq, waiting_for)
 
     def _gather_round(self, client, gk: str, seq: int, phase: str,
                       need: set, procs: Tuple[int, ...],
                       pending_tokens: List[str]) -> Dict[int, str]:
         """Collect the round keys of ``need`` members.
 
-        One ``key_value_dir_get`` returns every published peer key in a
-        single RPC, so a round costs O(N) cluster-wide instead of the
-        O(N²) of per-peer polled gets (reference bar: controller.cc's one
-        Gatherv + one Bcast per cycle).  Polling backs off exponentially
-        to ``_poll_s``; leave markers are checked with one dir-get at a
-        bounded interval, after a grace that fast rounds never reach.
-        Surfaces stall diagnosis instead of hanging (reference:
+        Event-driven steady state: when the transport has
+        ``key_value_dir_watch`` (the launcher-hosted RPC KV,
+        runner/kv.py) and ``HOROVOD_KV_WATCH`` is on, the server holds
+        each gather until the round directory changes, so wake-up lag is
+        ~one RTT instead of a poll tick; leave markers ride the same
+        watch reply (the ``extra`` directory), so a departing peer wakes
+        the round immediately and the bounded marker polls disappear.  A
+        watch error demotes this controller to the polled path for the
+        rest of the incarnation (``watch_fallbacks`` stat) — chaos seeds
+        dropping ``rpc.request:key_value_dir_watch`` pin that the round
+        still converges.
+
+        Polled fallback: one ``key_value_dir_get`` returns every
+        published peer key in a single RPC, so a round costs O(N)
+        cluster-wide instead of the O(N²) of per-peer polled gets
+        (reference bar: controller.cc's one Gatherv + one Bcast per
+        cycle).  Polling backs off exponentially to ``_poll_s``; leave
+        markers are checked with one dir-get at a bounded interval,
+        after a grace that fast rounds never reach.  Both transports
+        surface stall diagnosis instead of hanging (reference:
         stall_inspector names missing ranks).
         """
         out: Dict[int, str] = {}
@@ -637,27 +732,87 @@ class Controller:
                                         pending_tokens)
             return out
         dirkey = self._key(gk, f"{seq}/{phase}/")
+        leftdir = f"{_KEY_PREFIX}/{self.namespace}/left/"
+        me = jax.process_index()
+        use_watch = (self._watch_enabled and self._watch_ok
+                     and hasattr(client, "key_value_dir_watch"))
+        # markers a SATISFIED earlier gather received but deliberately did
+        # not scan: if one names a member we are about to wait on, fail
+        # now — the cursor below would otherwise defer discovery to the
+        # first hold deadline.  Consumed here (not kept): every satisfied
+        # reply re-stashes the leftdir's full snapshot, so a still-live
+        # marker always reappears
+        if use_watch and self._watch_left:
+            stash, self._watch_left = self._watch_left, []
+            self._scan_left_entries(stash, seq, need)
+        watch_ver = self._watch_cursor
+        held = True
+        expected = len(need)   # total peer keys this phase dir will hold
         t0 = time.monotonic()
         warned = False
         delay = 0.001
         next_left_check = self._left_check_grace_s
         while True:
-            with self._lock:
-                self.kv_dir_gets += 1
-            if _metrics.ACTIVE:
-                _m_kv_ops.inc(op="dir_get")
-            stale = False
-            if _chaos.ACTIVE:
+            waited = time.monotonic() - t0
+            if use_watch:
+                # bound each hold so the warn/abort diagnosis below keeps
+                # its cadence even while the server parks the request
+                hold = self._watch_deadline_s
+                if not warned:
+                    hold = min(hold, max(
+                        0.05, self._peer_wait_warn_s - waited + 0.01))
+                if self._peer_wait_abort_s > 0:
+                    hold = min(hold, max(
+                        0.05, self._peer_wait_abort_s - waited + 0.01))
                 try:
-                    act = _chaos.fire("kv.dir_get", dir=dirkey, seq=seq)
-                except Exception:  # noqa: BLE001 - injected transient
-                    act, stale = None, True   # read failed: no data
-                stale = stale or (act is not None and act.kind == "stale")
-            try:
-                entries = ([] if stale
-                           else client.key_value_dir_get(dirkey))
-            except Exception:  # noqa: BLE001 - nothing published yet
-                entries = []
+                    # skip= our own publish under this directory (the
+                    # set that opened the round must not satisfy the
+                    # watch) and min_entries= every peer key the phase
+                    # will hold: the server wakes us ONCE, when the last
+                    # peer lands — one watch per steady-state gather
+                    entries, watch_ver, left_entries, held = (
+                        client.key_value_dir_watch(
+                            dirkey, watch_ver, hold, extra=leftdir,
+                            skip=f"{dirkey}{me}", min_entries=expected))
+                except Exception:  # noqa: BLE001 - transport lost the
+                    # verb (old server, exhausted retries): demote to
+                    # polling for the rest of the incarnation
+                    with self._lock:
+                        self._watch_ok = False
+                        self.watch_fallbacks += 1
+                    use_watch = False
+                    if _metrics.ACTIVE:
+                        _m_kv_ops.inc(op="watch_fallback")
+                    logger.warning(
+                        "key_value_dir_watch failed; negotiation falls "
+                        "back to polled dir-gets", exc_info=True)
+                    continue
+                with self._lock:
+                    self.kv_dir_watches += 1
+                    self._watch_used = True
+                if _metrics.ACTIVE:
+                    _m_kv_ops.inc(op="dir_watch")
+                self._watch_cursor = watch_ver
+            else:
+                left_entries = []
+                with self._lock:
+                    self.kv_dir_gets += 1
+                if _metrics.ACTIVE:
+                    _m_kv_ops.inc(op="dir_get")
+                stale = False
+                if _chaos.ACTIVE:
+                    try:
+                        act = _chaos.fire("kv.dir_get", dir=dirkey,
+                                          seq=seq)
+                    except Exception:  # noqa: BLE001 - injected transient
+                        act, stale = None, True   # read failed: no data
+                    stale = stale or (act is not None
+                                      and act.kind == "stale")
+                try:
+                    entries = ([] if stale
+                               else client.key_value_dir_get(dirkey))
+                except Exception:  # noqa: BLE001 - nothing published yet
+                    entries = []
             for k, v in entries:
                 try:
                     q = int(k.rsplit("/", 1)[1])
@@ -667,10 +822,24 @@ class Controller:
                     out[q] = v
                     need.discard(q)
             if not need:
+                # unscanned markers: hand them to the NEXT gather's
+                # pre-watch scan (the cursor has moved past them, so no
+                # future watch wakes on their account).  Unconditional —
+                # each reply carries the leftdir's whole snapshot, so an
+                # empty list means no live markers and must replace any
+                # stale stash
+                self._watch_left = left_entries
                 return out
+            # leave markers are consulted only while the gather is still
+            # unsatisfied — a peer that published its round key and THEN
+            # left (join → shutdown) must complete this round, exactly
+            # like the polled path, whose entry ingestion also precedes
+            # its marker check
+            if left_entries:
+                self._scan_left_entries(left_entries, seq, need)
             waited = time.monotonic() - t0
-            if waited >= next_left_check:
-                self._check_left(client, procs, seq, need)
+            if not use_watch and waited >= next_left_check:
+                self._check_left(client, seq, need)
                 next_left_check = waited + self._left_check_s
             if not warned and waited > self._peer_wait_warn_s:
                 warned = True
@@ -700,8 +869,13 @@ class Controller:
                     f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
                     f"{self._peer_wait_abort_s:.0f}); pending tensors "
                     f"here: {names}; aborting")
-            time.sleep(delay)
-            delay = min(delay * 2, self._poll_s)
+            if not use_watch:
+                time.sleep(delay)
+                delay = min(delay * 2, self._poll_s)
+            elif not held:
+                # watch slots exhausted server-side: the reply was an
+                # immediate snapshot, so pace the retry like a poll tick
+                time.sleep(0.05)
 
     def _peer_get(self, client, gk: str, seq: int, phase: str, q: int,
                   procs: Tuple[int, ...], pending_tokens: List[str]) -> str:

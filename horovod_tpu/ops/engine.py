@@ -185,6 +185,16 @@ class CollectiveEngine:
         self._bytes_reduced = 0
         self._cycle_active = False
         self._cycle_started: Optional[float] = None
+        # event-driven wake-ups (ISSUE 5): cycle completion and new
+        # submissions notify _cv, so join()'s drain and the
+        # nothing-common retry wait instead of busy-polling.  The
+        # bounded waits are safety nets; the counters/attrs are pinned
+        # by tests/test_engine_stress.py.
+        self._submit_gen = 0          # bumped per submit(), under _cv
+        self._drain_wait_s = 0.25     # join-drain safety re-check bound
+        self._drain_wait_iters = 0
+        self._pace_s = 0.02           # nothing-common retry pacing bound
+        self._pace_waits = 0
         # tuned (threshold, cycle) agreed through the controller's rounds
         # in multi-process jobs (rank-0 parameter sync)
         self._negotiated_params: Optional[dict] = None
@@ -279,6 +289,7 @@ class CollectiveEngine:
                     HorovodInternalError("engine is shut down"))
                 return entry.handle
             self._queue.append(entry)
+            self._submit_gen += 1
             self._cv.notify_all()
         return entry.handle
 
@@ -379,8 +390,10 @@ class CollectiveEngine:
             if _metrics.ACTIVE:
                 _m_cycles.inc()
                 _m_cycle_dur.observe(time.monotonic() - t_cycle)
-            with self._lock:
+            with self._cv:
+                # cycle completion wakes join()'s event-driven drain
                 self._cycle_active = False
+                self._cv.notify_all()
 
     # -- cross-process negotiation (reference: ComputeResponseList) ---------
     @staticmethod
@@ -552,12 +565,15 @@ class CollectiveEngine:
         """
         ctl = self._controller
         # drain our own pending collectives first: join is ordered after
-        # every prior submission on this process
-        while True:
-            with self._lock:
-                if not self._queue and not self._cycle_active:
-                    break
-            time.sleep(0.005)
+        # every prior submission on this process.  Event-driven: cycle
+        # completion notifies _cv, so the wait wakes when the queue can
+        # actually have emptied instead of polling every 5 ms (the
+        # bounded timeout is a missed-notify safety net only; the
+        # iteration counter is pinned by test_engine_stress.py).
+        with self._cv:
+            while self._queue or self._cycle_active:
+                self._drain_wait_iters += 1
+                self._cv.wait(timeout=self._drain_wait_s)
         ctl.set_joined(True)
         all_procs = tuple(range(jax.process_count()))
         try:
@@ -584,6 +600,8 @@ class CollectiveEngine:
         if self.timeline:
             self.timeline.cycle_mark(self._cycle_count)
         if self._controller is not None and self._controller.enabled:
+            with self._lock:
+                gen0 = self._submit_gen
             # framework span inside any active jax.profiler capture: the
             # whole cycle runs on the engine thread, so the negotiation
             # range interleaves with the XLA collective ops it gates in
@@ -596,8 +614,13 @@ class CollectiveEngine:
                 if self.stall:
                     self.stall.check()
                 # nothing common this round: pace the retry so mismatched
-                # leftovers don't spin the control plane
-                time.sleep(0.02)
+                # leftovers don't spin the control plane, but wake at once
+                # on a NEW submission — it may be exactly the tensor the
+                # peers are waiting on (event-driven, ISSUE 5)
+                with self._cv:
+                    self._pace_waits += 1
+                    if self._submit_gen == gen0 and not self._stop:
+                        self._cv.wait(timeout=self._pace_s)
                 return
         self._execute(entries)
 

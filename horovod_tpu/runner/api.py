@@ -47,10 +47,15 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         base_env = dict(os.environ)
         if env:
             base_env.update(env)
-        procs = spawn.spawn_workers(
-            slots, command, addr, port, prefix_output=prefix_output,
-            base_env=base_env)
-        rc = spawn.wait_workers(procs)
+        # event-driven negotiation KV, hosted here for the job's lifetime
+        # (workers find it via HOROVOD_KV_ADDR; docs/controller.md
+        # "Negotiation transport")
+        from . import kv as _kv
+        with _kv.hosted_kv(base_env, expected_procs=np) as kv_server:
+            procs = spawn.spawn_workers(
+                slots, command, addr, port, prefix_output=prefix_output,
+                base_env=base_env, kv_server=kv_server)
+            rc = spawn.wait_workers(procs)
         if rc != 0:
             raise RuntimeError(f"horovod_tpu.runner.run failed with exit "
                                f"code {rc}")

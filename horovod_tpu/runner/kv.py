@@ -1,0 +1,498 @@
+"""RPC-hosted control-plane key-value store with long-poll watch.
+
+Reference parity: ``horovod/runner/http/http_server.py`` — the
+launcher's HTTP KV rendezvous store — upgraded from polled GETs to an
+event-driven transport.  The launcher (``hvdrun`` / ``runner.run`` /
+the elastic driver) hosts one :class:`KvServer`; workers reach it
+through :class:`RpcKvClient`, whose surface is a drop-in superset of
+the JAX coordination-service client the negotiation controller was
+built on (``key_value_set`` / ``key_value_dir_get`` /
+``blocking_key_value_get`` / ``key_value_delete``), plus the one verb
+the coordination service lacks: **``key_value_dir_watch``**, a long
+poll that the server holds on a :class:`threading.Condition` until the
+watched directory's version advances past the caller's known version
+(every ``key_value_set`` bumps the version and notifies) or a bounded
+deadline expires.  Steady-state negotiation latency then tracks the
+network RTT instead of a poll tick (ISSUE 5; the coordination tail of
+arXiv:2310.06993).
+
+Wire format: every value is a string (the controller JSON-encodes its
+round payloads already); directory listings are ``[key, value]`` pairs
+carrying full key paths, matching ``key_value_dir_get`` on the JAX
+client.  Watch replies carry a server version cursor the caller passes
+back, so a set landing between two watch calls can never be missed.
+
+Held watches are bounded (``HOROVOD_KV_WATCH_SLOTS``): past the limit a
+watch degrades to an immediate snapshot (a poll) instead of parking one
+more server thread, so watchers cannot starve the RPC thread pool.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from . import rpc as _rpc
+
+logger = logging.getLogger("horovod_tpu")
+
+#: Launch-contract env: ``host:port`` of the job's KV server.  Presence
+#: routes the controller's negotiation transport here (docs/env.md).
+KV_ADDR_ENV = "HOROVOD_KV_ADDR"
+#: ``0`` disables the long-poll watch verb (client falls back to polled
+#: dir-gets — the pre-event-driven transport, kept for A/B benching).
+KV_WATCH_ENV = "HOROVOD_KV_WATCH"
+#: Server-side bound on one held watch, seconds.
+KV_WATCH_DEADLINE_ENV = "HOROVOD_KV_WATCH_DEADLINE_S"
+#: Max concurrently HELD watches before degrading to snapshots.
+KV_WATCH_SLOTS_ENV = "HOROVOD_KV_WATCH_SLOTS"
+#: Root of the controller's negotiation keyspace (ops/controller.py pins
+#: the same literal as ``_KEY_PREFIX``; layering keeps the controller
+#: from importing the runner at module scope).  The elastic driver
+#: subtree-deletes ``{CTL_KEY_PREFIX}/e{N}/`` for epochs whose workers
+#: crashed without running ``cleanup_keys()``.
+CTL_KEY_PREFIX = "hvdctl"
+
+_DEFAULT_DEADLINE_S = 10.0
+# floor for the configured hold: a zero/negative deadline would make
+# every unsatisfied watch return an immediate snapshot with held=True —
+# the caller's degraded-reply pacing never fires and each waiting gather
+# becomes an unpaced tight RPC loop (use HOROVOD_KV_WATCH=0 to disable
+# the watch transport; the deadline knob only bounds one hold)
+_MIN_DEADLINE_S = 0.05
+_DEFAULT_SLOTS = 64
+
+
+def watch_enabled() -> bool:
+    return os.environ.get(KV_WATCH_ENV, "1") != "0"
+
+
+def watch_deadline_s() -> float:
+    try:
+        configured = float(os.environ.get(KV_WATCH_DEADLINE_ENV,
+                                          str(_DEFAULT_DEADLINE_S)))
+    except ValueError:
+        return _DEFAULT_DEADLINE_S
+    return max(_MIN_DEADLINE_S, configured)
+
+
+def _watch_slots(default: Optional[int] = None) -> int:
+    """The held-watch bound: explicit env wins, then the launcher's
+    job-size-derived ``default``, then the module floor."""
+    fallback = _DEFAULT_SLOTS if default is None else default
+    raw = os.environ.get(KV_WATCH_SLOTS_ENV)
+    if raw is None:
+        return fallback
+    try:
+        return int(raw)
+    except ValueError:
+        return fallback
+
+
+class KvStore:
+    """In-memory versioned KV store with per-directory change signals.
+
+    One global monotonic version stamps every mutation; each directory
+    prefix of the mutated key records the stamp (key ``a/b/c`` bumps
+    ``a/``, ``a/b/``).  A watch on prefix ``d`` parks on the store's
+    Condition until ``dir_version(d)`` exceeds the caller's cursor, so
+    wake-ups are edge-triggered per directory and a watcher re-arming
+    with the cursor from its last reply can never miss an update.
+    """
+
+    def __init__(self, slots: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._data: Dict[str, str] = {}
+        self._ver = 0
+        self._dir_ver: Dict[str, int] = {}
+        # per-key stamps + a per-directory deletion stamp, so a watch may
+        # EXCLUDE the caller's own key from its wake predicate (``skip``):
+        # publish-then-watch is the controller's round shape, and without
+        # the exclusion every first watch would wake on the caller's own
+        # publish — one wasted RPC per negotiation round
+        self._key_ver: Dict[str, int] = {}
+        self._tomb_ver: Dict[str, int] = {}
+        # live-key count per directory prefix: O(1) min_entries wake
+        # predicate (the controller's steady-state gather re-evaluates
+        # it on every store mutation while parked)
+        self._dir_count: Dict[str, int] = {}
+        self._held = 0
+        self._max_held = _watch_slots(slots)
+        self._degrade_warned = False
+
+    @staticmethod
+    def _dirs_of(key: str) -> List[str]:
+        parts = key.split("/")[:-1]
+        return ["/".join(parts[:i + 1]) + "/" for i in range(len(parts))]
+
+    def _bump(self, key: str, tomb: bool = False,
+              fresh: bool = False) -> None:
+        # caller holds self._lock
+        self._ver += 1
+        for d in self._dirs_of(key):
+            self._dir_ver[d] = self._ver
+            if tomb:
+                self._tomb_ver[d] = self._ver
+                self._dir_count[d] -= 1
+                if self._dir_count[d] <= 0:
+                    del self._dir_count[d]
+            elif fresh:
+                self._dir_count[d] = self._dir_count.get(d, 0) + 1
+        if tomb:
+            self._key_ver.pop(key, None)
+        else:
+            self._key_ver[key] = self._ver
+        self._cond.notify_all()
+        if len(self._dir_ver) > self._PRUNE_AT:
+            self._prune()
+
+    #: Version-map compaction threshold: negotiation rounds mint new
+    #: per-seq directory names forever, so the stamp dicts (NOT the key
+    #: data — that is cleaned per round) would grow without bound on the
+    #: elastic driver's job-lifetime server.
+    _PRUNE_AT = 4096
+
+    def _prune(self) -> None:
+        # caller holds self._lock.  Drop stamps for directories with no
+        # live keys whose last activity is at least a full threshold of
+        # versions old.  Safe: parked watchers were notified AT the
+        # original mutation; a watcher arriving later with a pre-prune
+        # cursor merely waits out its bounded deadline and re-arms with
+        # the fresh cursor its (correct, live) snapshot reply carries —
+        # no update can be observed wrongly, and a NEW write under a
+        # pruned directory recreates its stamp at a higher version than
+        # any outstanding cursor, so it wakes watchers as usual.
+        floor = self._ver - self._PRUNE_AT
+        dead = [d for d, v in self._dir_ver.items()
+                if v <= floor and d not in self._dir_count]
+        for d in dead:
+            del self._dir_ver[d]
+            self._tomb_ver.pop(d, None)
+
+    def _dir_changed(self, prefix: str, since: int,
+                     skip: Optional[str]) -> bool:
+        # caller holds self._lock (dir_watch's Condition wraps it; one
+        # interprocedural level past the guarded-by detector's horizon)
+        if skip is None:
+            return self._dir_ver.get(prefix, 0) > since  # hvdlint: disable=HVD113
+        if self._tomb_ver.get(prefix, 0) > since:  # hvdlint: disable=HVD113
+            return True
+        return any(v > since for k, v in self._key_ver.items()  # hvdlint: disable=HVD113
+                   if k.startswith(prefix) and k != skip)
+
+    # -- mutation ------------------------------------------------------------
+    def set(self, key: str, value: str) -> None:
+        with self._cond:
+            fresh = key not in self._data
+            self._data[key] = str(value)
+            self._bump(key, fresh=fresh)
+
+    def delete(self, key: str) -> None:
+        """Delete ``key``; a trailing ``/`` deletes the whole subtree
+        (the JAX client's directory-delete convention the controller's
+        namespace cleanup relies on)."""
+        with self._cond:
+            if key.endswith("/"):
+                doomed = [k for k in self._data if k.startswith(key)]
+                for k in doomed:
+                    del self._data[k]
+                for k in doomed:
+                    self._bump(k, tomb=True)
+            elif key in self._data:
+                del self._data[key]
+                self._bump(key, tomb=True)
+
+    # -- reads ---------------------------------------------------------------
+    def get(self, key: str) -> Optional[str]:
+        with self._lock:
+            return self._data.get(key)
+
+    def _snapshot(self, prefix: str) -> List[Tuple[str, str]]:
+        # caller holds self._lock
+        return sorted((k, v) for k, v in self._data.items()
+                      if k.startswith(prefix))
+
+    def dir_get(self, prefix: str) -> Tuple[List[Tuple[str, str]], int]:
+        with self._lock:
+            return self._snapshot(prefix), self._ver
+
+    def dir_watch(self, prefix: str, since: int, deadline_s: float,
+                  extra: Optional[str] = None, skip: Optional[str] = None,
+                  min_entries: Optional[int] = None
+                  ) -> Tuple[List[Tuple[str, str]], int,
+                             List[Tuple[str, str]], bool]:
+        """Hold until ``prefix`` (or ``extra``, when given) changes past
+        version ``since``, or ``deadline_s`` elapses.
+
+        Returns ``(entries, version_cursor, extra_entries, ok)``.
+        ``extra`` is a second directory folded into the same wake
+        condition and reply — the controller rides its leave-marker
+        directory here, so a departing peer wakes waiting rounds
+        immediately instead of at the next bounded marker check.
+        ``skip`` names ONE key (the caller's own publish) whose writes
+        do not satisfy the wake predicate, so publish-then-watch costs a
+        single watch.  ``min_entries`` switches the primary predicate
+        from "any change past ``since``" to "at least this many non-skip
+        keys under ``prefix``" — a gather that needs all N-1 peers then
+        wakes ONCE, at the last arrival, instead of once per peer
+        (``extra`` changes still wake it either way).  ``ok=False``
+        flags a slot-exhausted degrade to an immediate snapshot, telling
+        the caller to pace its retry instead of spinning.
+        """
+        deadline_s = max(0.0, min(float(deadline_s), 3600.0))
+        deadline = time.monotonic() + deadline_s
+        with self._cond:
+            def changed() -> bool:
+                # runs under self._cond == self._lock (wait predicate;
+                # re-evaluated by every parked watcher on every store
+                # mutation, so it must be O(1): live-key counts come
+                # from _dir_count, not a store scan)
+                if min_entries is not None:
+                    n = self._dir_count.get(prefix, 0)  # hvdlint: disable=HVD113
+                    if (skip is not None and skip.startswith(prefix)
+                            and skip in self._data):  # hvdlint: disable=HVD113
+                        n -= 1
+                    if n >= min_entries:
+                        return True
+                elif self._dir_changed(prefix, since, skip):
+                    return True
+                return (extra is not None
+                        and self._dir_changed(extra, since, None))
+
+            degraded = False
+            if not changed():
+                if self._held >= self._max_held and not self._degrade_warned:
+                    # a silent degrade would quietly cost more than the
+                    # polling this transport replaced (the caller paces
+                    # snapshot retries at 20 Hz); say so ONCE
+                    self._degrade_warned = True
+                    logger.warning(
+                        "KV watch slots exhausted (%d held); further "
+                        "watches degrade to snapshot polling — raise %s "
+                        "(launchers default it to 4x the process count)",
+                        self._held, KV_WATCH_SLOTS_ENV)
+                if self._held < self._max_held:
+                    self._held += 1
+                    try:
+                        while not changed():
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                break
+                            self._cond.wait(remaining)
+                    finally:
+                        self._held -= 1
+                else:
+                    degraded = True
+            entries = self._snapshot(prefix)
+            extra_entries = ([] if extra is None
+                             else self._snapshot(extra))
+            return entries, self._ver, extra_entries, not degraded
+
+
+def kv_handlers(store: KvStore) -> Dict[str, callable]:
+    """``JsonRpcServer`` handler table exposing ``store`` (wire format in
+    the module docstring).  A missing ``key_value_get`` key answers
+    ``{"ok": false}`` — never an error status, so a poll loop's misses
+    don't trip the client's retry machinery."""
+    def _set(p):
+        store.set(p["k"], p["v"])
+        return {}
+
+    def _get(p):
+        v = store.get(p["k"])
+        return {"ok": v is not None, "v": v}
+
+    def _dir_get(p):
+        entries, ver = store.dir_get(p["d"])
+        return {"e": [[k, v] for k, v in entries], "ver": ver}
+
+    def _delete(p):
+        store.delete(p["k"])
+        return {}
+
+    def _watch(p):
+        min_entries = p.get("min")
+        entries, ver, extra, ok = store.dir_watch(
+            p["d"], int(p.get("ver", 0)),
+            float(p.get("deadline_s", _DEFAULT_DEADLINE_S)),
+            extra=p.get("x"), skip=p.get("skip"),
+            min_entries=(None if min_entries is None
+                         else int(min_entries)))
+        return {"e": [[k, v] for k, v in entries], "ver": ver,
+                "xe": [[k, v] for k, v in extra], "held": ok}
+
+    return {
+        "key_value_set": _set,
+        "key_value_get": _get,
+        "key_value_dir_get": _dir_get,
+        "key_value_delete": _delete,
+        "key_value_dir_watch": _watch,
+    }
+
+
+class KvServer:
+    """A :class:`KvStore` served over :class:`~.rpc.JsonRpcServer`
+    (HMAC-signed POSTs like every other control-plane endpoint)."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0",
+                 secret=_rpc._ENV, slots: Optional[int] = None):
+        self.store = KvStore(slots=slots)
+        self._server = _rpc.JsonRpcServer(
+            kv_handlers(self.store), port=port, host=host, secret=secret)
+        self.port = self._server.port
+
+    def close(self):
+        self._server.close()
+
+
+class RpcKvClient:
+    """Client for :class:`KvServer` with the JAX coordination-service
+    client's KV surface, plus ``key_value_dir_watch``.
+
+    Every call rides :func:`~.rpc.json_request` — keep-alive pooled
+    connections, retry/backoff, HMAC signing, and the ``rpc.request``
+    chaos injection site (so fault schedules can drop/delay any verb,
+    ``key_value_dir_watch`` included) all compose for free.
+    """
+
+    def __init__(self, addr: str, port: int, secret=_rpc._ENV,
+                 timeout: float = 30.0):
+        self._addr = addr
+        self._port = int(port)
+        self._secret = secret
+        self._timeout = timeout
+
+    def _call(self, name: str, payload: dict, timeout=None, **kw) -> dict:
+        return _rpc.json_request(
+            self._addr, self._port, name, payload,
+            timeout=timeout or self._timeout, secret=self._secret, **kw)
+
+    # -- JAX-client-compatible surface ---------------------------------------
+    def key_value_set(self, key: str, value: str,
+                      allow_overwrite: bool = True) -> None:
+        # allow_overwrite accepted for signature parity; the store always
+        # overwrites, which is the controller's contract (_kv_set)
+        self._call("key_value_set", {"k": key, "v": value})
+
+    def key_value_dir_get(self, prefix: str) -> List[Tuple[str, str]]:
+        reply = self._call("key_value_dir_get", {"d": prefix})
+        return [(k, v) for k, v in reply["e"]]
+
+    def key_value_delete(self, key: str) -> None:
+        self._call("key_value_delete", {"k": key})
+
+    def blocking_key_value_get(self, key: str, timeout_ms: int) -> str:
+        """Block until ``key`` exists (watch-driven when enabled, else a
+        bounded poll); raises ``TimeoutError`` at the deadline like the
+        coordination client's DEADLINE_EXCEEDED."""
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        parent = key.rsplit("/", 1)[0] + "/" if "/" in key else ""
+        ver = 0
+        use_watch = watch_enabled() and bool(parent)
+        while True:
+            got = self._call("key_value_get", {"k": key})
+            if got.get("ok"):
+                return got["v"]
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"key {key!r} not set within {timeout_ms} ms")
+            if use_watch:
+                try:
+                    _e, ver, _x, held = self.key_value_dir_watch(
+                        parent, ver, min(remaining, watch_deadline_s()))
+                    if not held:
+                        time.sleep(min(0.05, max(0.0, remaining)))
+                except Exception:  # noqa: BLE001 - server lacks watch
+                    use_watch = False
+            else:
+                time.sleep(min(0.05, remaining))
+
+    # -- the event-driven verb -----------------------------------------------
+    def key_value_dir_watch(self, prefix: str, since: int,
+                            deadline_s: float, extra: Optional[str] = None,
+                            skip: Optional[str] = None,
+                            min_entries: Optional[int] = None
+                            ) -> Tuple[List[Tuple[str, str]], int,
+                                       List[Tuple[str, str]], bool]:
+        payload = {"d": prefix, "ver": int(since),
+                   "deadline_s": float(deadline_s)}
+        if extra is not None:
+            payload["x"] = extra
+        if skip is not None:
+            payload["skip"] = skip
+        if min_entries is not None:
+            payload["min"] = int(min_entries)
+        # the RPC timeout must outlive a full server-side hold, or every
+        # quiet watch would be misread as a transport failure and retried
+        reply = self._call("key_value_dir_watch", payload,
+                           timeout=deadline_s + self._timeout)
+        return ([(k, v) for k, v in reply["e"]], int(reply["ver"]),
+                [(k, v) for k, v in reply.get("xe", [])],
+                bool(reply.get("held", True)))
+
+
+# -- launcher wiring ----------------------------------------------------------
+
+def start_kv_server(base_env: Optional[dict] = None,
+                    expected_procs: Optional[int] = None
+                    ) -> Optional[KvServer]:
+    """Start the job's KV server in the launcher process, unless an outer
+    launcher already exported one (``HOROVOD_KV_ADDR`` present in the
+    spawn env) — elastic epochs share the driver's single store, and the
+    controller's per-incarnation namespaces keep them isolated.
+
+    ``expected_procs`` sizes the held-watch bound (4x the process count,
+    floored at the module default): steady state parks ONE watch per
+    worker, so the default cap must scale with the job or large jobs
+    would silently degrade to snapshot polling.
+    """
+    env = base_env if base_env is not None else os.environ
+    if env.get(KV_ADDR_ENV) or os.environ.get(KV_ADDR_ENV):
+        return None
+    slots = (None if expected_procs is None
+             else max(_DEFAULT_SLOTS, 4 * int(expected_procs)))
+    try:
+        srv = KvServer(slots=slots)
+    except Exception:  # noqa: BLE001 - port exhaustion etc.: workers fall
+        # back to the coordination-service transport, nothing breaks
+        logger.warning("control-plane KV server failed to start; workers "
+                       "will use the coordination-service KV",
+                       exc_info=True)
+        return None
+    logger.debug("control-plane KV server on port %d", srv.port)
+    return srv
+
+
+@contextlib.contextmanager
+def hosted_kv(base_env: Optional[dict] = None,
+              expected_procs: Optional[int] = None):
+    """One launcher-side KV hosting block, shared by every launcher
+    (`runner.run`, ``hvdrun``): mint the job secret BEFORE the server
+    binds (it resolves its HMAC key at construction), start the server,
+    close it when the job ends."""
+    from .spawn import ensure_job_secret
+    ensure_job_secret(base_env)
+    srv = start_kv_server(base_env, expected_procs=expected_procs)
+    try:
+        yield srv
+    finally:
+        if srv is not None:
+            srv.close()
+
+
+def kv_env_for(worker_host: str, is_local, kv_server: Optional[KvServer],
+               interface: Optional[str] = None) -> Dict[str, str]:
+    """The spawn-env entries advertising ``kv_server`` to a worker on
+    ``worker_host`` (same reachable-address selection as the elastic
+    driver's RPC endpoint)."""
+    if kv_server is None:
+        return {}
+    from .network import local_service_addr
+    addr = local_service_addr(worker_host, is_local, interface=interface)
+    return {KV_ADDR_ENV: f"{addr}:{kv_server.port}"}

@@ -143,12 +143,17 @@ def run_launcher(args: argparse.Namespace) -> int:
             print(f"hvdrun: rank {s.rank} -> {s.hostname} "
                   f"(local {s.local_rank}/{s.local_size})", file=sys.stderr)
         print(f"hvdrun: coordinator {addr}:{args.port}", file=sys.stderr)
-    procs = spawn.spawn_workers(
-        slots, args.command, addr, args.port,
-        prefix_output=not args.no_prefix_output,
-        output_filename=args.output_filename,
-        base_env=dict(os.environ))
-    return spawn.wait_workers(procs, timeout=args.start_timeout)
+    # interface-aware KV advertisement matches the coordinator address
+    # above; hosted_kv mints the job secret before the server binds
+    from . import kv as _kv
+    with _kv.hosted_kv(expected_procs=len(slots)) as kv_server:
+        procs = spawn.spawn_workers(
+            slots, args.command, addr, args.port,
+            prefix_output=not args.no_prefix_output,
+            output_filename=args.output_filename,
+            base_env=dict(os.environ), kv_server=kv_server,
+            network_interface=args.network_interface)
+        return spawn.wait_workers(procs, timeout=args.start_timeout)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -25,6 +25,7 @@ deterministically (docs/env.md "Chaos engineering").
 from __future__ import annotations
 
 import http.client
+import io
 import json
 import logging
 import os
@@ -67,6 +68,11 @@ _m_server_reqs = _metrics.counter(
 _m_server_replays = _metrics.counter(
     "hvd_rpc_server_idem_replays_total",
     "Duplicate deliveries answered from the idempotency-token cache")
+_m_conn_reuse = _metrics.counter(
+    "hvd_rpc_conn_reuse_total",
+    "Keep-alive connection pool outcomes per request: hit = reused an "
+    "idle socket, miss = dialed fresh, stale = a reused socket had died "
+    "and was redialed", labels=("result",))
 
 _ENV = object()  # sentinel: resolve the secret from the environment
 
@@ -75,6 +81,7 @@ _ENV = object()  # sentinel: resolve the secret from the environment
 RETRIES_ENV = "HOROVOD_RPC_RETRIES"
 BACKOFF_ENV = "HOROVOD_RPC_BACKOFF_S"
 MAX_BACKOFF_ENV = "HOROVOD_RPC_MAX_BACKOFF_S"
+KEEPALIVE_ENV = "HOROVOD_RPC_KEEPALIVE"
 
 #: Idempotency-token replies remembered per server (LRU).
 _IDEM_CACHE_SIZE = 4096
@@ -112,6 +119,106 @@ def jittered_backoff_s(attempt: int, base: float, cap: float,
     return min(cap, base * (2 ** attempt)) * (0.5 + rng.random())
 
 
+def keepalive_enabled() -> bool:
+    """``HOROVOD_RPC_KEEPALIVE`` (default on).  ``0`` restores the
+    one-connection-per-request ``urlopen`` transport."""
+    return os.environ.get(KEEPALIVE_ENV, "1") != "0"
+
+
+class ConnectionPool:
+    """Thread-safe idle-connection stacks keyed by ``(host, port)``.
+
+    A connection is checked out by exactly one thread at a time (it is
+    popped under the lock and only returned after the response body has
+    been fully read), so no HTTP pipelining or socket sharing ever
+    happens.  Bounded per endpoint: surplus connections returned to a
+    full stack are closed instead of pooled, so a burst of concurrent
+    callers cannot grow the pool without bound.
+    """
+
+    def __init__(self, max_idle_per_host: int = 4):
+        self._lock = threading.Lock()
+        self._idle: Dict[tuple, list] = {}
+        self._max_idle = max_idle_per_host
+
+    def get(self, host: str, port: int):
+        """An idle connection for the endpoint, or None (dial fresh)."""
+        with self._lock:
+            stack = self._idle.get((host, port))
+            return stack.pop() if stack else None
+
+    def put(self, host: str, port: int, conn) -> None:
+        with self._lock:
+            stack = self._idle.setdefault((host, port), [])
+            if len(stack) < self._max_idle:
+                stack.append(conn)
+                return
+        conn.close()  # pool full: close outside the lock
+
+    def clear(self) -> None:
+        """Close every idle connection (tests / interpreter teardown)."""
+        with self._lock:
+            conns = [c for stack in self._idle.values() for c in stack]
+            self._idle.clear()
+        for c in conns:
+            c.close()
+
+
+_POOL = ConnectionPool()
+
+
+def _post_pooled(addr: str, port: int, name: str, body: bytes,
+                 headers: dict, timeout: float) -> dict:
+    """One POST over a pooled keep-alive connection.
+
+    Stale-socket detection: a server restart or idle-timeout close only
+    surfaces when the next request hits the dead socket, so a
+    CONNECTION-level failure on a REUSED connection is retried once on a
+    freshly dialed one (counted ``stale``).  A TIMEOUT is not staleness —
+    the server is slow, not gone, and the request may still be executing
+    (a parked ``key_value_dir_watch`` in particular), so an eager resend
+    would double the caller's wait and burn a second held-watch slot; it
+    propagates to ``json_request``'s retry/backoff machinery instead,
+    like any failure on a freshly dialed connection.
+    """
+    went_stale = False
+    for reused in (True, False):
+        conn = _POOL.get(addr, port) if reused else None
+        if reused and conn is None:
+            continue  # nothing idle: fall through to the fresh dial
+        if conn is None:
+            conn = http.client.HTTPConnection(addr, port, timeout=timeout)
+        try:
+            if conn.sock is not None:  # pooled: refresh the deadline
+                conn.sock.settimeout(timeout)
+            conn.request("POST", f"/{name}", body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+        except Exception as e:
+            conn.close()
+            if reused and not isinstance(e, TimeoutError):
+                went_stale = True
+                if _metrics.ACTIVE:
+                    _m_conn_reuse.inc(result="stale")
+                continue  # the socket had died under us: redial once
+            raise
+        if _metrics.ACTIVE and not went_stale:
+            # exactly ONE outcome per request: a stale-then-redialed
+            # request already counted as "stale"
+            _m_conn_reuse.inc(result="hit" if reused else "miss")
+        if resp.will_close:
+            conn.close()
+        else:
+            _POOL.put(addr, port, conn)
+        if resp.status >= 400:
+            raise urllib.error.HTTPError(
+                f"http://{addr}:{port}/{name}", resp.status, resp.reason,
+                resp.headers, io.BytesIO(data))
+        return json.loads(data or b"{}")
+    raise http.client.HTTPException(
+        "keep-alive pool exhausted")  # pragma: no cover - loop covers both
+
+
 class JsonRpcServer:
     """HTTP server mapping POST /<name> with a JSON body to
     ``handlers[name](payload) -> response dict``.
@@ -147,6 +254,16 @@ class JsonRpcServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # keep-alive: HTTP/1.1 persistent connections, so the client
+            # pool can reuse one socket across control-plane calls.
+            # Every reply path sends Content-Length (send_error included),
+            # which 1.1 requires for the connection to stay open.
+            protocol_version = "HTTP/1.1"
+            # a reply is two small writes (header flush + body); Nagle
+            # would hold the second behind the first's ACK, putting a
+            # delayed-ack stall on the control plane's wake path
+            disable_nagle_algorithm = True
+
             def _reply(self, body: bytes):
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -305,6 +422,8 @@ def _post_once(addr: str, port: int, name: str, body: bytes,
         # re-signed per attempt: retries get a fresh timestamp, so a
         # long backoff chain cannot drift past the freshness window
         headers.update(_secret.sign_headers(secret, name, body))
+    if keepalive_enabled():
+        return _post_pooled(addr, port, name, body, headers, timeout)
     req = urllib.request.Request(
         f"http://{addr}:{port}/{name}", data=body, headers=headers)
     with urllib.request.urlopen(req, timeout=timeout) as resp:

@@ -113,7 +113,9 @@ def spawn_workers(slots: List[SlotAssignment], command: Sequence[str],
                   coordinator_addr: str, coordinator_port: int,
                   prefix_output: bool = True,
                   output_filename: Optional[str] = None,
-                  base_env: Optional[Dict[str, str]] = None
+                  base_env: Optional[Dict[str, str]] = None,
+                  kv_server=None,
+                  network_interface: Optional[str] = None
                   ) -> List[WorkerProcess]:
     procs: List[WorkerProcess] = []
     cwd = os.getcwd()
@@ -121,9 +123,19 @@ def spawn_workers(slots: List[SlotAssignment], command: Sequence[str],
     # distributes via the env): published launcher-side too so this
     # process's RPC signs with the same key the workers verify against
     secret_key = ensure_job_secret(base_env)
+    kv_envs: Dict[str, Dict[str, str]] = {}
+    if kv_server is not None:
+        # advertise the launcher-hosted KV server (runner/kv.py) with the
+        # same NIC-aware address selection as the other local services;
+        # one lookup per distinct hostname
+        from .kv import kv_env_for
+        kv_envs = {h: kv_env_for(h, is_local, kv_server,
+                                 interface=network_interface)
+                   for h in {s.hostname for s in slots}}
     for slot in slots:
         env = worker_env(slot, coordinator_addr, coordinator_port, base_env)
         env.setdefault(_secret.SECRET_ENV, secret_key)
+        env.update(kv_envs.get(slot.hostname, {}))
         if is_local(slot.hostname):
             cmd, popen_env, stdin_data = list(command), env, None
         else:

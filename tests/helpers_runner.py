@@ -475,9 +475,11 @@ def autotune_leader_join_fn():
 
 
 def kv_ops_per_round_fn():
-    """VERDICT r4 #3: negotiation transport cost.  After warmup, each
-    steady-state round must cost ONE key_value_set plus dir-get polls —
-    never a per-peer blocking get (the O(N^2) pattern this replaces)."""
+    """VERDICT r4 #3 + ISSUE 5: negotiation transport cost.  After
+    warmup, each steady-state round must cost ONE key_value_set plus ONE
+    long-poll dir-watch (when the launcher's RPC KV is live) — never a
+    per-peer blocking get (the O(N^2) pattern the dir ops replaced), and
+    zero POLLED dir-gets on the watch transport."""
     import numpy as np
     import horovod_tpu as hvd
 
@@ -491,8 +493,9 @@ def kv_ops_per_round_fn():
         assert np.allclose(np.asarray(out), 10.0), out  # 1+2+3+4
     after = hvd.runtime._state().engine.stats()["negotiation"]
     diff = {k: after[k] - before[k]
-            for k in ("rounds", "kv_sets", "kv_dir_gets", "kv_left_gets",
-                      "kv_blocking_gets")}
+            for k in ("rounds", "kv_sets", "kv_dir_gets",
+                      "kv_dir_watches", "kv_left_gets",
+                      "kv_blocking_gets", "watch_fallbacks")}
     return {"rank": r, **diff}
 
 
@@ -533,11 +536,16 @@ def controller_shutdown_clean_fn():
     import json
 
     import horovod_tpu as hvd
+    from horovod_tpu.ops import controller as ctl_mod
     from horovod_tpu.ops.controller import Controller
     from jax._src import distributed
 
     r = hvd.cross_rank()
+    # barriers ride the coordination service; the KEY checks must look at
+    # whichever transport negotiation actually used (the launcher-hosted
+    # RPC KV when HOROVOD_KV_ADDR is set — ISSUE 5)
     client = distributed.global_state.client
+    kv = ctl_mod._client()
     ctl = Controller(namespace="cleantest")
     tok = json.dumps(
         {"s": [["t", "allreduce", "sum", "float32", [2], 0, False, -1,
@@ -547,12 +555,12 @@ def controller_shutdown_clean_fn():
         res = ctl.negotiate([tok], (0, 1))
         assert res.counts[tok] == 1
     # keys from recent rounds ARE still present before cleanup
-    pre = client.key_value_dir_get("hvdctl/cleantest/")
+    pre = kv.key_value_dir_get("hvdctl/cleantest/")
     ctl.leave()
     client.wait_at_barrier("cleantest_left", 20000)
     ctl.cleanup_keys()
     client.wait_at_barrier("cleantest_clean", 20000)
-    leftover = client.key_value_dir_get("hvdctl/cleantest/")
+    leftover = kv.key_value_dir_get("hvdctl/cleantest/")
     return {"rank": r, "pre": len(pre),
             "leftover": [k for k, _ in leftover]}
 

@@ -690,3 +690,60 @@ def test_engine_cycle_injection(hvd):
                       name="chaos.cycle.probe")
     np.testing.assert_allclose(np.asarray(x), np.full((4,), 8.0))
     assert sched.fired_at("engine.cycle")
+
+
+# --- event-driven KV watch: drop → polled fallback (ISSUE 5) -----------------
+
+def test_watch_drop_falls_back_to_poll_and_converges(monkeypatch):
+    """Fixed-seed regression: a schedule dropping every
+    ``rpc.request:key_value_dir_watch`` forces the controller off the
+    long-poll transport; the round must DEMOTE to polled dir-gets (one
+    fallback, sticky for the incarnation) and still converge on the
+    same dispatch decision, with the schedule proven non-inert."""
+    import hashlib
+    import json
+
+    from horovod_tpu.ops import controller as ctl_mod
+    from horovod_tpu.runner.kv import KvServer, RpcKvClient
+
+    monkeypatch.setenv("HOROVOD_RPC_RETRIES", "1")
+    monkeypatch.setenv("HOROVOD_RPC_BACKOFF_S", "0.01")
+    srv = KvServer(secret=None)
+    cli = RpcKvClient("127.0.0.1", srv.port, secret=None)
+    orig_client, orig_pi = ctl_mod._client, ctl_mod.jax.process_index
+    ctl_mod._client = lambda: cli
+    ctl_mod.jax.process_index = lambda: 0
+    sched = FaultSchedule.parse(
+        "rpc.request:key_value_dir_watch action=drop", seed=11)
+    chaos.install(sched)
+    try:
+        ctl = ctl_mod.Controller()
+        tok = json.dumps(
+            {"s": [["t", "allreduce", "sum", "float32", [2], 0, False,
+                    -1, 1.0, 1.0]], "r": -1, "sp": None},
+            separators=(",", ":"), sort_keys=True)
+        gk = "g" + hashlib.sha1(b"0,1").hexdigest()[:12]
+        h = hashlib.sha1(tok.encode()).hexdigest()
+
+        def peer(seq):
+            time.sleep(0.03)
+            srv.store.set(
+                f"hvdctl/0/{gk}/{seq}/a/1",
+                json.dumps({"h": h, "e": [tok]},
+                           separators=(",", ":")))
+
+        for seq in range(3):
+            threading.Thread(target=peer, args=(seq,),
+                             daemon=True).start()
+            res = ctl.negotiate([tok], (0, 1))
+            assert res.counts[tok] == 1        # converged every round
+        st = ctl.stats()
+        assert st["watch_fallbacks"] == 1, st  # demoted exactly once
+        assert st["kv_dir_watches"] == 0, st   # no watch ever landed
+        assert st["kv_dir_gets"] >= 3, st      # polling carried the job
+        assert sched.fired_at("rpc.request"), sched.stats()
+    finally:
+        chaos.uninstall()
+        ctl_mod._client = orig_client
+        ctl_mod.jax.process_index = orig_pi
+        srv.close()

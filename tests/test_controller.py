@@ -353,11 +353,14 @@ def test_mixed_op_storm_cross_process():
 
 
 def test_negotiation_kv_ops_per_round_bounded():
-    """VERDICT r4 #3: rounds are O(N) per process — in a 4-process job,
-    10 steady-state rounds cost exactly 10 key_value_sets, ZERO per-peer
-    blocking gets, and a bounded number of dir-get polls (each returning
-    all peers in one RPC).  The old transport cost (N-1) polled gets per
-    round plus (N-1) leave-marker gets per tick."""
+    """VERDICT r4 #3 + ISSUE 5: rounds are O(N) per process AND
+    event-driven — in a 4-process job launched through the runner (which
+    hosts the RPC KV), 10 steady-state rounds cost exactly 10
+    key_value_sets plus 10 key_value_dir_watch long polls, ZERO polled
+    dir-gets, ZERO leave-marker gets (markers ride the watch reply), and
+    ZERO per-peer blocking gets.  The pre-watch transport paid dir-get
+    polls bounded by the 250 ms tick; the original one paid (N-1) polled
+    gets per round plus (N-1) leave-marker gets per tick."""
     results = run(helpers_runner.kv_ops_per_round_fn, np=4, env=_env(),
                   port=free_port())
     assert len(results) == 4
@@ -365,13 +368,69 @@ def test_negotiation_kv_ops_per_round_bounded():
         assert r["rounds"] == 10, r
         assert r["kv_sets"] == 10, r                 # ONE publish per round
         assert r["kv_blocking_gets"] == 0, r         # never per-peer gets
-        assert r["kv_dir_gets"] >= 10, r             # at least one poll each
-        # bounded polling: exponential backoff means even heavy scheduler
-        # skew on a loaded 1-core host stays well under this
-        assert r["kv_dir_gets"] <= 10 * 40, r
-        # leave markers are only consulted after the 0.5s grace — rare in
-        # lockstep rounds, and one dir-get each time, never per peer
-        assert r["kv_left_gets"] <= 20, r
+        assert r["watch_fallbacks"] == 0, r          # watch stayed up
+        # steady state: ONE held watch per round, woken at last arrival
+        # (min_entries), so the count is exactly the round count
+        assert r["kv_dir_watches"] == 10, r
+        assert r["kv_dir_gets"] == 0, r              # ZERO polled dir-gets
+        assert r["kv_left_gets"] == 0, r             # folded into watch
+
+
+def test_steady_state_watch_costs_one_set_one_watch():
+    """ISSUE 5 transport-cost pin, runnable without a multi-process
+    launch: a Controller over the REAL RpcKvClient + KvServer, with the
+    peer simulated by direct store writes.  A steady-state fast round at
+    "4 processes" costs exactly one key_value_set plus one
+    key_value_dir_watch and ZERO polled dir-gets / leave-marker gets."""
+    import hashlib
+    import json as _json
+    import threading
+    import time
+
+    from horovod_tpu.ops import controller as ctl_mod
+    from horovod_tpu.runner.kv import KvServer, RpcKvClient
+
+    srv = KvServer(secret=None)
+    cli = RpcKvClient("127.0.0.1", srv.port, secret=None)
+    orig_client, orig_pi = ctl_mod._client, ctl_mod.jax.process_index
+    ctl_mod._client = lambda: cli
+    ctl_mod.jax.process_index = lambda: 0
+    try:
+        ctl = ctl_mod.Controller()
+        tok = _json.dumps(
+            {"s": [["t", "allreduce", "sum", "float32", [2], 0, False,
+                    -1, 1.0, 1.0]], "r": -1, "sp": None},
+            separators=(",", ":"), sort_keys=True)
+        procs = (0, 1, 2, 3)
+        gk = "g" + hashlib.sha1(
+            ",".join(map(str, procs)).encode()).hexdigest()[:12]
+        h = hashlib.sha1(tok.encode()).hexdigest()
+
+        def peers(seq, full):
+            time.sleep(0.03)
+            val = {"h": h, "e": [tok]} if full else {"h": h}
+            for q in (1, 2, 3):
+                srv.store.set(f"hvdctl/0/{gk}/{seq}/a/{q}",
+                              _json.dumps(val, separators=(",", ":")))
+
+        for seq in range(6):
+            threading.Thread(target=peers, args=(seq, seq == 0),
+                             daemon=True).start()
+            res = ctl.negotiate([tok], procs)
+            assert res.counts[tok] == 1
+            assert res.fast == (seq > 0)      # hash-only from round 1 on
+        st = ctl.stats()
+        assert st["kv_sets"] == 6, st          # one publish per round
+        assert st["kv_dir_watches"] == 6, st   # ONE watch per round
+        assert st["kv_dir_gets"] == 0, st      # ZERO polled dir-gets
+        assert st["kv_left_gets"] == 0, st     # markers ride the watch
+        assert st["kv_blocking_gets"] == 0, st
+        assert st["watch_fallbacks"] == 0, st
+        assert st["fast_rounds"] == 5 and st["full_rounds"] == 1, st
+    finally:
+        ctl_mod._client = orig_client
+        ctl_mod.jax.process_index = orig_pi
+        srv.close()
 
 
 def test_controller_keys_cleaned_at_shutdown():

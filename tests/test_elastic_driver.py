@@ -608,6 +608,29 @@ def test_flush_listeners_delivers_terminal_events(nospawn):
     assert "job_done" in seen
 
 
+def test_dead_epoch_kv_namespaces_pruned(nospawn):
+    """Epoch re-formation sweeps ``hvdctl/e{M}/`` for M ≤ epoch-2 from the
+    driver-hosted KV store (crashed incarnations never run
+    controller.cleanup_keys()); the previous epoch, the current one, and
+    non-elastic generation namespaces survive."""
+    if nospawn._kv_server is None:
+        pytest.skip("KV hosted by an outer launcher in this environment")
+    store = nospawn._kv_server.store
+    for ns in ("e0", "e1", "e2", "e3", "g1"):
+        store.set(f"hvdctl/{ns}/round/0/1", "x")
+        store.set(f"hvdctl/{ns}/left/1", "1")
+    nospawn._prune_dead_epoch_keys(3)
+    keys = [k for k, _ in store.dir_get("hvdctl/")[0]]
+    assert not any(k.startswith(("hvdctl/e0/", "hvdctl/e1/"))
+                   for k in keys)
+    for kept in ("hvdctl/e2/", "hvdctl/e3/", "hvdctl/g1/"):
+        assert any(k.startswith(kept) for k in keys)
+    # early epochs have no unreachable predecessors: sweep is a no-op
+    nospawn._prune_dead_epoch_keys(1)
+    assert any(k.startswith("hvdctl/e2/")
+               for k, _ in store.dir_get("hvdctl/")[0])
+
+
 def test_driver_network_interface_flows_to_workers():
     """--network-interface reaches both the coordinator address and the
     driver RPC address handed to spawned workers."""

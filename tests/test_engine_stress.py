@@ -124,3 +124,125 @@ def test_three_frontends_share_one_engine(hvd, n_workers):
         t.join(timeout=30)
     assert not any(t.is_alive() for t in threads), "worker hung"
     assert not errors, errors
+
+
+# --- event-driven wake-ups (ISSUE 5): no busy-polling ------------------------
+
+class _StubProcessSet:
+    """Two-process process set: enough surface for _member_procs/sigs."""
+
+    def __init__(self):
+        import types
+
+        import numpy as _np
+        devs = _np.array([types.SimpleNamespace(process_index=0),
+                          types.SimpleNamespace(process_index=1)])
+        self.mesh = types.SimpleNamespace(devices=devs)
+        self.process_set_id = 0
+
+
+class _StubController:
+    """Controller stand-in: negotiation never finds common tensors."""
+
+    enabled = True
+    joined = False
+
+    def __init__(self):
+        from horovod_tpu.ops.controller import NegotiationResult
+        self._empty = NegotiationResult()
+        self.set_joined_calls = []
+
+    def negotiate(self, tokens, procs, params=None, aux=None):
+        return self._empty
+
+    def set_joined(self, joined):
+        self.set_joined_calls.append(joined)
+
+
+def _bare_engine(hvd, controller):
+    from horovod_tpu.ops.engine import CollectiveEngine
+    cfg = hvd.runtime._state().config
+    return CollectiveEngine(cfg, mesh=None, controller=controller)
+
+
+def test_join_drain_wakes_on_cycle_completion(hvd):
+    """join()'s pre-join drain is a condition wait notified on cycle
+    completion — NOT the old 5 ms busy-poll.  With the safety re-check
+    stretched to 10 s, a drain that still returns promptly (and in ≤ a
+    couple of wait iterations) proves the event-driven wake-up; a
+    5 ms poll would have burned ~100 iterations for the same wait."""
+    import threading
+    import time
+
+    class _JoinDoneController(_StubController):
+        def negotiate(self, tokens, procs, params=None, aux=None):
+            from horovod_tpu.ops.controller import NegotiationResult
+            return NegotiationResult(all_joined=True, last_joiner=1)
+
+    eng = _bare_engine(hvd, _JoinDoneController())
+    eng._drain_wait_s = 10.0               # a poll would stall; a notify won't
+    with eng._cv:
+        eng._cycle_active = True           # simulate an in-flight cycle
+    out = {}
+
+    def joiner():
+        t0 = time.monotonic()
+        out["last"] = eng.join()
+        out["dt"] = time.monotonic() - t0
+
+    th = threading.Thread(target=joiner, daemon=True)
+    th.start()
+    time.sleep(0.5)
+    assert th.is_alive()                   # still draining: cycle active
+    with eng._cv:                          # what run_cycle_once's finally does
+        eng._cycle_active = False
+        eng._cv.notify_all()
+    th.join(timeout=5)
+    assert not th.is_alive()
+    assert out["last"] == 1
+    assert out["dt"] < 5.0                 # woke on notify, not the 10s net
+    assert eng._drain_wait_iters <= 3, eng._drain_wait_iters
+
+
+def test_nothing_common_pace_wakes_on_submit(hvd):
+    """The nothing-common retry is a condition wait notified by
+    submit() — a NEW submission (possibly the tensor peers are waiting
+    on) re-enters negotiation immediately instead of after a fixed
+    20 ms sleep.  With the pace bound stretched to 10 s, the cycle must
+    return as soon as the concurrent submit lands."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from horovod_tpu.ops.engine import TensorTableEntry
+
+    eng = _bare_engine(hvd, _StubController())
+    eng._pace_s = 10.0
+    ps = _StubProcessSet()
+
+    def entry(name):
+        return TensorTableEntry(name=name, op_type="allreduce",
+                                arrays=[np.ones((2,), np.float32)],
+                                process_set=ps, stacked=False)
+
+    with eng._cv:
+        eng._queue.append(entry("lonely"))
+    out = {}
+
+    def cycle():
+        t0 = time.monotonic()
+        eng.run_cycle_once()
+        out["dt"] = time.monotonic() - t0
+
+    th = threading.Thread(target=cycle, daemon=True)
+    th.start()
+    time.sleep(0.3)                        # cycle is now pace-waiting
+    assert th.is_alive()
+    eng.submit(entry("newcomer"))          # must wake the pace wait
+    th.join(timeout=5)
+    assert not th.is_alive()
+    assert out["dt"] < 5.0                 # woke on submit, not the 10s net
+    assert eng._pace_waits == 1
+    with eng._lock:                        # lonely requeued + newcomer
+        assert len(eng._queue) == 2

@@ -1,0 +1,342 @@
+"""Event-driven control-plane KV: store versioning, long-poll watch,
+RPC client parity, and the keep-alive connection pool (ISSUE 5).
+
+The negotiation controller's steady-state transport cost pin — one
+``key_value_set`` plus ONE ``key_value_dir_watch`` per round, zero
+polled dir-gets — lives in tests/test_controller.py; the chaos-driven
+watch→poll fallback regression lives in tests/test_chaos.py.
+"""
+
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.runner import rpc as rpc_mod
+from horovod_tpu.runner.kv import (KvServer, KvStore, RpcKvClient,
+                                   kv_env_for, start_kv_server)
+
+
+@pytest.fixture()
+def server():
+    srv = KvServer(secret=None)
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def client(server):
+    return RpcKvClient("127.0.0.1", server.port, secret=None)
+
+
+# --- KvStore semantics -------------------------------------------------------
+
+def test_store_set_get_dir_delete():
+    st = KvStore()
+    st.set("a/b/0", "x")
+    st.set("a/b/1", "y")
+    st.set("a/c/0", "z")
+    assert st.get("a/b/1") == "y"
+    assert st.get("missing") is None
+    entries, ver = st.dir_get("a/b/")
+    assert entries == [("a/b/0", "x"), ("a/b/1", "y")]
+    assert ver == 3
+    st.delete("a/b/1")
+    assert st.get("a/b/1") is None
+    st.delete("a/")                      # trailing slash: subtree
+    assert st.dir_get("a/")[0] == []
+    # versions are monotonic across mutations, deletions included
+    assert st.dir_get("a/")[1] > ver
+
+
+def test_watch_holds_until_set_and_returns_cursor():
+    st = KvStore()
+    woke = {}
+
+    def watcher():
+        t0 = time.monotonic()
+        entries, ver, _extra, ok = st.dir_watch("d/", 0, 10.0)
+        woke.update(entries=entries, ver=ver, ok=ok,
+                    waited=time.monotonic() - t0)
+
+    th = threading.Thread(target=watcher, daemon=True)
+    th.start()
+    time.sleep(0.15)
+    st.set("d/k", "v")
+    th.join(timeout=5)
+    assert not th.is_alive()
+    assert woke["entries"] == [("d/k", "v")] and woke["ok"]
+    assert woke["waited"] >= 0.1
+    # re-arming with the returned cursor waits out the deadline (nothing
+    # new), instead of re-waking on the already-seen change
+    t0 = time.monotonic()
+    entries, _v, _x, ok = st.dir_watch("d/", woke["ver"], 0.15)
+    assert time.monotonic() - t0 >= 0.1
+    assert entries == [("d/k", "v")] and ok
+
+
+def test_watch_deadline_and_skip_and_min_entries():
+    st = KvStore()
+    # deadline: an untouched dir returns (empty) after the bound
+    t0 = time.monotonic()
+    entries, _v, _x, ok = st.dir_watch("d/", 0, 0.1)
+    assert entries == [] and ok and time.monotonic() - t0 >= 0.08
+    # skip: the caller's own publish does not satisfy the predicate
+    st.set("d/me", "mine")
+    t0 = time.monotonic()
+    entries, ver, _x, ok = st.dir_watch("d/", 0, 0.12, skip="d/me")
+    assert time.monotonic() - t0 >= 0.08     # held despite own key
+    assert entries == [("d/me", "mine")]
+    # min_entries: wakes once, at the LAST peer arrival
+    def peers():
+        time.sleep(0.05)
+        st.set("d/p1", "1")
+        time.sleep(0.05)
+        st.set("d/p2", "2")
+
+    threading.Thread(target=peers, daemon=True).start()
+    t0 = time.monotonic()
+    entries, _v, _x, ok = st.dir_watch("d/", ver, 10.0, skip="d/me",
+                                       min_entries=2)
+    waited = time.monotonic() - t0
+    assert [k for k, _ in entries] == ["d/me", "d/p1", "d/p2"]
+    assert 0.08 <= waited < 5.0, waited      # woke at p2, not p1/deadline
+
+
+def test_watch_extra_dir_wakes_and_rides_reply():
+    st = KvStore()
+    st.set("d/me", "mine")
+    _e, ver, _x, _ok = st.dir_watch("d/", 10**9, 0.0)
+
+    def leaver():
+        time.sleep(0.05)
+        st.set("left/3", "1")
+
+    threading.Thread(target=leaver, daemon=True).start()
+    t0 = time.monotonic()
+    entries, _v, extra, ok = st.dir_watch("d/", ver, 10.0, extra="left/",
+                                          skip="d/me", min_entries=5)
+    assert time.monotonic() - t0 < 5.0       # the leave marker woke it
+    assert extra == [("left/3", "1")] and ok
+
+
+def test_watch_slot_exhaustion_degrades_to_snapshot():
+    st = KvStore()
+    st._max_held = 0
+    t0 = time.monotonic()
+    entries, _v, _x, ok = st.dir_watch("d/", 0, 5.0)
+    assert time.monotonic() - t0 < 1.0       # no hold
+    assert entries == [] and not ok          # degrade flagged
+
+
+# --- RPC client parity -------------------------------------------------------
+
+def test_client_roundtrip_and_watch(server, client):
+    client.key_value_set("hvd/a/0", "zero")
+    assert client.key_value_dir_get("hvd/a/") == [("hvd/a/0", "zero")]
+
+    def peer():
+        time.sleep(0.1)
+        server.store.set("hvd/a/1", "one")
+
+    threading.Thread(target=peer, daemon=True).start()
+    entries, ver, _extra, ok = client.key_value_dir_watch(
+        "hvd/a/", 0, 10.0, skip="hvd/a/0", min_entries=1)
+    assert ("hvd/a/1", "one") in entries and ok and ver >= 2
+    client.key_value_delete("hvd/a/")
+    assert client.key_value_dir_get("hvd/a/") == []
+
+
+def test_client_blocking_get_waits_and_times_out(server, client):
+    def peer():
+        time.sleep(0.1)
+        server.store.set("bk/k", "v")
+
+    threading.Thread(target=peer, daemon=True).start()
+    assert client.blocking_key_value_get("bk/k", 5000) == "v"
+    with pytest.raises(TimeoutError):
+        client.blocking_key_value_get("bk/nope", 150)
+
+
+def test_kv_handlers_signed_by_default(monkeypatch):
+    """The KV endpoints live behind the same HMAC discipline as every
+    other control-plane POST: with a job secret in the env, unsigned
+    clients get 403 and signed clients work."""
+    import urllib.error
+
+    from horovod_tpu.runner import secret as secret_mod
+    key = secret_mod.make_secret_key()
+    monkeypatch.setenv(secret_mod.SECRET_ENV, key)
+    srv = KvServer()                          # secret from env
+    try:
+        good = RpcKvClient("127.0.0.1", srv.port)
+        good.key_value_set("s/k", "v")
+        assert good.key_value_dir_get("s/") == [("s/k", "v")]
+        bad = RpcKvClient("127.0.0.1", srv.port, secret=None)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            bad.key_value_set("s/k2", "v2")
+        assert ei.value.code == 403
+    finally:
+        srv.close()
+
+
+def test_start_kv_server_defers_to_outer_launcher(monkeypatch):
+    srv = start_kv_server()
+    try:
+        assert srv is not None
+        env = kv_env_for("localhost", lambda h: True, srv)
+        assert env["HOROVOD_KV_ADDR"].endswith(f":{srv.port}")
+    finally:
+        srv.close()
+    monkeypatch.setenv("HOROVOD_KV_ADDR", "somewhere:1")
+    assert start_kv_server() is None         # outer launcher owns it
+    assert kv_env_for("localhost", lambda h: True, None) == {}
+
+
+# --- keep-alive connection pool ----------------------------------------------
+
+def _reuse(result):
+    return rpc_mod._m_conn_reuse.value(result=result)
+
+
+def test_keepalive_pool_reuses_and_detects_stale(server, client):
+    rpc_mod._POOL.clear()
+    h0, m0, s0 = _reuse("hit"), _reuse("miss"), _reuse("stale")
+    client.key_value_set("p/k", "1")          # fresh dial
+    client.key_value_set("p/k", "2")          # must reuse the socket
+    assert _reuse("miss") == m0 + 1
+    assert _reuse("hit") >= h0 + 1
+    # kill the pooled socket under the client: the next call must detect
+    # the stale connection, redial, and still succeed
+    with rpc_mod._POOL._lock:
+        conns = [c for stack in rpc_mod._POOL._idle.values()
+                 for c in stack]
+    assert conns, "expected an idle pooled connection"
+    for c in conns:
+        c.sock.close()
+    client.key_value_set("p/k", "3")
+    assert _reuse("stale") == s0 + 1
+    assert server.store.get("p/k") == "3"
+
+
+def test_keepalive_disabled_falls_back_to_urlopen(monkeypatch, server):
+    monkeypatch.setenv(rpc_mod.KEEPALIVE_ENV, "0")
+    rpc_mod._POOL.clear()
+    client = RpcKvClient("127.0.0.1", server.port, secret=None)
+    client.key_value_set("u/k", "v")
+    assert client.key_value_dir_get("u/") == [("u/k", "v")]
+    with rpc_mod._POOL._lock:
+        assert not any(rpc_mod._POOL._idle.values())
+
+
+def test_pool_bounds_idle_connections():
+    pool = rpc_mod.ConnectionPool(max_idle_per_host=2)
+
+    class FakeConn:
+        closed = False
+
+        def close(self):
+            self.closed = True
+
+    conns = [FakeConn() for _ in range(4)]
+    for c in conns:
+        pool.put("h", 1, c)
+    assert [c.closed for c in conns] == [False, False, True, True]
+    assert pool.get("h", 1) is conns[1]
+    assert pool.get("h", 1) is conns[0]
+    assert pool.get("h", 1) is None
+    pool.put("h", 1, conns[0])
+    pool.clear()
+    assert conns[0].closed
+
+
+def test_chaos_site_covers_watch_verb(monkeypatch, server):
+    """``rpc.request:key_value_dir_watch`` is a live injection site: a
+    drop-all schedule makes the client's watch raise after its bounded
+    retries (the controller's cue to fall back to polling)."""
+    import horovod_tpu.chaos as chaos
+    from horovod_tpu.chaos import FaultSchedule
+
+    monkeypatch.setenv(rpc_mod.RETRIES_ENV, "1")
+    monkeypatch.setenv(rpc_mod.BACKOFF_ENV, "0.01")
+    client = RpcKvClient("127.0.0.1", server.port, secret=None)
+    chaos.install(FaultSchedule.parse(
+        "rpc.request:key_value_dir_watch action=drop", seed=3))
+    try:
+        with pytest.raises(ConnectionError):
+            client.key_value_dir_watch("c/", 0, 0.1)
+        client.key_value_set("c/k", "v")      # other verbs unaffected
+        assert client.key_value_dir_get("c/") == [("c/k", "v")]
+    finally:
+        chaos.uninstall()
+
+
+def test_version_stamps_bounded_over_many_rounds():
+    """The per-directory version stamps must not leak: negotiation mints
+    a new per-seq directory every round forever, and the elastic
+    driver's KvServer lives for the whole job.  After many
+    publish-then-clean rounds the stamp dicts stay around _PRUNE_AT,
+    and a write under a long-pruned directory still wakes a watcher."""
+    s = KvStore()
+    for seq in range(3 * KvStore._PRUNE_AT // 4):
+        for r in range(4):
+            s.set(f"hvdctl/ns/g1/{seq}/a/{r}", "v")
+        if seq >= 4:
+            for r in range(4):
+                s.delete(f"hvdctl/ns/g1/{seq - 4}/a/{r}")
+    assert len(s._dir_ver) <= s._PRUNE_AT + 64, len(s._dir_ver)
+    assert len(s._tomb_ver) <= s._PRUNE_AT + 64, len(s._tomb_ver)
+    assert len(s._dir_count) < 40, len(s._dir_count)   # live dirs only
+    # correctness across a prune: a fresh write under a pruned directory
+    # recreates its stamp above any outstanding cursor
+    _e, ver, _x, _ok = s.dir_watch("hvdctl/ns/g1/0/a/", 0, 0.0)
+    s.set("hvdctl/ns/g1/0/a/9", "late")
+    e, _v, _x, _ok = s.dir_watch("hvdctl/ns/g1/0/a/", ver, 5.0)
+    assert e == [("hvdctl/ns/g1/0/a/9", "late")], e
+
+
+def test_conn_reuse_outcomes_are_exclusive(monkeypatch):
+    """hvd_rpc_conn_reuse_total counts exactly ONE outcome per request:
+    a stale-then-redialed request counts as `stale`, never also `miss`."""
+    from horovod_tpu import metrics as _metrics
+
+    def reuse_counts():
+        fam = _metrics.snapshot()["families"].get(
+            "hvd_rpc_conn_reuse_total", {"series": []})
+        out = {"hit": 0, "miss": 0, "stale": 0}
+        for srs in fam["series"]:
+            out[srs["labels"]["result"]] = srs["value"]
+        return out
+
+    srv = KvServer(secret=None)
+    cli = RpcKvClient("127.0.0.1", srv.port, secret=None)
+    before = reuse_counts()
+    cli.key_value_set("x/k", "1")          # miss (fresh dial)
+    cli.key_value_set("x/k", "2")          # hit (pooled)
+    srv.close()                             # kills the pooled socket
+    srv2 = KvServer(secret=None)
+    cli2 = RpcKvClient("127.0.0.1", srv2.port, secret=None)
+    try:
+        cli2.key_value_set("x/k", "3")     # miss on the new endpoint
+        d = {k: reuse_counts()[k] - before[k] for k in before}
+        assert d["hit"] == 1 and d["miss"] == 2, d
+        # one request = one outcome, even around the server restart
+        assert d["hit"] + d["miss"] + d["stale"] == 3, d
+    finally:
+        srv2.close()
+
+
+def test_watch_deadline_clamped_to_floor(monkeypatch):
+    """A zero/negative HOROVOD_KV_WATCH_DEADLINE_S must not produce an
+    unpaced tight watch loop: unsatisfied watches return immediately
+    with held=True, so the caller's degraded-reply pacing never fires —
+    the deadline is floored instead (HOROVOD_KV_WATCH=0 is the off
+    switch, not a zero deadline)."""
+    from horovod_tpu.runner import kv as kv_mod
+    for raw in ("0", "-1", "0.001"):
+        monkeypatch.setenv(kv_mod.KV_WATCH_DEADLINE_ENV, raw)
+        assert kv_mod.watch_deadline_s() == kv_mod._MIN_DEADLINE_S
+    monkeypatch.setenv(kv_mod.KV_WATCH_DEADLINE_ENV, "3.5")
+    assert kv_mod.watch_deadline_s() == 3.5
+    monkeypatch.setenv(kv_mod.KV_WATCH_DEADLINE_ENV, "garbage")
+    assert kv_mod.watch_deadline_s() == kv_mod._DEFAULT_DEADLINE_S

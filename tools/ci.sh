@@ -23,16 +23,19 @@
 #   9. chaos:   the elastic join path under pinned fault-injection seeds
 #      must converge, and the leader-join regression stays pinned
 #      (docs/env.md "Chaos engineering")
+#  10. bench:   tools/bench_control.py --smoke — real multi-process
+#      negotiation over the RPC KV; watch-transport invariants (one
+#      set + one watch per round, zero polled dir-gets) stay pinned
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/9 package: wheel + sdist =="
+echo "== 1/10 package: wheel + sdist =="
 rm -rf dist/
 python -m build --no-isolation --outdir dist/ . > /tmp/ci_build.log 2>&1 \
   || { tail -30 /tmp/ci_build.log; exit 1; }
 ls -l dist/
 
-echo "== 2/9 wheel install smoke (scratch target, run from /tmp) =="
+echo "== 2/10 wheel install smoke (scratch target, run from /tmp) =="
 WHEEL_TGT=$(mktemp -d)
 trap 'rm -rf "$WHEEL_TGT"' EXIT
 REPO_DIR="$(pwd)"
@@ -89,39 +92,81 @@ for fam in ("hvd_engine_cycles_total", "hvd_cycle_duration_seconds",
 assert fams["hvd_cycle_duration_seconds"]["type"] == "histogram"
 cycles = [v for n, _, v in fams["hvd_engine_cycles_total"]["samples"]]
 assert cycles and cycles[0] >= 1, cycles
+
+# event-driven control plane smoke (ISSUE 5): one negotiation round over
+# the installed RpcKvClient/KvServer must ride the long-poll watch and
+# the keep-alive pool, and both must be visible on /metrics
+import hashlib, threading, time
+from horovod_tpu.ops import controller as ctl_mod
+from horovod_tpu.runner.kv import KvServer, RpcKvClient
+kv_srv = KvServer(secret=None)
+kv_cli = RpcKvClient("127.0.0.1", kv_srv.port, secret=None)
+orig_client, orig_pi = ctl_mod._client, ctl_mod.jax.process_index
+ctl_mod._client = lambda: kv_cli
+ctl_mod.jax.process_index = lambda: 0
+try:
+    ctl = ctl_mod.Controller(namespace="cismoke")
+    tok = json.dumps(
+        {"s": [["t", "allreduce", "sum", "float32", [2], 0, False, -1,
+                1.0, 1.0]], "r": -1, "sp": None},
+        separators=(",", ":"), sort_keys=True)
+    gk = "g" + hashlib.sha1(b"0,1").hexdigest()[:12]
+    h = hashlib.sha1(tok.encode()).hexdigest()
+    for seq in range(2):
+        threading.Timer(0.05, kv_srv.store.set,
+                        (f"hvdctl/cismoke/{gk}/{seq}/a/1",
+                         json.dumps({"h": h, "e": [tok]},
+                                    separators=(",", ":")))).start()
+        res = ctl.negotiate([tok], (0, 1))
+        assert res.counts[tok] == 1, res
+    st = ctl.stats()
+    assert st["kv_dir_watches"] >= 2 and st["kv_dir_gets"] == 0, st
+finally:
+    ctl_mod._client = orig_client
+    ctl_mod.jax.process_index = orig_pi
+    kv_srv.close()
+fams = aggregate.parse_prometheus(aggregate.scrape("127.0.0.1", srv.port))
+def _family_count(fam, **want):
+    return sum(v for _, lbl, v in fams[fam]["samples"]
+               if all(lbl.get(k) == w for k, w in want.items()))
+watch_rounds = _family_count("hvd_negotiation_rounds_total", kind="watch")
+assert watch_rounds >= 2, fams["hvd_negotiation_rounds_total"]["samples"]
+reuse_hits = _family_count("hvd_rpc_conn_reuse_total", result="hit")
+assert reuse_hits >= 1, fams["hvd_rpc_conn_reuse_total"]["samples"]
 srv.close()
 
 hvd.shutdown()
-print("dist smoke OK (incl. /metrics + /healthz scrape), imported from",
-      os.path.dirname(hvd.__file__))
+print(f"dist smoke OK (incl. /metrics + /healthz scrape, "
+      f"{int(watch_rounds)} watch rounds, {int(reuse_hits)} keep-alive "
+      f"hits), imported from", os.path.dirname(hvd.__file__))
 PYEOF
   )
 }
 
 dist_smoke dist/*.whl
 if [ "${1:-}" != "--quick" ]; then
-  echo "== 3/9 sdist install smoke (builds from source) =="
+  echo "== 3/10 sdist install smoke (builds from source) =="
   dist_smoke dist/*.tar.gz
 fi
 
-echo "== 4/9 native core build + parity tests =="
+echo "== 4/10 native core build + parity tests =="
 python setup.py build_ext --inplace > /tmp/ci_native.log 2>&1 \
   || { tail -30 /tmp/ci_native.log; exit 1; }
 python -m pytest tests/test_native_core.py -q
 
-echo "== 5/9 pure-python fallback (native core disabled) =="
+echo "== 5/10 pure-python fallback (native core disabled) =="
 HOROVOD_TPU_NATIVE_CORE=0 python -m pytest \
   tests/test_basics.py tests/test_fusion.py -q
 
-echo "== 6/9 controller disabled (single-process semantics) =="
+echo "== 6/10 controller disabled (single-process semantics) =="
 HOROVOD_TPU_CONTROLLER=0 python -m pytest tests/test_basics.py -q
 
 if [ "${1:-}" != "--quick" ]; then
-  echo "== 7/9 full suite =="
+  echo "== 7/10 full suite =="
   python -m pytest tests/ -q
 fi
 
-echo "== 8/9 hvdlint static analysis =="
+echo "== 8/10 hvdlint static analysis =="
 # all three engines (user rules, lock-order, guarded-by race detector);
 # --baseline: fail only on NEW findings vs the checked-in ratchet
 # (near-empty by policy — docs/analysis.md "Baseline workflow").  One
@@ -129,8 +174,16 @@ echo "== 8/9 hvdlint static analysis =="
 python -m horovod_tpu.analysis \
   --baseline tools/hvdlint_baseline.json horovod_tpu/ examples/
 
-echo "== 9/9 chaos smoke: elastic join under fixed fault seeds =="
+echo "== 9/10 chaos smoke: elastic join under fixed fault seeds =="
 python -m pytest tests/test_chaos.py -q \
   -k "converges_under_fault_seed or leader_join"
+
+echo "== 10/10 control-plane bench smoke (watch transport invariants) =="
+# fast correctness run of tools/bench_control.py: real multi-process
+# negotiation over the RPC KV; asserts ZERO polled dir-gets and one
+# set + one watch per steady-state round (docs/performance.md)
+python tools/bench_control.py --smoke > /tmp/ci_bench_control.log 2>&1 \
+  || { tail -30 /tmp/ci_bench_control.log; exit 1; }
+tail -1 /tmp/ci_bench_control.log
 
 echo "CI matrix: all stages green"
