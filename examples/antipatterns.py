@@ -19,8 +19,16 @@ and the comment shows the corrected form.  The bugs:
 * HVD006 — eager collective inside a jit-traced function
 * HVD110/111/113/114 — RacyMetricsSink: shared state half-guarded by its
            lock (the guarded-by race detector's teaching fixture)
+* HVD200–HVD205 — the SPMD divergence dataflow family: rank-guarded
+           collectives through TWO helper levels, shape-divergent
+           operands, divergent early exits, divergent publishes and
+           parameters (the interprocedural taint engine's fixtures)
+* HVD210 — rank_asymmetric_toy_step: a step whose COMPILED collective
+           schedule depends on the rank (the hvdsched extractor's
+           teaching fixture; tests/test_schedule.py traces both ranks)
 """
 
+import socket
 import threading
 import time
 
@@ -155,6 +163,87 @@ class RacyMetricsSink:
         # here without it — the read can see the dict mid-resize.
         # Fix: with self._lock: return dict(self._counts)
         return dict(self._counts)
+
+
+# ---------------------------------------------------------------------------
+# SPMD divergence dataflow fixtures (HVD200–HVD205)
+# ---------------------------------------------------------------------------
+
+def _reduce_stats(x):
+    # innocent on its own: the SECOND helper level that actually submits
+    return hvd.allreduce(x, name="divergence.stats")
+
+
+def _log_stats(x):
+    # the FIRST helper level: merely forwards — the one-level syntactic
+    # rule (HVD001) cannot see through this, the dataflow engine can
+    return _reduce_stats(x)
+
+
+def rank_guarded_through_two_helpers(metrics):
+    # HVD200: only rank 0 calls the helper chain that allreduces two
+    # frames down; every other rank deadlocks.  Fix: hoist the call out
+    # of the branch — all ranks submit, rank 0 alone uses the result.
+    if hvd.rank() == 0:
+        return _log_stats(metrics)
+    return metrics
+
+
+def shape_divergent_operand(x):
+    # HVD201: each rank reduces a different-sized slice — the fused
+    # buffers disagree and the reduction diverges (or crashes).  Fix:
+    # broadcast the size from rank 0 (n = hvd.broadcast_object(n)).
+    n = hvd.rank() + 1
+    shard = x[:n]
+    return hvd.allreduce(shard, name="divergence.shard")
+
+
+def divergent_early_return_skip(x):
+    # HVD202: the wall clock decides who returns early, so only some
+    # ranks reach the allreduce below and the rest block forever.
+    # Fix: make every rank take the same path (agree via a collective).
+    if time.time() % 2 > 1:
+        return None
+    return hvd.allreduce(x, name="divergence.late")
+
+
+def divergent_publish(kv_store):
+    # HVD203: every rank writes ITS hostname to ONE shared key —
+    # last-writer-wins, and the ranks read a value they don't agree on.
+    # Fix: rank-qualify the key (the divergent-key form below is the
+    # accepted idiom and stays silent), or broadcast the value first.
+    kv_store.set("job/leader_host", socket.gethostname())
+    kv_store.set("job/host/%d" % hvd.rank(), socket.gethostname())
+
+
+def divergent_collective_name(x):
+    # HVD204: negotiation matches requests by name= — per-rank names
+    # pair incompatible submissions (rank 0's "grads.0" never meets
+    # rank 1's "grads.1").  Fix: one shared name for the one logical
+    # tensor.  (NOT hvd.broadcast here: any broadcast-family call is an
+    # HVD002 sync marker and would mute the fixture above.)
+    return hvd.allreduce(x, name="grads.%d" % hvd.rank())
+
+
+def divergent_loop_trip_count(x):
+    # HVD205: rank r submits r barriers; every rank waits for a barrier
+    # some peer never submits.  Fix: loop over a broadcast count.
+    for _ in range(hvd.rank()):
+        hvd.barrier()
+    return x
+
+
+def rank_asymmetric_toy_step(rank):
+    # HVD210 (schedule extractor, NOT an AST rule): the COMPILED
+    # collective schedule depends on the rank — rank 0's program issues
+    # two psums, everyone else's one, and the replicas deadlock.
+    # tests/test_schedule.py traces this at rank 0 and rank 1 and pins
+    # that tools/hvdsched's consistency check (HVD210) catches it.
+    def step(g):
+        if rank == 0:
+            g = jax.lax.psum(g, "workers")   # only rank 0's trace has this
+        return jax.lax.psum(g, "workers")
+    return step
 
 
 def main():

@@ -65,8 +65,12 @@ def main():
     y = torch.from_numpy(labels[rank::nproc]).long()
 
     # every process must run the SAME number of optimizer steps (each
-    # fires gradient allreduces): agree on the minimum across shards
-    batch = max(1, min(args.batch_size, len(X)))
+    # fires gradient allreduces) with the SAME batch size: a rank whose
+    # shard is smaller than the requested batch would otherwise window
+    # its data differently — agree on the minima across shards
+    batch = int(hvd.allreduce(
+        torch.tensor(float(max(1, min(args.batch_size, len(X))))),
+        op=hvd.Min, name="batch"))
     local_steps = max(len(X) // batch, 1)
     steps = int(hvd.allreduce(torch.tensor(float(local_steps)),
                               op=hvd.Min, name="steps"))

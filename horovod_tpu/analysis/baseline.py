@@ -6,11 +6,18 @@ with a justification).  ``--baseline FILE`` subtracts baselined findings
 from a run; ``--baseline FILE --update-baseline`` rewrites the file from
 the current findings (the explicit ratchet step, reviewed in the diff).
 
-Entries match on a **fingerprint** — ``code | path | message with digit
-runs collapsed`` — so line-number drift from unrelated edits does not
-invalidate the baseline, while a genuinely new finding (different
-attribute, class, or rule) never matches.  Each fingerprint carries a
-count: the baseline tolerates at most that many occurrences.
+Entries match on a **fingerprint** — ``v<analyzer> | code | path |
+message with digit runs collapsed`` — so line-number drift from
+unrelated edits does not invalidate the baseline, while a genuinely new
+finding (different attribute, class, or rule) never matches.  Each
+fingerprint carries a count: the baseline tolerates at most that many
+occurrences.
+
+Fingerprints and the file itself are keyed on
+:data:`report.ANALYZER_VERSION`: a baseline recorded by an older rule
+engine is **refused** (loud ``--update-baseline`` prompt), never
+silently matched — an engine upgrade that reclassifies or renumbers
+findings must re-ratchet explicitly, in a reviewed diff.
 """
 
 from __future__ import annotations
@@ -21,7 +28,7 @@ import re
 from collections import Counter
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from .report import Finding
+from .report import ANALYZER_VERSION, Finding
 
 _DIGITS = re.compile(r"\d+")
 
@@ -58,13 +65,25 @@ def _canonical_path(path: str) -> str:
 
 def fingerprint(finding: Finding) -> str:
     path = _canonical_path(finding.path)
-    return f"{finding.code}|{path}|{_DIGITS.sub('#', finding.message)}"
+    return (f"v{ANALYZER_VERSION}|{finding.code}|{path}|"
+            f"{_DIGITS.sub('#', finding.message)}")
 
 
 def load(path: str) -> Dict[str, int]:
-    """fingerprint -> tolerated occurrence count."""
+    """fingerprint -> tolerated occurrence count.
+
+    Raises ``ValueError`` when the baseline was recorded by a different
+    analyzer generation: its entries describe what an *older* rule
+    engine found, and matching them against this engine's output could
+    silently swallow real new findings (or report baselined ones)."""
     with open(path, "r", encoding="utf-8") as f:
         data = json.load(f)
+    recorded = int(data.get("analyzer_version", 0))
+    if recorded != ANALYZER_VERSION:
+        raise ValueError(
+            f"baseline recorded by analyzer version {recorded}, this is "
+            f"version {ANALYZER_VERSION} — re-ratchet with "
+            f"--baseline {path} --update-baseline and review the diff")
     out: Dict[str, int] = {}
     for entry in data.get("findings", []):
         fp = entry["fingerprint"]
@@ -84,8 +103,8 @@ def save(path: str, findings: Iterable[Finding]) -> int:
                 "count": n, "fingerprint": fp}
                for fp, n in sorted(counts.items())]
     with open(path, "w", encoding="utf-8") as f:
-        json.dump({"version": 1, "findings": entries}, f, indent=2,
-                  sort_keys=False)
+        json.dump({"version": 1, "analyzer_version": ANALYZER_VERSION,
+                   "findings": entries}, f, indent=2, sort_keys=False)
         f.write("\n")
     return len(entries)
 

@@ -11,23 +11,28 @@ import sys
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from . import baseline as baseline_mod
-from . import guarded_by, lock_order, user_rules
-from .report import (Finding, RULES, apply_suppressions, file_skipped,
-                     iter_suppressions)
+from . import divergence, guarded_by, lock_order, user_rules
+from .report import (Finding, RULES, apply_suppressions,
+                     file_skipped, iter_suppressions)
 
 _SKIP_DIRS = {"__pycache__", ".git", "build", "dist", "node_modules",
               ".pytest_cache", ".hypothesis"}
 
 #: All engines, in run order.  "guards" is the HVD110–115 guarded-by
-#: race detector (guarded_by.py) added alongside the original two.
-ENGINES = ("user", "locks", "guards")
+#: race detector (guarded_by.py); "divergence" is the HVD200–HVD205
+#: SPMD rank-divergence dataflow engine (divergence.py).
+ENGINES = ("user", "locks", "guards", "divergence")
 
 #: Parsed-AST cache keyed by absolute path: every pass (user rules,
-#: lock-order, guarded-by) and every re-run in one process (e.g. the
-#: framework-wide pytest pins) reuses one parse per file revision.  The
-#: entry is validated against the SOURCE CONTENT (size + crc32), never
-#: against mtime — a file edited between read and stat can not poison
-#: the cache with a stale tree.
+#: lock-order, guarded-by, divergence) and every re-run in one process
+#: (e.g. the framework-wide pytest pins) reuses one parse per file
+#: revision.  The entry is validated against the SOURCE CONTENT
+#: (size + crc32), never against mtime — a file edited between read and
+#: stat can not poison the cache with a stale tree.  The cache stores
+#: ONLY the parse result, which depends on nothing but the source, so
+#: it needs no ANALYZER_VERSION keying; findings are recomputed from
+#: the AST on every call, and the version token guards the artifacts
+#: that DO persist findings (the baseline files, baseline.py).
 _AST_CACHE: Dict[str, Tuple[Tuple[int, int], ast.Module]] = {}
 
 
@@ -105,9 +110,25 @@ def analyze_source(source: str, path: str = "<string>",
         findings.extend(lock_order.check_module(tree, path))
     if "guards" in engines:
         findings.extend(guarded_by.check_module(tree, path))
+    if "divergence" in engines:
+        findings.extend(divergence.check_module(tree, path))
+    findings = _dedupe_generalized(findings)
     findings = apply_suppressions(findings, iter_suppressions(source))
     findings.sort(key=lambda f: (f.line, f.col, f.code))
     return findings
+
+
+#: The divergence engine generalizes two user rules; when both fire on
+#: the same line, the specific rule's message wins and the generalized
+#: finding is dropped (one bug, one finding).
+_GENERALIZES = {"HVD200": "HVD001", "HVD202": "HVD003"}
+
+
+def _dedupe_generalized(findings: List[Finding]) -> List[Finding]:
+    specific = {(f.code, f.path, f.line) for f in findings}
+    return [f for f in findings
+            if f.code not in _GENERALIZES
+            or (_GENERALIZES[f.code], f.path, f.line) not in specific]
 
 
 def analyze_paths(paths: Sequence[str], include_skipped: bool = False,
@@ -175,12 +196,17 @@ def expand_select(spec: str) -> Tuple[List[str], List[str]]:
         m = _RANGE_RE.match(tok)
         if m:
             lo, hi = int(m.group(1)), int(m.group(2))
-            ends = {f"HVD{lo:03d}", f"HVD{hi:03d}"}
-            if hi < lo or not ends <= set(RULES):
+            hits = [f"HVD{n:03d}" for n in range(lo, hi + 1)
+                    if f"HVD{n:03d}" in RULES]
+            # a range may span a family's reserved band (HVD200-HVD215
+            # selects the divergence+schedule family even though 206-209
+            # and 212-215 are not yet assigned), but a range selecting
+            # NOTHING is a typo — it would filter out every finding and
+            # exit 0, fatal in a CI gate
+            if hi < lo or not hits:
                 unknown.append(tok)
                 continue
-            codes.extend(f"HVD{n:03d}" for n in range(lo, hi + 1)
-                         if f"HVD{n:03d}" in RULES)
+            codes.extend(hits)
         elif tok in RULES:
             codes.append(tok)
         else:
@@ -242,11 +268,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="comma-separated rule codes to report; "
                              "ranges allowed (HVD110-HVD115)")
     parser.add_argument("--engine",
-                        choices=("user", "locks", "guards", "all"),
+                        choices=("user", "locks", "guards", "divergence",
+                                 "all"),
                         default="all",
                         help="user-script rules, the lock-order "
                              "self-check, the guarded-by race detector, "
-                             "or all three (default)")
+                             "the SPMD divergence dataflow engine, or "
+                             "all four (default)")
     parser.add_argument("--include-skipped", action="store_true",
                         help="analyze files marked '# hvdlint: skip-file' "
                              "(for linting the lint fixtures themselves)")

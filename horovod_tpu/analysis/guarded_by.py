@@ -11,7 +11,14 @@ blocks, ``acquire()``/``release()`` spans, ``Condition(self._lock)``
 underlying-lock aliasing, and one level of intra-class calls: a private
 method only ever called with a lock held analyzes as if it held that
 lock (the *ambient* set), so ``caller must hold self._lock`` helpers do
-not false-positive.
+not false-positive.  Non-escaping nested defs (only ever called
+directly, never passed as a value) analyze under the locks provably
+held at BOTH their definition site and every direct call site — the
+``while not changed(): cv.wait()`` wait-predicate idiom defines and
+calls its predicate inside ``with self._cond:``, so the predicate and
+the private helpers it calls resolve the Condition's underlying lock
+one call level deeper, while a def merely *defined* under a lock but
+called after its release still analyzes bare.
 
 Findings:
 
@@ -84,6 +91,30 @@ def _self_attr(node: ast.expr) -> Optional[str]:
     return None
 
 
+def _nested_escapes(root: ast.AST, name: str) -> bool:
+    """Does the nested function ``name`` escape its enclosing method as a
+    *value* (thread target, callback registration, return, assignment,
+    container element)?  Only direct ``name(...)`` calls keep it local to
+    the defining scope."""
+    direct_callees: Set[int] = set()
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == name:
+            direct_callees.add(id(node.func))
+    for node in ast.walk(root):
+        if isinstance(node, ast.Name) and node.id == name \
+                and isinstance(node.ctx, ast.Load) \
+                and id(node) not in direct_callees:
+            return True
+    return False
+
+
+def _calls_name(root: ast.AST, name: str) -> bool:
+    """Does this subtree contain a direct ``name(...)`` call?"""
+    return any(isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+               and n.func.id == name for n in ast.walk(root))
+
+
 def _reads_attr(node: ast.expr, attr: str) -> bool:
     """Does this expression read ``self.<attr>`` anywhere?"""
     for sub in ast.walk(node):
@@ -130,10 +161,21 @@ class _MethodWalker:
     """Walk one method body tracking the held-lock set, recording
     attribute access sites and intra-class call sites."""
 
-    def __init__(self, cls: _MergedClass, method: str, in_init: bool):
+    def __init__(self, cls: _MergedClass, method: str, in_init: bool,
+                 root: Optional[ast.AST] = None,
+                 shared: Optional[dict] = None):
         self.cls = cls
         self.method = method
         self.in_init = in_init
+        #: the outermost method node — nested walkers share it so escape
+        #: analysis for a nested def sees every use site in the method
+        self.root = root
+        #: method-scope state shared with nested walkers: deferred
+        #: non-escaping nested defs ("defs": [(stmt, def_held, label)])
+        #: and the running INTERSECTION of the held set at each direct
+        #: call site of a nested name ("call_held": name -> fset|None)
+        self.shared = shared if shared is not None \
+            else {"defs": [], "call_held": {}}
 
     # -- held-set helpers ----------------------------------------------------
     def _underlying(self, attr: str) -> str:
@@ -171,10 +213,34 @@ class _MethodWalker:
             self.walk(stmt.body, inner)
             return held
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            # nested def: runs later on an unknown thread with no lock held
-            nested = _MethodWalker(
-                self.cls, f"{self.method}.<{stmt.name}>", in_init=False)
-            nested.walk(stmt.body, frozenset())
+            # A nested def that never escapes as a value (no thread
+            # target, callback registration, return or assignment — only
+            # direct ``name()`` calls) runs on the defining thread, so it
+            # analyzes under the locks provably held at BOTH its
+            # definition site and every direct call site (analysis is
+            # deferred to finish(), once every call site's held set has
+            # been seen — a def inside ``with lock:`` that is only
+            # CALLED after the block must NOT analyze as lock-held).
+            # This is what resolves ``Condition(lock)`` aliasing one
+            # call level deeper: the ``while not changed(): cv.wait()``
+            # predicate idiom defines AND calls ``changed`` inside
+            # ``with self._cond:``, and the predicate (plus any private
+            # helper it calls) analyzes as holding the condition's
+            # underlying lock.  An escaping nested def still analyzes
+            # with an empty held set (it runs later, on an unknown
+            # thread).
+            inherits = (self.root is not None
+                        and not stmt.decorator_list
+                        and not _nested_escapes(self.root, stmt.name))
+            if inherits:
+                self.shared["defs"].append(
+                    (stmt, held, f"{self.method}.<{stmt.name}>"))
+                self.shared["call_held"].setdefault(stmt.name, None)
+            else:
+                nested = _MethodWalker(
+                    self.cls, f"{self.method}.<{stmt.name}>",
+                    in_init=False, root=self.root, shared=self.shared)
+                nested.walk(stmt.body, frozenset())
             return held
         if isinstance(stmt, ast.ClassDef):
             return held          # nested classes are opaque (callgraph.py)
@@ -322,10 +388,49 @@ class _MethodWalker:
         if isinstance(target, ast.Attribute):
             self._scan_expr(target.value, held)
 
+    def finish(self):
+        """Analyze the deferred non-escaping nested defs.  Each runs
+        under ``def-site held ∩ (∩ call-site helds)`` — never called
+        directly means no provable context, so an empty set.  The queue
+        drains with a cursor because a nested body may register deeper
+        nested defs of its own.
+
+        Order matters: a deferred def called from another deferred
+        def's body (``def a(): ...`` / ``def b(): return a()``) must be
+        analyzed AFTER its caller, or the call site inside the caller
+        has not been recorded yet and the callee falsely analyzes bare.
+        At each step, pick a remaining def not called by any other
+        remaining def (callers drain first; mutual recursion falls back
+        to definition order)."""
+        done = 0
+        defs = self.shared["defs"]
+        while done < len(defs):
+            remaining = defs[done:]
+            pick = 0
+            for j, (stmt_j, _, _) in enumerate(remaining):
+                if not any(k != j and _calls_name(stmt_k, stmt_j.name)
+                           for k, (stmt_k, _, _) in enumerate(remaining)):
+                    pick = j
+                    break
+            defs[done], defs[done + pick] = defs[done + pick], defs[done]
+            stmt, def_held, label = defs[done]
+            done += 1
+            call_held = self.shared["call_held"].get(stmt.name)
+            effective = def_held & call_held if call_held is not None \
+                else frozenset()
+            nested = _MethodWalker(self.cls, label, in_init=False,
+                                   root=self.root, shared=self.shared)
+            nested.walk(stmt.body, effective)
+
     def _scan_expr(self, node: ast.expr, held: FrozenSet[str],
                    skip_attrs: Set[str] = frozenset()):
         if isinstance(node, ast.Call):
             fn = node.func
+            if isinstance(fn, ast.Name) \
+                    and fn.id in self.shared["call_held"]:
+                prev = self.shared["call_held"][fn.id]
+                self.shared["call_held"][fn.id] = \
+                    held if prev is None else (prev & held)
             handled_fn = False
             if isinstance(fn, ast.Attribute):
                 if isinstance(fn.value, ast.Name) and fn.value.id == "self":
@@ -508,8 +613,9 @@ class _ClassCheck:
             return []
         for mname, (cls_name, fn) in merged.methods.items():
             walker = _MethodWalker(merged, mname,
-                                   in_init=(mname == "__init__"))
+                                   in_init=(mname == "__init__"), root=fn)
             walker.walk(fn.body, frozenset())
+            walker.finish()
         _collect_init_facts(merged)
         # handler-table / executor registrations in __init__ spawn their
         # thread at construction (e.g. an RPC server starting its serve

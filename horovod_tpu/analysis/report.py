@@ -6,6 +6,15 @@ import dataclasses
 import re
 from typing import Dict, Iterable, List, Set, Tuple
 
+#: Analyzer-generation token.  Bump on ANY rule-engine change that can
+#: alter what a given source revision produces (new rules, changed
+#: inference, changed messages): the AST/finding cache and the baseline
+#: fingerprints are keyed on it, so a stale cache entry or an outdated
+#: baseline can never silently mask (or resurrect) findings across an
+#: analyzer upgrade.  v3 = schedule extractor + divergence dataflow
+#: engine (HVD200–HVD215) + nested-def held-set inheritance.
+ANALYZER_VERSION = 3
+
 # code -> (title, default fix-it).  The fix-it is the actionable half of
 # every message: what to change so the job cannot deadlock/diverge.
 RULES: Dict[str, Tuple[str, str]] = {
@@ -86,6 +95,53 @@ RULES: Dict[str, Tuple[str, str]] = {
         "pick ONE lock to guard this attribute and hold it at every "
         "access site; two locks each covering part of the accesses "
         "exclude nothing"),
+    "HVD200": (
+        "collective guarded by rank-divergent control flow",
+        "hoist the collective out of the branch — the condition "
+        "(rank, env var, clock, hostname, unseeded RNG) can evaluate "
+        "differently per process, so some ranks never submit it and the "
+        "rest deadlock; if every rank must agree, broadcast the decision "
+        "from rank 0 first"),
+    "HVD201": (
+        "collective operand whose shape can diverge across ranks",
+        "make the operand shape rank-invariant (pad to a fixed size, or "
+        "broadcast the size from rank 0) — reductions require "
+        "identically-shaped operands on every rank, and a shape built "
+        "from rank/env/RNG mismatches the fused buffer layout"),
+    "HVD202": (
+        "collective after a rank-divergent early exit",
+        "move the divergent return/raise below the collective (or make "
+        "every rank take the same path) — ranks that exited early never "
+        "submit the collective and the rest block forever"),
+    "HVD203": (
+        "rank-divergent value published under a shared control-plane key",
+        "publish per-rank values under rank-qualified keys, or broadcast "
+        "the value from rank 0 before publishing — a shared key written "
+        "with different values per rank leaves the control plane in a "
+        "last-writer-wins state the ranks don't agree on"),
+    "HVD204": (
+        "rank-divergent collective parameter",
+        "pass the same name/root_rank/op/process_set on every rank — "
+        "negotiation matches collectives by these fields, and a "
+        "per-rank value (e.g. root_rank=hvd.rank()) pairs incompatible "
+        "requests or broadcasts from N different roots"),
+    "HVD205": (
+        "collective inside a loop with a rank-divergent trip count",
+        "make the loop bound identical on every rank (broadcast it from "
+        "rank 0) — a rank iterating fewer times submits fewer "
+        "collectives, and the peers deadlock on the missing ones"),
+    "HVD210": (
+        "collective schedule differs across configurations",
+        "make the step function's collective sequence independent of "
+        "rank and mesh size — every replica must issue the same "
+        "collectives in the same order, or the compiled programs "
+        "deadlock against each other (see tools/hvdsched --consistency)"),
+    "HVD211": (
+        "collective schedule drifted from its committed snapshot",
+        "if the change is intentional, re-record with tools/hvdsched "
+        "--update and commit the snapshot diff for review; otherwise the "
+        "fusion plan changed by accident and multi-host jobs may "
+        "diverge"),
 }
 
 

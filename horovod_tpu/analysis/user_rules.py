@@ -311,16 +311,29 @@ class UserScriptChecker:
             self._scan_expr(stmt.test, ctx)
             rank = self._is_rank_expr(stmt.test)
             sub = ctx.replace(rank_line=stmt.lineno) if rank else ctx
+            loop = isinstance(stmt, ast.While)
+            saved_loop_exit = (ctx.func.get("divergent_loop")
+                               if loop and ctx.func is not None else None)
             self._walk_stmts(stmt.body, sub)
             self._walk_stmts(stmt.orelse, sub)
+            if loop and ctx.func is not None:
+                # break/continue inside this while exit THIS loop only:
+                # code after it is reached by every rank
+                ctx.func["divergent_loop"] = saved_loop_exit
             if rank and ctx.func is not None \
                     and ctx.func["divergent"] is None:
                 # a rank-conditional branch that can leave the function
-                # makes everything after it rank-divergent (HVD003)
-                terminal = (ast.Return, ast.Raise, ast.Break, ast.Continue)
-                if any(isinstance(s, terminal)
+                # makes everything after it rank-divergent (HVD003); one
+                # that can only leave the LOOP ITERATION (break/continue)
+                # taints the rest of the enclosing loop body, never the
+                # code after the loop
+                if any(isinstance(s, (ast.Return, ast.Raise))
                        for s in stmt.body + stmt.orelse):
                     ctx.func["divergent"] = stmt.lineno
+                elif not loop and ctx.func.get("divergent_loop") is None \
+                        and any(isinstance(s, (ast.Break, ast.Continue))
+                                for s in stmt.body + stmt.orelse):
+                    ctx.func["divergent_loop"] = stmt.lineno
             return
         if isinstance(stmt, ast.Try):
             self._walk_stmts(stmt.body, ctx)
@@ -332,8 +345,12 @@ class UserScriptChecker:
             return
         if isinstance(stmt, (ast.For, ast.AsyncFor)):
             self._scan_expr(stmt.iter, ctx)
+            saved_loop_exit = (ctx.func.get("divergent_loop")
+                               if ctx.func is not None else None)
             self._walk_stmts(stmt.body, ctx)
             self._walk_stmts(stmt.orelse, ctx)
+            if ctx.func is not None:
+                ctx.func["divergent_loop"] = saved_loop_exit
             return
         if isinstance(stmt, (ast.With, ast.AsyncWith)):
             for item in stmt.items:
@@ -428,11 +445,11 @@ class UserScriptChecker:
                       f"collective '{coll}' inside an except handler "
                       f"(line {ctx.except_line}); an exception raised on a "
                       f"subset of ranks strands the rest")
-        elif ctx.func is not None and ctx.func["divergent"] is not None:
+        elif ctx.func is not None and self._divergent_line(ctx) is not None:
             self._add("HVD003", call,
                       f"collective '{coll}' after a rank-conditional "
-                      f"early exit (line {ctx.func['divergent']}); only the "
-                      f"ranks that did not exit reach this call")
+                      f"early exit (line {self._divergent_line(ctx)}); only "
+                      f"the ranks that did not exit reach this call")
         if ctx.in_jit:
             self._add("HVD006", call,
                       f"eager collective '{coll}' inside a jit/shard_map-"
@@ -446,6 +463,18 @@ class UserScriptChecker:
                       f"unordered set iteration; member order can differ "
                       f"across processes, diverging the fusion plan")
         self._check_hvd005(call, COLLECTIVES[coll])
+
+    @staticmethod
+    def _divergent_line(ctx: _Ctx):
+        """Line of the rank-divergent exit governing this point: a
+        function-leaving one (return/raise — taints the rest of the
+        function), else a loop-iteration-leaving one (break/continue —
+        taints only the rest of the enclosing loop body)."""
+        if ctx.func is None:
+            return None
+        if ctx.func["divergent"] is not None:
+            return ctx.func["divergent"]
+        return ctx.func.get("divergent_loop")
 
     def _check_helper_call(self, call: ast.Call, name: str, ctx: _Ctx):
         """HVD001/003/006 through one helper level: ``name`` is a
@@ -464,12 +493,12 @@ class UserScriptChecker:
                       f"collective submitted {via}, inside an except "
                       f"handler (line {ctx.except_line}); an exception "
                       f"raised on a subset of ranks strands the rest")
-        elif ctx.func is not None and ctx.func["divergent"] is not None:
+        elif ctx.func is not None and self._divergent_line(ctx) is not None:
             self._add("HVD003", call,
                       f"collective submitted {via}, after a "
                       f"rank-conditional early exit (line "
-                      f"{ctx.func['divergent']}); only the ranks that did "
-                      f"not exit reach this call")
+                      f"{self._divergent_line(ctx)}); only the ranks that "
+                      f"did not exit reach this call")
         if ctx.in_jit:
             self._add("HVD006", call,
                       f"eager collective submitted {via}, inside a "

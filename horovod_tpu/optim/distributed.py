@@ -30,6 +30,17 @@ from ..compression import Compression
 from ..runtime import ReduceOp
 
 
+def _axis_size(axis_name: str):
+    """Static size of a named mapped axis at trace time.
+
+    ``jax.lax.axis_size`` only exists on newer jax; on 0.4.x
+    ``jax.core.axis_frame(name)`` returns the size directly.  Both are
+    trace-time constants, so the jaxpr is identical either way."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.core.axis_frame(axis_name)
+
+
 def _tree_leaves_sorted(tree):
     """Leaves with deterministic path-sorted order (the controller's total
     order on tensor names, applied at trace time)."""
@@ -49,6 +60,13 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
     The in-jit analog of the reference's fusion buffer: leaves are bucketed
     by dtype in deterministic order up to ``threshold_bytes``
     (HOROVOD_FUSION_THRESHOLD), each bucket reduced with one ``psum``.
+
+    The buckets come from the SAME planner the eager engine uses
+    (``ops/fusion.py`` ``plan_fusion``) — one bucketing algorithm, one
+    cross-process ordering contract — and each bucket's collective is
+    traced under a ``jax.named_scope("hvd_bucket<i>")`` so the static
+    schedule extractor (``tools/hvdsched``, ``analysis/schedule.py``) can
+    attribute every ``psum`` in the jaxpr to its fusion bucket.
     """
     if threshold_bytes is None:
         cfg = runtime._state().config
@@ -76,40 +94,40 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
         return jax.tree_util.tree_unflatten(
             treedef, _restore_order(out, grads))
 
-    buckets = []
-    cur, cur_dtype, cur_bytes = [], None, 0
-    for i in order:
-        leaf = leaves[i]
-        nb = leaf.size * leaf.dtype.itemsize
-        if leaf.dtype != cur_dtype or (cur_bytes + nb > threshold_bytes
-                                       and cur):
-            if cur:
-                buckets.append(cur)
-            cur, cur_dtype, cur_bytes = [], leaf.dtype, 0
-        cur.append(i)
-        cur_bytes += nb
-    if cur:
-        buckets.append(cur)
+    # One planner for both worlds: leaves become EntrySigs (name = the
+    # sorted pytree path, the controller's total order) and the eager
+    # engine's plan_fusion decides the buckets.  Within one dtype the
+    # path-sorted leaf order IS the planner's name order, so this is the
+    # plan every process computes.
+    from ..ops.fusion import EntrySig, plan_fusion
+    sigs = [EntrySig(name=_names[i], op_type="allreduce",
+                     reduce_op=str(op), dtype=str(leaves[i].dtype),
+                     shape=tuple(leaves[i].shape), process_set_id=0,
+                     stacked=False, prescale=prescale_factor,
+                     postscale=postscale_factor)
+            for i in range(len(leaves))]
+    buckets = plan_fusion(sigs, threshold_bytes)
 
     out = [None] * len(leaves)
-    for bucket in buckets:
-        parts = [leaves[i].reshape(-1) for i in bucket]
-        buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
-        if prescale_factor != 1.0:
-            buf = buf * jnp.asarray(prescale_factor, buf.dtype)
-        wire, ctx = compression.compress(buf)
-        red = jax.lax.psum(wire, axis_name)
-        red = compression.decompress(red, ctx)
-        if op == ReduceOp.AVERAGE:
-            red = red / jax.lax.axis_size(axis_name)
-        if postscale_factor != 1.0:
-            red = red * jnp.asarray(postscale_factor, red.dtype)
-        off = 0
-        for i in bucket:
-            sz = leaves[i].size
-            out[i] = jax.lax.slice_in_dim(red, off, off + sz).reshape(
-                leaves[i].shape)
-            off += sz
+    for bucket_id, bucket in enumerate(buckets):
+        with jax.named_scope(f"hvd_bucket{bucket_id}"):
+            parts = [leaves[i].reshape(-1) for i in bucket]
+            buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+            if prescale_factor != 1.0:
+                buf = buf * jnp.asarray(prescale_factor, buf.dtype)
+            wire, ctx = compression.compress(buf)
+            red = jax.lax.psum(wire, axis_name)
+            red = compression.decompress(red, ctx)
+            if op == ReduceOp.AVERAGE:
+                red = red / _axis_size(axis_name)
+            if postscale_factor != 1.0:
+                red = red * jnp.asarray(postscale_factor, red.dtype)
+            off = 0
+            for i in bucket:
+                sz = leaves[i].size
+                out[i] = jax.lax.slice_in_dim(red, off, off + sz).reshape(
+                    leaves[i].shape)
+                off += sz
     # out is in path-sorted leaf order; restore original leaf order
     flat_sorted_to_orig = _restore_order(out, grads)
     return jax.tree_util.tree_unflatten(treedef, flat_sorted_to_orig)

@@ -176,13 +176,13 @@ class KvStore:
 
     def _dir_changed(self, prefix: str, since: int,
                      skip: Optional[str]) -> bool:
-        # caller holds self._lock (dir_watch's Condition wraps it; one
-        # interprocedural level past the guarded-by detector's horizon)
+        # caller holds self._lock (dir_watch's wait predicate runs under
+        # its Condition, which wraps the lock)
         if skip is None:
-            return self._dir_ver.get(prefix, 0) > since  # hvdlint: disable=HVD113
-        if self._tomb_ver.get(prefix, 0) > since:  # hvdlint: disable=HVD113
+            return self._dir_ver.get(prefix, 0) > since
+        if self._tomb_ver.get(prefix, 0) > since:
             return True
-        return any(v > since for k, v in self._key_ver.items()  # hvdlint: disable=HVD113
+        return any(v > since for k, v in self._key_ver.items()
                    if k.startswith(prefix) and k != skip)
 
     # -- mutation ------------------------------------------------------------
@@ -253,9 +253,9 @@ class KvStore:
                 # mutation, so it must be O(1): live-key counts come
                 # from _dir_count, not a store scan)
                 if min_entries is not None:
-                    n = self._dir_count.get(prefix, 0)  # hvdlint: disable=HVD113
+                    n = self._dir_count.get(prefix, 0)
                     if (skip is not None and skip.startswith(prefix)
-                            and skip in self._data):  # hvdlint: disable=HVD113
+                            and skip in self._data):
                         n -= 1
                     if n >= min_entries:
                         return True

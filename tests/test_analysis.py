@@ -481,11 +481,13 @@ def test_antipatterns_fixture_trips_every_user_rule():
     # skip-file honored by default (CI stage 8 stays green) ...
     assert analyze_paths([path]) == []
     # ... and every documented antipattern fires under --include-skipped,
-    # including the RacyMetricsSink guarded-by fixture
+    # including the RacyMetricsSink guarded-by fixture and the
+    # HVD200–HVD205 divergence dataflow fixtures
     found = [f.code for f in analyze_paths([path], include_skipped=True)]
     assert sorted(set(found)) == [
         "HVD001", "HVD002", "HVD003", "HVD004", "HVD005", "HVD006",
-        "HVD110", "HVD111", "HVD113", "HVD114"]
+        "HVD110", "HVD111", "HVD113", "HVD114",
+        "HVD200", "HVD201", "HVD202", "HVD203", "HVD204", "HVD205"]
 
 
 def test_cli_json_output_and_exit_codes():
@@ -705,8 +707,10 @@ def step(g):
 
 
 def test_helper_call_outside_hazard_context_is_clean():
-    # the helper itself is fine, and an unconditional call site is fine;
-    # only ONE level is expanded (a helper-of-a-helper stays silent)
+    # the helper itself is fine, and an unconditional call site is fine.
+    # The syntactic user engine expands only ONE level (a helper-of-a-
+    # helper stays silent there); the divergence engine's fixed-point
+    # summaries see the full chain and report the deep case as HVD200.
     src = """
 import horovod_tpu as hvd
 def log_metrics(x):
@@ -717,7 +721,8 @@ log_metrics(m)
 if hvd.rank() == 0:
     indirect(m)
 """
-    assert codes(src) == []
+    assert codes(src, engines=("user",)) == []
+    assert codes(src) == ["HVD200"]
 
 
 def test_helper_factory_defining_closure_is_not_a_helper():

@@ -1,0 +1,544 @@
+"""hvdlint divergence dataflow engine (HVD200–HVD205, analysis/divergence.py).
+
+All CPU-only, no jax import needed by the engine itself: pure AST
+dataflow.  Covers taint propagation (sources, helpers, implicit flow),
+the broadcast sanitizer, shape-taint structure, every rule's positive
+AND the quiet-direction negatives, suppressions, and the framework-wide
+clean pin that backs CI stage 8.
+"""
+
+import os
+
+from horovod_tpu.analysis import analyze_source
+from horovod_tpu.analysis.cli import analyze_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(src, engines=("divergence",), **kw):
+    return [f.code for f in analyze_source(src, "fixture.py",
+                                           engines=engines, **kw)]
+
+
+def messages(src, engines=("divergence",), **kw):
+    return [f.message for f in analyze_source(src, "fixture.py",
+                                              engines=engines, **kw)]
+
+
+HDR = "import horovod_tpu as hvd\n"
+
+
+# ---------------------------------------------------------------------------
+# HVD200: divergent-branch collectives, interprocedural
+# ---------------------------------------------------------------------------
+
+def test_hvd200_two_helper_levels():
+    src = HDR + """
+def _reduce(x):
+    return hvd.allreduce(x, name="s")
+def _log(x):
+    return _reduce(x)
+def train(x):
+    if hvd.rank() == 0:
+        return _log(x)
+    return x
+"""
+    assert codes(src) == ["HVD200"]
+    (msg,) = messages(src)
+    assert "via helper '_log'" in msg and "the process rank" in msg
+
+
+def test_hvd200_three_helper_levels_fixed_point():
+    src = HDR + """
+def _a(x): return hvd.allreduce(x, name="s")
+def _b(x): return _a(x)
+def _c(x): return _b(x)
+def train(x):
+    if hvd.rank() == 0:
+        _c(x)
+"""
+    assert codes(src) == ["HVD200"]
+
+
+def test_hvd200_env_var_branch():
+    src = HDR + """
+import os
+def train(x):
+    if os.environ.get("DEBUG"):
+        return hvd.allreduce(x, name="s")
+"""
+    assert codes(src) == ["HVD200"]
+    assert "an environment variable" in messages(src)[0]
+
+
+def test_hvd200_divergent_returning_helper_guard():
+    # the CONDITION comes from a helper that returns rank()
+    src = HDR + """
+def my_id():
+    return hvd.rank()
+def train(x):
+    if my_id() == 0:
+        hvd.allreduce(x, name="s")
+"""
+    assert codes(src) == ["HVD200"]
+
+
+def test_hvd200_method_helper_resolved_via_callgraph():
+    src = HDR + """
+class Trainer:
+    def _reduce(self, x):
+        return hvd.allreduce(x, name="s")
+    def step(self, x):
+        if hvd.rank() == 0:
+            return self._reduce(x)
+"""
+    assert codes(src) == ["HVD200"]
+
+
+def test_hvd200_unseeded_rng_and_time_sources():
+    src = HDR + """
+import random, time
+def a(x):
+    if random.random() > 0.5:
+        hvd.barrier()
+def b(x):
+    if time.time() > 0:
+        hvd.barrier()
+"""
+    assert codes(src) == ["HVD200", "HVD200"]
+
+
+def test_hvd200_hostname_source_via_alias():
+    src = HDR + """
+import socket as sk
+def f(x):
+    host = sk.gethostname()
+    if host == "worker-0":
+        hvd.allreduce(x, name="s")
+"""
+    assert codes(src) == ["HVD200"]
+    assert "the hostname" in messages(src)[0]
+
+
+def test_hvd200_implicit_flow_through_flag():
+    # the flag is ASSIGNED under a divergent branch: implicit flow
+    src = HDR + """
+def f(x):
+    lead = False
+    if hvd.rank() == 0:
+        lead = True
+    if lead:
+        hvd.allreduce(x, name="s")
+"""
+    assert codes(src) == ["HVD200"]
+
+
+def test_hvd200_direct_rank_branch_dedupes_to_hvd001():
+    # one bug, one finding: the specific syntactic rule wins on the line
+    src = HDR + """
+def f(x):
+    if hvd.rank() == 0:
+        hvd.allreduce(x, name="s")
+"""
+    assert codes(src, engines=("user", "divergence")) == ["HVD001"]
+    assert codes(src) == ["HVD200"]        # alone, the engine still reports
+
+
+def test_hvd200_negative_unconditional_helper_chain():
+    src = HDR + """
+def _reduce(x): return hvd.allreduce(x, name="s")
+def _log(x): return _reduce(x)
+def train(x):
+    return _log(x)
+"""
+    assert codes(src) == []
+
+
+def test_hvd200_negative_clean_condition():
+    src = HDR + """
+def f(x, debug):
+    if debug:
+        hvd.allreduce(x, name="s")
+"""
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# sanitizers
+# ---------------------------------------------------------------------------
+
+def test_broadcast_object_sanitizes_rank():
+    src = HDR + """
+def f(x):
+    n = hvd.broadcast_object(hvd.rank())
+    if n == 0:
+        hvd.allreduce(x, name="s")
+"""
+    assert codes(src) == []
+
+
+def test_allreduce_sanitizes_shape_source():
+    # the steps-agreement idiom: allreduce(Min) of a local count is clean
+    src = HDR + """
+def f(x):
+    steps = int(hvd.allreduce(len(x[hvd.rank():]), op=hvd.Min, name="n"))
+    for _ in range(steps):
+        hvd.allreduce(x, name="s")
+"""
+    assert codes(src) == []
+
+
+def test_reassignment_clears_taint():
+    src = HDR + """
+def f(x):
+    n = hvd.rank()
+    n = 3
+    if n:
+        hvd.allreduce(x, name="s")
+"""
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD201: shape-divergent operands
+# ---------------------------------------------------------------------------
+
+def test_hvd201_divergent_slice_bound():
+    src = HDR + """
+def f(x):
+    n = hvd.rank() + 1
+    return hvd.allreduce(x[:n], name="s")
+"""
+    assert codes(src) == ["HVD201"]
+
+
+def test_hvd201_divergent_ctor_dimension():
+    src = HDR + """
+import numpy as np
+def f():
+    return hvd.allreduce(np.zeros(hvd.rank() + 1), name="s")
+"""
+    assert codes(src) == ["HVD201"]
+
+
+def test_hvd201_taint_through_assignment_chain():
+    src = HDR + """
+def f(x):
+    n = hvd.rank()
+    shard = x[n:]
+    doubled = shard * 2
+    return hvd.allreduce(doubled, name="s")
+"""
+    assert codes(src) == ["HVD201"]
+
+
+def test_hvd201_negative_allgather_ragged_is_legal():
+    # the eager allgather exchanges sizes; ragged dim0 is supported
+    src = HDR + """
+def f(x):
+    n = hvd.rank() + 1
+    return hvd.allgather(x[:n], name="g")
+"""
+    assert codes(src) == []
+
+
+def test_hvd201_negative_fill_value_is_data_not_shape():
+    src = HDR + """
+import numpy as np
+def f():
+    return hvd.allreduce(np.full((4,), float(hvd.rank())), name="s")
+"""
+    assert codes(src) == []
+
+
+def test_hvd201_negative_scalar_measurement_of_shard():
+    # len()/float() collapse the shape; a scalar operand cannot mismatch
+    src = HDR + """
+def f(x):
+    shard = x[hvd.rank():]
+    return hvd.allreduce(float(len(shard)), op=hvd.Sum, name="n")
+"""
+    assert codes(src) == []
+
+
+def test_hvd201_negative_batch_window_idiom():
+    # x[i:i+batch] has extent `batch` regardless of the (divergent) i
+    src = HDR + """
+def f(x, batch):
+    i = hvd.rank() * batch
+    return hvd.allreduce(x[i:i + batch], name="s")
+"""
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD202: divergent early exits
+# ---------------------------------------------------------------------------
+
+def test_hvd202_time_guarded_early_return():
+    src = HDR + """
+import time
+def f(x):
+    if time.time() % 2 > 1:
+        return None
+    return hvd.allreduce(x, name="s")
+"""
+    assert codes(src) == ["HVD202"]
+
+
+def test_hvd202_through_helper():
+    src = HDR + """
+import os
+def _sync(x):
+    return hvd.allreduce(x, name="s")
+def f(x):
+    if os.getenv("SKIP"):
+        return None
+    return _sync(x)
+"""
+    assert codes(src) == ["HVD202"]
+
+
+def test_hvd202_rank_early_return_dedupes_to_hvd003():
+    src = HDR + """
+def f(x):
+    if hvd.rank() != 0:
+        return None
+    return hvd.allreduce(x, name="s")
+"""
+    assert codes(src, engines=("user", "divergence")) == ["HVD003"]
+
+
+def test_hvd202_negative_exit_after_collective():
+    src = HDR + """
+import time
+def f(x):
+    y = hvd.allreduce(x, name="s")
+    if time.time() % 2 > 1:
+        return None
+    return y
+"""
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD203: divergent control-plane publishes
+# ---------------------------------------------------------------------------
+
+def test_hvd203_shared_key_divergent_value():
+    src = HDR + """
+import socket
+def f(kv):
+    kv.set("job/leader", socket.gethostname())
+"""
+    assert codes(src) == ["HVD203"]
+
+
+def test_hvd203_negative_rank_qualified_key():
+    src = HDR + """
+import socket
+def f(kv):
+    kv.set("job/host/%d" % hvd.rank(), socket.gethostname())
+"""
+    assert codes(src) == []
+
+
+def test_hvd203_negative_clean_value():
+    src = HDR + """
+def f(kv, cfg):
+    kv.set("job/config", cfg)
+"""
+    assert codes(src) == []
+
+
+def test_hvd203_non_store_receiver_is_silent():
+    src = HDR + """
+import socket
+def f(cache):
+    cache.set("k", socket.gethostname())
+"""
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD204 / HVD205
+# ---------------------------------------------------------------------------
+
+def test_hvd204_divergent_root_rank():
+    src = HDR + """
+def f(x):
+    return hvd.broadcast(x, hvd.rank())
+"""
+    assert codes(src) == ["HVD204"]
+
+
+def test_hvd204_divergent_name_kwarg():
+    src = HDR + """
+def f(x):
+    return hvd.allreduce(x, name="t%d" % hvd.rank())
+"""
+    assert codes(src) == ["HVD204"]
+
+
+def test_hvd204_negative_constant_root():
+    src = HDR + """
+def f(x):
+    return hvd.broadcast(x, 0)
+"""
+    assert codes(src) == []
+
+
+def test_hvd205_divergent_range_loop():
+    src = HDR + """
+def f(x):
+    for _ in range(hvd.rank()):
+        hvd.barrier()
+"""
+    assert codes(src) == ["HVD205"]
+
+
+def test_hvd205_divergent_while_loop_via_helper():
+    src = HDR + """
+import os
+def _sync():
+    hvd.barrier()
+def f():
+    n = int(os.environ.get("N", "0"))
+    while n > 0:
+        _sync()
+        n -= 1
+"""
+    assert codes(src) == ["HVD205"]
+
+
+def test_hvd205_negative_size_bound_loop():
+    # size() is identical on every rank: not a divergent source
+    src = HDR + """
+def f(x):
+    for _ in range(hvd.size()):
+        hvd.barrier()
+"""
+    assert codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# engine plumbing: suppressions, select, tuple assigns, module scope
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression_applies():
+    src = HDR + """
+def f(x):
+    if hvd.rank() == 0:
+        hvd.allreduce(x, name="s")  # hvdlint: disable=HVD200
+"""
+    assert codes(src) == []
+
+
+def test_select_range_includes_new_rules():
+    from horovod_tpu.analysis.cli import expand_select
+    got, unknown = expand_select("HVD200-HVD205")
+    assert unknown == []
+    assert got == ["HVD200", "HVD201", "HVD202", "HVD203", "HVD204",
+                   "HVD205"]
+
+
+def test_zipped_tuple_assign_taints_elementwise():
+    src = HDR + """
+def f(x):
+    r, n = hvd.rank(), hvd.size()
+    if n > 1:
+        hvd.allreduce(x, name="s")
+"""
+    assert codes(src) == []
+
+
+def test_module_level_rank_var_seeds_functions():
+    src = HDR + """
+R = hvd.rank()
+def f(x):
+    if R == 0:
+        _pub(x)
+def _pub(x):
+    hvd.allgather(x, name="g")
+"""
+    assert codes(src) == ["HVD200"]
+
+
+def test_factory_closure_is_not_a_submission():
+    # defining a collective-bearing closure under a rank branch submits
+    # nothing (same contract as the user rules' helper expansion)
+    src = HDR + """
+def f(x):
+    if hvd.rank() == 0:
+        def closure():
+            return hvd.allreduce(x, name="s")
+        return closure
+"""
+    assert codes(src) == []
+
+
+def test_explain_knows_new_rules():
+    from horovod_tpu.analysis.cli import explain_rule
+    for code in ("HVD200", "HVD203", "HVD210", "HVD211"):
+        text = explain_rule(code)
+        assert not text.startswith("unknown rule code"), code
+        assert code in text
+
+
+# ---------------------------------------------------------------------------
+# fixture pins (the framework-wide clean pin lives in test_analysis.py's
+# test_full_lint_clean_on_framework_and_examples, which runs all engines)
+# ---------------------------------------------------------------------------
+
+def test_antipatterns_divergence_fixtures_fire_once_each():
+    path = os.path.join(REPO, "examples", "antipatterns.py")
+    found = [f.code for f in analyze_paths([path], include_skipped=True,
+                                           engines=("user", "divergence"))]
+    for code in ("HVD200", "HVD201", "HVD202", "HVD203", "HVD204",
+                 "HVD205"):
+        assert found.count(code) == 1, (code, found)
+
+
+def test_hvd202_negative_post_loop_after_divergent_continue():
+    # review regression: break/continue exit the LOOP, not the function —
+    # every rank reaches the collective after the loop, so flagging it
+    # violates the engine's err-toward-silence contract
+    src = HDR + """
+def f(xs):
+    for x in xs:
+        if hvd.rank() == 0:
+            continue
+        work(x)
+    return hvd.allreduce(xs, name="a")
+"""
+    assert codes(src) == []
+    assert codes(src.replace("continue", "break")) == []
+    # the pre-existing user rule had the same bug: stays silent too
+    assert codes(src, engines=("user", "divergence")) == []
+
+
+def test_hvd202_in_loop_after_divergent_continue_still_flagged():
+    # ... but a collective later in the SAME loop body is genuinely
+    # skipped on the ranks that took the divergent continue
+    src = HDR + """
+import os
+def f(xs):
+    for x in xs:
+        if os.getenv("SKIP"):
+            continue
+        hvd.allreduce(x, name="a")
+"""
+    assert "HVD202" in codes(src)
+
+
+def test_hvd202_divergent_return_in_loop_still_taints_post_loop():
+    src = HDR + """
+def f(xs):
+    for x in xs:
+        if hvd.rank() == 0:
+            return None
+    return hvd.allreduce(xs, name="a")
+"""
+    assert codes(src) == ["HVD202"]
+    # ... and dedupes to the user rule's HVD003 when both engines run
+    assert codes(src, engines=("user", "divergence")) == ["HVD003"]

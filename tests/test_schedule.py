@@ -1,0 +1,409 @@
+"""hvdsched: jaxpr collective-schedule extraction (analysis/schedule.py).
+
+All CPU-only: tracing uses ``jax.make_jaxpr`` with an ``axis_env`` —
+no devices, no mesh, no shard_map.  Covers the jaxpr walk (top level,
+pjit, scan, cond, while, nesting), record fields (axes, avals, bucket
+ids from named_scope), JSON snapshot roundtrip + drift detection
+(HVD211), the cross-configuration consistency rule (HVD210), the
+fusion-plan unification in fused_reduce_tree, and the CLI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from horovod_tpu.analysis import schedule as sched_mod
+from horovod_tpu.analysis.schedule import (
+    BUILTIN_ENTRIES, CollectiveRecord, Schedule, builtin_schedule,
+    check_builtin_consistency, check_builtin_snapshots, check_consistency,
+    check_snapshot, diff_schedules, snapshot_path, trace_schedule)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+AX = [("workers", 2)]
+
+
+def _x(n=4):
+    return jax.ShapeDtypeStruct((n,), jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# the jaxpr walk
+# ---------------------------------------------------------------------------
+
+def test_top_level_psum_record_fields():
+    def step(x):
+        return jax.lax.psum(x, "workers")
+
+    s = trace_schedule(step, (_x(),), axis_env=AX, entry="t")
+    assert [r.prim for r in s.records] == ["psum"]
+    r = s.records[0]
+    assert r.axes == ["workers"]
+    assert r.inputs == ["float32[4]"] and r.outputs == ["float32[4]"]
+    assert r.path == "" and r.index == 0 and r.bucket is None
+
+
+def test_multiple_collective_prims_in_order():
+    def step(x):
+        a = jax.lax.psum(x, "workers")
+        b = jax.lax.all_gather(x, "workers")
+        c = jax.lax.ppermute(x, "workers", [(0, 1), (1, 0)])
+        d = jax.lax.psum_scatter(x, "workers", tiled=True)
+        return a, b, c, d
+
+    s = trace_schedule(step, (_x(),), axis_env=AX, entry="t")
+    assert [r.prim for r in s.records] == [
+        "psum", "all_gather", "ppermute", "reduce_scatter"]
+    ag = s.records[1]
+    assert ag.outputs == ["float32[2x4]"]       # axis_size stacks in
+    pp = s.records[2]
+    assert pp.params["perm"] == [[0, 1], [1, 0]]
+    rs = s.records[3]
+    assert rs.params["tiled"] is True and rs.outputs == ["float32[2]"]
+
+
+def test_walk_descends_into_scan():
+    def step(x):
+        def body(c, t):
+            s = jax.lax.psum(t, "workers")
+            return c + s.sum(), s
+        return jax.lax.scan(body, 0.0, jnp.zeros((3, 4)))
+
+    s = trace_schedule(step, (_x(),), axis_env=AX, entry="t")
+    assert len(s.records) == 1
+    assert s.records[0].path == "scan:jaxpr"
+
+
+def test_walk_descends_into_cond_branches():
+    def step(x):
+        return jax.lax.cond(x.sum() > 0,
+                            lambda a: jax.lax.psum(a, "workers"),
+                            lambda a: a * 2.0, x)
+
+    s = trace_schedule(step, (_x(),), axis_env=AX, entry="t")
+    assert len(s.records) == 1
+    (r,) = s.records
+    assert r.path.startswith("cond:branches[")   # branch index recorded
+
+
+def test_walk_descends_into_while_loop():
+    def step(x):
+        def cond_f(c):
+            return c[0] < 3
+        def body_f(c):
+            i, v = c
+            return i + 1, jax.lax.psum(v, "workers")
+        return jax.lax.while_loop(cond_f, body_f, (0, x))
+
+    s = trace_schedule(step, (_x(),), axis_env=AX, entry="t")
+    assert [r.path for r in s.records] == ["while:body_jaxpr"]
+
+
+def test_walk_descends_into_pjit_and_nesting():
+    @jax.jit
+    def inner(x):
+        def body(c, t):
+            return c, jax.lax.psum(t, "workers")
+        _, ys = jax.lax.scan(body, 0.0, jnp.zeros((2, 4)))
+        return ys
+
+    def step(x):
+        return inner(x)
+
+    s = trace_schedule(step, (_x(),), axis_env=AX, entry="t")
+    assert len(s.records) == 1
+    assert s.records[0].path == "pjit<inner>/scan:jaxpr"
+
+
+def test_named_scope_bucket_ids_recorded():
+    def step(x):
+        with jax.named_scope("hvd_bucket7"):
+            a = jax.lax.psum(x, "workers")
+        b = jax.lax.psum(a, "workers")
+        return b
+
+    s = trace_schedule(step, (_x(),), axis_env=AX, entry="t")
+    assert [r.bucket for r in s.records] == [7, None]
+
+
+def test_non_collective_eqns_are_ignored():
+    def step(x):
+        return (x * 2).sum() + x.max()
+
+    s = trace_schedule(step, (_x(),), axis_env=AX, entry="t")
+    assert s.records == []
+
+
+# ---------------------------------------------------------------------------
+# snapshot roundtrip, diff, HVD211
+# ---------------------------------------------------------------------------
+
+def _sched(entry="t"):
+    def step(x):
+        return jax.lax.psum(x, "workers")
+    return trace_schedule(step, (_x(),), axis_env=AX, entry=entry)
+
+
+def test_json_roundtrip_is_lossless():
+    s = _sched()
+    back = Schedule.from_json(s.to_json())
+    assert back.entry == s.entry
+    assert back.axis_env == s.axis_env
+    assert [r.as_dict() for r in back.records] == \
+        [r.as_dict() for r in s.records]
+
+
+def test_json_is_stable_across_retraces():
+    assert _sched().to_json() == _sched().to_json()
+
+
+def test_from_json_rejects_unknown_format():
+    payload = json.loads(_sched().to_json())
+    payload["format"] = 99
+    with pytest.raises(ValueError, match="format"):
+        Schedule.from_json(json.dumps(payload))
+
+
+def test_diff_schedules_empty_on_identical():
+    assert diff_schedules(_sched(), _sched()) == []
+
+
+def test_diff_schedules_reports_changed_line():
+    def other(x):
+        return jax.lax.psum(x * 2, "workers")
+    a = _sched()
+    b = trace_schedule(other, (_x(8),), axis_env=AX, entry="t")
+    diff = diff_schedules(a, b)
+    assert any(l.startswith("-") and "float32[4]" in l for l in diff)
+    assert any(l.startswith("+") and "float32[8]" in l for l in diff)
+
+
+def test_check_snapshot_roundtrip_and_drift(tmp_path):
+    path = str(tmp_path / "t.json")
+    with open(path, "w") as f:
+        f.write(_sched().to_json())
+    assert check_snapshot(path, _sched()) == []
+
+    def drifted(x):
+        a = jax.lax.psum(x, "workers")
+        return jax.lax.psum(a, "workers")
+    bad = trace_schedule(drifted, (_x(),), axis_env=AX, entry="t")
+    findings = check_snapshot(path, bad)
+    assert [f.code for f in findings] == ["HVD211"]
+    assert "drifted" in findings[0].message
+
+
+def test_check_snapshot_missing_file_is_a_finding(tmp_path):
+    findings = check_snapshot(str(tmp_path / "none.json"), _sched())
+    assert [f.code for f in findings] == ["HVD211"]
+    assert "--update" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# HVD210: cross-configuration consistency
+# ---------------------------------------------------------------------------
+
+def test_consistency_identical_across_mesh_sizes():
+    def step(x):
+        return jax.lax.all_gather(x, "workers")
+    variants = [(f"w={n}",
+                 trace_schedule(step, (_x(),), axis_env=[("workers", n)],
+                                entry="t"))
+                for n in (2, 4, 8)]
+    # shapes/axis_size differ (that's the mesh), canonical form must not
+    assert check_consistency(variants) == []
+
+
+def test_consistency_flags_mesh_dependent_schedule():
+    def make(n):
+        def step(x):
+            y = x
+            for _ in range(n):        # one psum per mesh size: WRONG
+                y = jax.lax.psum(y, "workers")
+            return y
+        return trace_schedule(step, (_x(),), axis_env=[("workers", n)],
+                              entry="t")
+    findings = check_consistency([("w=2", make(2)), ("w=3", make(3))])
+    assert [f.code for f in findings] == ["HVD210"]
+    assert "2 vs 3 collectives" in findings[0].message
+
+
+def test_consistency_flags_rank_asymmetric_toy_step():
+    # the antipatterns teaching fixture: rank 0 traces an extra psum
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    try:
+        import antipatterns
+    finally:
+        sys.path.pop(0)
+    variants = [
+        (f"rank={r}",
+         trace_schedule(antipatterns.rank_asymmetric_toy_step(r),
+                        (_x(),), axis_env=AX, entry="toy"))
+        for r in (0, 1)]
+    assert len(variants[0][1].records) == 2
+    assert len(variants[1][1].records) == 1
+    findings = check_consistency(variants)
+    assert [f.code for f in findings] == ["HVD210"]
+    assert "rank=0" in findings[0].message \
+        and "rank=1" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# the framework entries + fusion-plan unification
+# ---------------------------------------------------------------------------
+
+def test_builtin_entries_trace_with_bucket_ids():
+    s = builtin_schedule("fused_reduce")
+    assert len(s.records) >= 2                     # multi-bucket plan
+    assert [r.prim for r in s.records] == ["psum"] * len(s.records)
+    assert [r.bucket for r in s.records] == list(range(len(s.records)))
+
+
+def test_committed_snapshots_match_the_tree():
+    # CI stage 11's core guarantee, pinned in-process
+    findings = check_builtin_snapshots()
+    assert findings == [], [f.format_text() for f in findings]
+
+
+def test_builtin_consistency_across_mesh_sizes():
+    findings = check_builtin_consistency()
+    assert findings == [], [f.format_text() for f in findings]
+
+
+def test_fused_reduce_uses_the_fusion_planner():
+    # parity pin: the in-jit bucketing IS ops/fusion.plan_fusion's plan
+    from horovod_tpu.ops.fusion import EntrySig, plan_fusion
+    from horovod_tpu.optim.distributed import _tree_leaves_sorted
+
+    grads = BUILTIN_ENTRIES["fused_reduce"]()[1][0]
+    leaves, names = _tree_leaves_sorted(grads)
+    sigs = [EntrySig(name=names[i], op_type="allreduce",
+                     reduce_op="average", dtype=str(leaves[i].dtype),
+                     shape=tuple(leaves[i].shape), process_set_id=0,
+                     stacked=False, prescale=1.0, postscale=1.0)
+            for i in range(len(leaves))]
+    plan = plan_fusion(sigs, sched_mod._THRESHOLD)
+    s = builtin_schedule("fused_reduce")
+    assert len(s.records) == len(plan)
+    for record, bucket in zip(s.records, plan):
+        nelem = sum(sigs[i].nbytes // (2 if "bfloat16" in sigs[i].dtype
+                                       else 4) for i in bucket)
+        assert record.inputs[0].endswith(f"[{nelem}]")
+
+
+def test_mutating_the_fusion_plan_fails_the_check(monkeypatch):
+    # the acceptance pin: reverse the planner's bucket order and the
+    # committed snapshot check must fail with HVD211
+    from horovod_tpu.ops import fusion as fusion_mod
+    real = fusion_mod.plan_fusion
+
+    def reversed_plan(entries, threshold_bytes):
+        return list(reversed(real(entries, threshold_bytes)))
+
+    monkeypatch.setattr(fusion_mod, "plan_fusion", reversed_plan)
+    findings = check_builtin_snapshots(entries=["fused_reduce"])
+    assert [f.code for f in findings] == ["HVD211"]
+
+
+def test_threshold_change_alters_schedule():
+    monkey = sched_mod._THRESHOLD
+    try:
+        sched_mod._THRESHOLD = 1 << 30         # everything fuses per dtype
+        big = builtin_schedule("fused_reduce")
+    finally:
+        sched_mod._THRESHOLD = monkey
+    small = builtin_schedule("fused_reduce")
+    assert len(big.records) < len(small.records)
+
+
+def test_distopt_step_matches_fused_reduce_plan():
+    a = builtin_schedule("fused_reduce")
+    b = builtin_schedule("distopt_step")
+    assert [r.canonical()[:2] for r in a.records] == \
+        [r.canonical()[:2] for r in b.records]
+
+
+# ---------------------------------------------------------------------------
+# CLI (tools/hvdsched)
+# ---------------------------------------------------------------------------
+
+def _run(*args):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.schedule", *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
+
+
+def test_cli_check_green_on_committed_snapshots():
+    proc = _run("--check")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_cli_check_fails_on_drifted_snapshot(tmp_path):
+    import shutil
+    snapdir = tmp_path / "schedules"
+    shutil.copytree(os.path.join(REPO, "tests", "schedules"), snapdir)
+    path = snapshot_path("fused_reduce", str(snapdir))
+    data = json.load(open(path))
+    data["records"] = list(reversed(data["records"]))
+    with open(path, "w") as f:
+        json.dump(data, f)
+    proc = _run("--check", "--dir", str(snapdir))
+    assert proc.returncode == 1
+    assert "HVD211" in proc.stdout
+
+
+def test_cli_update_then_check_roundtrip(tmp_path):
+    snapdir = str(tmp_path / "fresh")
+    up = _run("--update", "--dir", snapdir)
+    assert up.returncode == 0, up.stdout + up.stderr
+    assert sorted(os.listdir(snapdir)) == sorted(
+        f"{n}.json" for n in BUILTIN_ENTRIES)
+    chk = _run("--check", "--dir", snapdir)
+    assert chk.returncode == 0, chk.stdout + chk.stderr
+
+
+def test_cli_emit_is_valid_stable_json():
+    a, b = _run("--emit", "fused_reduce"), _run("--emit", "fused_reduce")
+    assert a.returncode == 0, a.stderr
+    assert a.stdout == b.stdout
+    payload = json.loads(a.stdout)
+    assert payload["entry"] == "fused_reduce" and payload["records"]
+
+
+def test_cli_user_entry_with_shapes_and_axes(tmp_path):
+    with open(tmp_path / "user_step.py", "w") as f:
+        f.write("import jax\n"
+                "def step(x, y):\n"
+                "    return jax.lax.psum(x, 'w'), "
+                "jax.lax.all_gather(y, 'w')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=f"{REPO}{os.pathsep}{tmp_path}")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.schedule",
+         "--entry", "user_step:step", "--shape", "8x4:float32",
+         "--shape", "6:bfloat16", "--axis", "w=2"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert [r["prim"] for r in payload["records"]] == \
+        ["psum", "all_gather"]
+    assert payload["records"][0]["inputs"] == ["float32[8x4]"]
+    assert payload["records"][1]["inputs"] == ["bfloat16[6]"]
+
+
+def test_cli_consistency_green():
+    proc = _run("--consistency")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_list_names_every_entry():
+    proc = _run("--list")
+    assert proc.returncode == 0
+    for name in BUILTIN_ENTRIES:
+        assert name in proc.stdout

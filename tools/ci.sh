@@ -17,25 +17,31 @@
 #   5. purepy:  the HOROVOD_TPU_NATIVE_CORE=0 fallback paths
 #   6. noctl:   single-process semantics with the controller disabled
 #   7. full:    the whole suite (skipped with --quick)
-#   8. hvdlint: static collective-consistency, lock-order and guarded-by
-#      race analysis over the framework and examples, gated on the
-#      findings baseline (docs/analysis.md)
+#   8. hvdlint: static collective-consistency, lock-order, guarded-by
+#      race and SPMD rank-divergence dataflow analysis (HVD200–HVD205)
+#      over the framework and examples, gated on the findings baseline
+#      (docs/analysis.md)
 #   9. chaos:   the elastic join path under pinned fault-injection seeds
 #      must converge, and the leader-join regression stays pinned
 #      (docs/env.md "Chaos engineering")
 #  10. bench:   tools/bench_control.py --smoke — real multi-process
 #      negotiation over the RPC KV; watch-transport invariants (one
 #      set + one watch per round, zero polled dir-gets) stay pinned
+#  11. hvdsched: re-trace the builtin step entries to jaxprs on CPU and
+#      diff their collective schedules against tests/schedules/
+#      (HVD211 drift) + the cross-mesh-size consistency check (HVD210);
+#      any fusion-plan change is an explicit snapshot update in review
+#      (docs/analysis.md "Schedule snapshots")
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/10 package: wheel + sdist =="
+echo "== 1/11 package: wheel + sdist =="
 rm -rf dist/
 python -m build --no-isolation --outdir dist/ . > /tmp/ci_build.log 2>&1 \
   || { tail -30 /tmp/ci_build.log; exit 1; }
 ls -l dist/
 
-echo "== 2/10 wheel install smoke (scratch target, run from /tmp) =="
+echo "== 2/11 wheel install smoke (scratch target, run from /tmp) =="
 WHEEL_TGT=$(mktemp -d)
 trap 'rm -rf "$WHEEL_TGT"' EXIT
 REPO_DIR="$(pwd)"
@@ -145,45 +151,54 @@ PYEOF
 
 dist_smoke dist/*.whl
 if [ "${1:-}" != "--quick" ]; then
-  echo "== 3/10 sdist install smoke (builds from source) =="
+  echo "== 3/11 sdist install smoke (builds from source) =="
   dist_smoke dist/*.tar.gz
 fi
 
-echo "== 4/10 native core build + parity tests =="
+echo "== 4/11 native core build + parity tests =="
 python setup.py build_ext --inplace > /tmp/ci_native.log 2>&1 \
   || { tail -30 /tmp/ci_native.log; exit 1; }
 python -m pytest tests/test_native_core.py -q
 
-echo "== 5/10 pure-python fallback (native core disabled) =="
+echo "== 5/11 pure-python fallback (native core disabled) =="
 HOROVOD_TPU_NATIVE_CORE=0 python -m pytest \
   tests/test_basics.py tests/test_fusion.py -q
 
-echo "== 6/10 controller disabled (single-process semantics) =="
+echo "== 6/11 controller disabled (single-process semantics) =="
 HOROVOD_TPU_CONTROLLER=0 python -m pytest tests/test_basics.py -q
 
 if [ "${1:-}" != "--quick" ]; then
-  echo "== 7/10 full suite =="
+  echo "== 7/11 full suite =="
   python -m pytest tests/ -q
 fi
 
-echo "== 8/10 hvdlint static analysis =="
-# all three engines (user rules, lock-order, guarded-by race detector);
-# --baseline: fail only on NEW findings vs the checked-in ratchet
-# (near-empty by policy — docs/analysis.md "Baseline workflow").  One
-# parse per file feeds every engine, keeping the stage well under 30s.
+echo "== 8/11 hvdlint static analysis =="
+# all four engines (user rules, lock-order, guarded-by race detector,
+# HVD200–HVD205 SPMD divergence dataflow); --baseline: fail only on NEW
+# findings vs the checked-in ratchet (EMPTY by policy, and refused
+# outright if its analyzer_version is stale — docs/analysis.md
+# "Baseline workflow").  One parse per file feeds every engine, keeping
+# the stage well under 30s.
 python -m horovod_tpu.analysis \
   --baseline tools/hvdlint_baseline.json horovod_tpu/ examples/
 
-echo "== 9/10 chaos smoke: elastic join under fixed fault seeds =="
+echo "== 9/11 chaos smoke: elastic join under fixed fault seeds =="
 python -m pytest tests/test_chaos.py -q \
   -k "converges_under_fault_seed or leader_join"
 
-echo "== 10/10 control-plane bench smoke (watch transport invariants) =="
+echo "== 10/11 control-plane bench smoke (watch transport invariants) =="
 # fast correctness run of tools/bench_control.py: real multi-process
 # negotiation over the RPC KV; asserts ZERO polled dir-gets and one
 # set + one watch per steady-state round (docs/performance.md)
 python tools/bench_control.py --smoke > /tmp/ci_bench_control.log 2>&1 \
   || { tail -30 /tmp/ci_bench_control.log; exit 1; }
 tail -1 /tmp/ci_bench_control.log
+
+echo "== 11/11 hvdsched: collective-schedule snapshots + consistency =="
+# re-trace every builtin step entry to a jaxpr on CPU, diff against the
+# committed tests/schedules/*.json (HVD211 — any fusion-plan change is
+# an explicit `tools/hvdsched --update` in review) and require identical
+# canonical schedules across mesh sizes (HVD210)
+bash tools/hvdsched --check --consistency
 
 echo "CI matrix: all stages green"
