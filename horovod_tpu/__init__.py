@@ -58,6 +58,7 @@ from .exceptions import (  # noqa: F401
 # --- optimizer wrappers (reference: horovod/torch/optimizer.py et al.) ------
 from .optim import (  # noqa: F401
     DistributedOptimizer, DistributedGradientTransform,
+    fused_reduce_scatter_tree, all_gather_sharded_tree,
     broadcast_parameters, broadcast_optimizer_state,
 )
 

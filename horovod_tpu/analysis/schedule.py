@@ -333,8 +333,12 @@ def _entry_distopt_step():
     import optax
     from ..optim.distributed import DistributedOptimizer
 
+    # sharded_update pinned off: snapshots must not flip with the
+    # operator's HOROVOD_SHARDED_UPDATE env (the sharded plan has its
+    # own entry, sharded_distopt_step)
     tx = DistributedOptimizer(optax.adam(1e-3), axis_name=_AXIS,
-                              threshold_bytes=_THRESHOLD)
+                              threshold_bytes=_THRESHOLD,
+                              sharded_update=False)
     spec = _grads_spec()
     params = jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), spec)
@@ -362,11 +366,35 @@ def _entry_jit_fused_reduce():
     return step, (_grads_spec(),)
 
 
+def _entry_sharded_distopt_step():
+    """The ZeRO-style sharded step (HOROVOD_SHARDED_UPDATE): per bucket
+    reduce_scatter → 1/N inner update → all_gather, never a full-gradient
+    psum (arXiv:2004.13336; ROADMAP item 1)."""
+    import optax
+    from ..optim.distributed import DistributedOptimizer
+
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=_AXIS,
+                              threshold_bytes=_THRESHOLD,
+                              sharded_update=True)
+    spec = _grads_spec()
+
+    def step(grads, params):
+        # the sharded optimizer state is per-worker (1/N bucket tiles),
+        # so init runs INSIDE the mapped program, like real sharded
+        # steps do; init issues no collectives, so the schedule is the
+        # update's reduce_scatter/all_gather plan alone
+        state = tx.init(params)
+        updates, _ = tx.update(grads, state, params)
+        return updates
+    return step, (spec, spec)
+
+
 #: entry name -> builder returning (fn, example_args).
 BUILTIN_ENTRIES = {
     "fused_reduce": _entry_fused_reduce,
     "distopt_step": _entry_distopt_step,
     "jit_fused_reduce": _entry_jit_fused_reduce,
+    "sharded_distopt_step": _entry_sharded_distopt_step,
 }
 
 #: Mesh sizes the consistency check traces every entry at (HVD210).

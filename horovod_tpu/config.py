@@ -109,6 +109,11 @@ class Config:
     # operations forced on/off
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
+    # ZeRO-style sharded weight update on the in-jit path (reduce-scatter
+    # → 1/N optimizer step → allgather; arXiv:2004.13336).  Default for
+    # DistributedGradientTransform(sharded_update=None) when axis_name
+    # is set; per-chip optimizer state drops to total/N + padding.
+    sharded_update: bool = False
 
     @staticmethod
     def from_env() -> "Config":
@@ -171,4 +176,6 @@ class Config:
             "HOROVOD_HIERARCHICAL_ALLREDUCE", c.hierarchical_allreduce)
         c.hierarchical_allgather = _env_bool(
             "HOROVOD_HIERARCHICAL_ALLGATHER", c.hierarchical_allgather)
+        c.sharded_update = _env_bool(
+            "HOROVOD_SHARDED_UPDATE", c.sharded_update)
         return c

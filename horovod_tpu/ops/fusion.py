@@ -53,11 +53,15 @@ class EntrySig:
     postscale: Optional[float] = None
 
     @property
-    def nbytes(self) -> int:
-        numel = 1
+    def numel(self) -> int:
+        n = 1
         for d in self.shape:
-            numel *= d
-        return numel * dtype_nbytes(self.dtype)
+            n *= d
+        return n
+
+    @property
+    def nbytes(self) -> int:
+        return self.numel * dtype_nbytes(self.dtype)
 
     def bucket_key(self) -> Tuple:
         """Entries sharing this key may fuse into one collective."""
@@ -149,6 +153,55 @@ def plan_fusion(entries: Sequence[EntrySig],
         cur_group = e.group_id
     flush()
     return buckets
+
+
+def pad_to_multiple(numel: int, parts: int) -> int:
+    """Smallest multiple of ``parts`` that is >= ``numel``.
+
+    A reduce-scatter splits a flat bucket evenly across the mesh axis, so
+    the buffer is zero-padded up to this size before the collective (the
+    ZeRO-style sharded-update path; arXiv:2004.13336)."""
+    if parts <= 0:
+        raise ValueError(f"parts must be positive, got {parts}")
+    return -(-numel // parts) * parts
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLayout:
+    """Flat-buffer layout of one planned fusion bucket, shard-aware.
+
+    The planner's bucket (``plan_fusion``) decides *which* entries fuse;
+    this records *where* each entry lives in the flattened buffer plus the
+    padding a ``shards``-way reduce-scatter needs — the slice metadata the
+    sharded-update path uses to carve per-worker tiles and to reassemble
+    the full buffer after the allgather.
+    """
+    indices: Tuple[int, ...]      # entry indices, planner (bucket) order
+    sizes: Tuple[int, ...]        # per-entry element counts, same order
+    numel: int                    # sum(sizes)
+    padded_numel: int             # numel rounded up to a multiple of shards
+    shard_numel: int              # padded_numel // shards (per-worker tile)
+
+
+def plan_bucket_layouts(entries: Sequence[EntrySig],
+                        buckets: Sequence[Sequence[int]],
+                        shards: int) -> List[BucketLayout]:
+    """Compute the padded flat-buffer layout of every planned bucket.
+
+    ``buckets`` is ``plan_fusion`` output over ``entries``; ``shards`` is
+    the mesh-axis size the buckets will be reduce-scattered over.  The
+    layout is pure plan metadata (trace-time only) — the bucketing itself
+    is unchanged, keeping the single cross-process ordering contract.
+    """
+    layouts: List[BucketLayout] = []
+    for bucket in buckets:
+        sizes = tuple(entries[i].numel for i in bucket)
+        numel = sum(sizes)
+        padded = pad_to_multiple(numel, shards)
+        layouts.append(BucketLayout(
+            indices=tuple(bucket), sizes=sizes, numel=numel,
+            padded_numel=padded, shard_numel=padded // shards))
+    return layouts
 
 
 class ResponseCache:

@@ -4,5 +4,9 @@ horovod/tensorflow/__init__.py DistributedOptimizer/DistributedGradientTape).
 
 from .distributed import (  # noqa: F401
     DistributedOptimizer, DistributedGradientTransform, fused_reduce_tree,
-    broadcast_parameters, broadcast_optimizer_state,
+    fused_reduce_scatter_tree, all_gather_sharded_tree, shard_tree_like,
+    state_partition_specs, broadcast_parameters, broadcast_optimizer_state,
+)
+from .precision import (  # noqa: F401
+    adamw_lp, scale_by_adam_lp, tree_nbytes,
 )

@@ -15,11 +15,21 @@ to the fusion threshold, each bucket is flattened/concatenated and reduced
 with ONE ``psum`` over ICI, then split back — the fusion buffer as a
 compiler construct.  Outside jit it falls back to the eager engine's
 grouped allreduce, preserving the reference's async-hook semantics.
+
+ZeRO-style sharded update (``sharded_update=True`` /
+``HOROVOD_SHARDED_UPDATE``, arXiv:2004.13336 "Automatic Cross-Replica
+Sharding of Weight Update in Data-Parallel Training"): instead of
+materializing the FULL reduced gradient and full optimizer state on every
+worker, each bucket is **reduce-scattered** (same total bytes on the wire
+as a tree allreduce), the inner optax update runs on this worker's 1/N
+tile against 1/N-sized moment state, and ONE **allgather** per bucket
+rebuilds the updated flat buffer.  Per-chip optimizer compute and state
+drop N×; params stay replicated (ZeRO stage "weight update sharding").
 """
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,13 +51,42 @@ def _axis_size(axis_name: str):
     return jax.core.axis_frame(axis_name)
 
 
+def _psum_scatter(x, axis_name: str):
+    """Tiled 1-D reduce-scatter with a version-checked compat path (the
+    sibling of ``_axis_size``).
+
+    ``jax.lax.psum_scatter`` exists on 0.4.x, but guard anyway: the
+    fallback computes the identical per-worker tile via a full ``psum``
+    plus this worker's slice — same numbers and the same 1/N optimizer
+    state, but the full reduced gradient IS materialized and the wire
+    bytes are N×.  On such a build the schedule gates (the
+    ``sharded_distopt_step`` snapshot, test_zero's no-psum pins, CI
+    stages 10/11) fail LOUDLY by design: the no-full-gradient guarantee
+    would not hold, and a reviewed snapshot update is the explicit
+    acknowledgment, not a silent degradation."""
+    if hasattr(jax.lax, "psum_scatter"):
+        return jax.lax.psum_scatter(x, axis_name, scatter_dimension=0,
+                                    tiled=True)
+    full = jax.lax.psum(x, axis_name)
+    shard = x.shape[0] // _axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice_in_dim(full, idx * shard, shard)
+
+
 def _tree_leaves_sorted(tree):
-    """Leaves with deterministic path-sorted order (the controller's total
-    order on tensor names, applied at trace time)."""
-    leaves = jax.tree_util.tree_leaves_with_path(tree)
-    leaves = sorted(leaves, key=lambda kv: jax.tree_util.keystr(kv[0]))
-    return [l for _, l in leaves], [jax.tree_util.keystr(k)
-                                    for k, _ in leaves]
+    """Leaves in deterministic path-sorted order (the controller's total
+    order on tensor names, applied at trace time).
+
+    Returns ``(leaves, names, order)`` where ``order[pos]`` is the
+    ``tree_leaves`` index of the ``pos``-th sorted leaf: the permutation
+    from the single path walk, which ``_restore_order`` inverts instead
+    of re-walking and re-sorting the paths (this runs per recompile)."""
+    keyed = jax.tree_util.tree_leaves_with_path(tree)
+    order = sorted(range(len(keyed)),
+                   key=lambda i: jax.tree_util.keystr(keyed[i][0]))
+    return ([keyed[i][1] for i in order],
+            [jax.tree_util.keystr(keyed[i][0]) for i in order],
+            order)
 
 
 def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
@@ -68,45 +107,41 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
     schedule extractor (``tools/hvdsched``, ``analysis/schedule.py``) can
     attribute every ``psum`` in the jaxpr to its fusion bucket.
     """
-    if threshold_bytes is None:
-        cfg = runtime._state().config
-        threshold_bytes = (cfg.fusion_threshold_bytes if cfg is not None
-                           else 64 * 1024 * 1024)
-    leaves, _names = _tree_leaves_sorted(grads)
+    threshold_bytes = _resolve_threshold(threshold_bytes)
+    leaves, _names, order = _tree_leaves_sorted(grads)
+    if not leaves:
+        # an empty gradient pytree has nothing to reduce on ANY op path;
+        # return it unchanged rather than handing None to a collective
+        return grads
     treedef = jax.tree_util.tree_structure(grads)
-    order = sorted(range(len(leaves)),
-                   key=lambda i: (str(leaves[i].dtype), i))
 
     if op == ReduceOp.ADASUM:
+        if compression not in (None, Compression.none):
+            raise ValueError(
+                "compression is not supported with op=Adasum: the "
+                "recursive pairwise dot products operate on the exact "
+                "local gradients, and silently skipping the compressor "
+                "would diverge from the psum path's wire format — use "
+                "op=Average/Sum with compression, or Adasum uncompressed")
         from ..ops.adasum import adasum_p
-        flat_all = jnp.concatenate(
-            [leaves[i].reshape(-1) for i in order]) if leaves else None
+        dorder = sorted(range(len(leaves)),
+                        key=lambda i: (str(leaves[i].dtype), i))
+        flat_all = jnp.concatenate([leaves[i].reshape(-1) for i in dorder])
         red = adasum_p(flat_all * prescale_factor if prescale_factor != 1.0
                        else flat_all, axis_name)
         out = [None] * len(leaves)
         off = 0
-        for i in order:
+        for i in dorder:
             sz = leaves[i].size
             out[i] = red[off:off + sz].reshape(leaves[i].shape)
             off += sz
         if postscale_factor != 1.0:
             out = [o * postscale_factor for o in out]
         return jax.tree_util.tree_unflatten(
-            treedef, _restore_order(out, grads))
+            treedef, _restore_order(out, order))
 
-    # One planner for both worlds: leaves become EntrySigs (name = the
-    # sorted pytree path, the controller's total order) and the eager
-    # engine's plan_fusion decides the buckets.  Within one dtype the
-    # path-sorted leaf order IS the planner's name order, so this is the
-    # plan every process computes.
-    from ..ops.fusion import EntrySig, plan_fusion
-    sigs = [EntrySig(name=_names[i], op_type="allreduce",
-                     reduce_op=str(op), dtype=str(leaves[i].dtype),
-                     shape=tuple(leaves[i].shape), process_set_id=0,
-                     stacked=False, prescale=prescale_factor,
-                     postscale=postscale_factor)
-            for i in range(len(leaves))]
-    buckets = plan_fusion(sigs, threshold_bytes)
+    buckets, _sigs = _plan_buckets(leaves, _names, op, prescale_factor,
+                                   postscale_factor, threshold_bytes)
 
     out = [None] * len(leaves)
     for bucket_id, bucket in enumerate(buckets):
@@ -129,19 +164,187 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
                     leaves[i].shape)
                 off += sz
     # out is in path-sorted leaf order; restore original leaf order
-    flat_sorted_to_orig = _restore_order(out, grads)
-    return jax.tree_util.tree_unflatten(treedef, flat_sorted_to_orig)
+    return jax.tree_util.tree_unflatten(treedef, _restore_order(out, order))
 
 
-def _restore_order(sorted_leaves, tree):
-    """Map path-sorted leaves back to tree_leaves order."""
-    paths = [jax.tree_util.keystr(k)
-             for k, _ in jax.tree_util.tree_leaves_with_path(tree)]
-    sorted_idx = sorted(range(len(paths)), key=lambda i: paths[i])
-    out = [None] * len(paths)
-    for pos, i in enumerate(sorted_idx):
+def _restore_order(sorted_leaves, order):
+    """Invert the ``_tree_leaves_sorted`` permutation back to
+    ``tree_leaves`` order (no second path walk)."""
+    out = [None] * len(order)
+    for pos, i in enumerate(order):
         out[i] = sorted_leaves[pos]
     return out
+
+
+def _resolve_threshold(threshold_bytes: Optional[int]) -> int:
+    if threshold_bytes is not None:
+        return threshold_bytes
+    cfg = runtime._state().config
+    return (cfg.fusion_threshold_bytes if cfg is not None
+            else 64 * 1024 * 1024)
+
+
+def _plan_buckets(leaves, names, op, prescale_factor, postscale_factor,
+                  threshold_bytes):
+    """One planner for both worlds: leaves become EntrySigs (name = the
+    sorted pytree path, the controller's total order) and the eager
+    engine's ``plan_fusion`` decides the buckets.  Within one dtype the
+    path-sorted leaf order IS the planner's name order, so this is the
+    plan every process computes."""
+    from ..ops.fusion import EntrySig, plan_fusion
+    sigs = [EntrySig(name=names[i], op_type="allreduce",
+                     reduce_op=str(op), dtype=str(leaves[i].dtype),
+                     shape=tuple(leaves[i].shape), process_set_id=0,
+                     stacked=False, prescale=prescale_factor,
+                     postscale=postscale_factor)
+            for i in range(len(leaves))]
+    return plan_fusion(sigs, threshold_bytes), sigs
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-style sharded update: reduce-scatter → 1/N update → allgather
+# ---------------------------------------------------------------------------
+
+class ShardedLayout(NamedTuple):
+    """Trace-time slice metadata for reassembling reduce-scattered buckets.
+
+    Everything here is static Python data (no arrays): the pytree
+    structure, the path-sort permutation, per-leaf shapes, and each
+    planned bucket's padded flat-buffer layout (``ops.fusion
+    BucketLayout``).  ``all_gather_sharded_tree`` needs exactly this to
+    rebuild the full pytree from per-worker 1/N tiles."""
+    treedef: Any
+    order: Tuple[int, ...]                 # _tree_leaves_sorted permutation
+    shapes: Tuple[Tuple[int, ...], ...]    # leaf shapes, path-sorted order
+    buckets: Tuple[Any, ...]               # ops.fusion.BucketLayout per bucket
+
+
+def _sharded_layout(tree, axis_size: int, op, prescale_factor,
+                    postscale_factor, threshold_bytes):
+    """Plan the bucket/padding layout of ``tree`` for an ``axis_size``-way
+    reduce-scatter — the SAME ``plan_fusion`` buckets as the replicated
+    path (one cross-process ordering contract), plus per-bucket padding
+    to a multiple of ``axis_size``.  Returns ``(sorted_leaves, layout)``
+    so callers reuse the single path walk."""
+    from ..ops.fusion import plan_bucket_layouts
+    leaves, names, order = _tree_leaves_sorted(tree)
+    buckets, sigs = _plan_buckets(leaves, names, op, prescale_factor,
+                                  postscale_factor, threshold_bytes)
+    return leaves, ShardedLayout(
+        treedef=jax.tree_util.tree_structure(tree), order=tuple(order),
+        shapes=tuple(tuple(l.shape) for l in leaves),
+        buckets=tuple(plan_bucket_layouts(sigs, buckets, axis_size)))
+
+
+def _bucket_flat(leaves, bl):
+    """Concatenate a bucket's (path-sorted) leaves into one flat buffer,
+    zero-padded to the reduce-scatter-divisible size."""
+    parts = [leaves[i].reshape(-1) for i in bl.indices]
+    buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+    if bl.padded_numel != bl.numel:
+        buf = jnp.pad(buf, (0, bl.padded_numel - bl.numel))
+    return buf
+
+
+def _my_tile(buf, shard_numel: int, axis_name: str):
+    """This worker's 1/N tile of a padded flat bucket buffer."""
+    idx = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice_in_dim(buf, idx * shard_numel, shard_numel)
+
+
+def _tiles_from_leaves(leaves, layout: ShardedLayout, axis_name: str):
+    """Per-bucket 1/N tiles of already-path-sorted leaves."""
+    return tuple(_my_tile(_bucket_flat(leaves, bl), bl.shard_numel,
+                          axis_name)
+                 for bl in layout.buckets)
+
+
+def shard_tree_like(tree, layout: ShardedLayout, axis_name: str):
+    """Carve ``tree`` (e.g. the replicated params) into this worker's
+    per-bucket flat tiles under an existing ``ShardedLayout`` — the
+    layout the sharded optimizer state lives on."""
+    leaves, _names, _order = _tree_leaves_sorted(tree)
+    return _tiles_from_leaves(leaves, layout, axis_name)
+
+
+def fused_reduce_scatter_tree(grads, axis_name: str,
+                              op: str = ReduceOp.AVERAGE,
+                              threshold_bytes: Optional[int] = None,
+                              compression=Compression.none,
+                              prescale_factor: float = 1.0,
+                              postscale_factor: float = 1.0):
+    """Reduce-scatter a gradient pytree: each worker keeps 1/N per bucket.
+
+    The sharded-update half of ``fused_reduce_tree``: the SAME
+    ``plan_fusion`` buckets in the same ``hvd_bucket<i>`` named scopes,
+    but each padded flat buffer is reduced with ``psum_scatter`` instead
+    of ``psum`` — same total collective bytes as a tree allreduce, and no
+    worker ever materializes the full reduced gradient.
+
+    Returns ``(shards, layout)``: ``shards`` is a tuple with one flat
+    1/N-sized array per planned bucket (this worker's tile, fully scaled
+    and averaged), ``layout`` is the static slice metadata
+    ``all_gather_sharded_tree`` / ``shard_tree_like`` consume.
+    """
+    if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+        raise ValueError(
+            f"fused_reduce_scatter_tree supports op=Average/Sum, got "
+            f"{op!r}: Adasum and min/max reductions are not expressible "
+            f"as a reduce-scatter of bucket tiles")
+    threshold_bytes = _resolve_threshold(threshold_bytes)
+    if not jax.tree_util.tree_leaves(grads):
+        return (), ShardedLayout(
+            treedef=jax.tree_util.tree_structure(grads), order=(),
+            shapes=(), buckets=())
+    n = _axis_size(axis_name)
+    leaves, layout = _sharded_layout(grads, n, op, prescale_factor,
+                                     postscale_factor, threshold_bytes)
+    shards = []
+    for bucket_id, bl in enumerate(layout.buckets):
+        with jax.named_scope(f"hvd_bucket{bucket_id}"):
+            buf = _bucket_flat(leaves, bl)
+            if prescale_factor != 1.0:
+                buf = buf * jnp.asarray(prescale_factor, buf.dtype)
+            wire, ctx = compression.compress(buf)
+            tile = _psum_scatter(wire, axis_name)
+            tile = compression.decompress(tile, ctx)
+            if op == ReduceOp.AVERAGE:
+                tile = tile / n
+            if postscale_factor != 1.0:
+                tile = tile * jnp.asarray(postscale_factor, tile.dtype)
+            shards.append(tile)
+    return tuple(shards), layout
+
+
+def all_gather_sharded_tree(shards, layout: ShardedLayout, axis_name: str):
+    """Rebuild the full (replicated) pytree from per-worker bucket tiles:
+    ONE tiled ``all_gather`` per bucket, then unpad/split/unflatten."""
+    if len(shards) != len(layout.buckets):
+        raise ValueError(
+            f"got {len(shards)} shard(s) for a layout of "
+            f"{len(layout.buckets)} bucket(s) — the shards and the "
+            f"layout come from different plans (e.g. a stale layout "
+            f"after a fusion-threshold change)")
+    out = [None] * len(layout.shapes)
+    for bucket_id, (bl, tile) in enumerate(zip(layout.buckets, shards)):
+        with jax.named_scope(f"hvd_bucket{bucket_id}"):
+            full = jax.lax.all_gather(tile, axis_name, axis=0, tiled=True)
+            off = 0
+            for i, sz in zip(bl.indices, bl.sizes):
+                out[i] = jax.lax.slice_in_dim(full, off, off + sz).reshape(
+                    layout.shapes[i])
+                off += sz
+    return jax.tree_util.tree_unflatten(
+        layout.treedef, _restore_order(out, list(layout.order)))
+
+
+def _sharded_update_default() -> bool:
+    """Env/config default for ``sharded_update`` (HOROVOD_SHARDED_UPDATE)."""
+    cfg = runtime._state().config
+    if cfg is not None:
+        return cfg.sharded_update
+    from ..config import _env_bool
+    return _env_bool("HOROVOD_SHARDED_UPDATE", False)
 
 
 class _DistState(NamedTuple):
@@ -159,7 +362,9 @@ def DistributedGradientTransform(
         prescale_factor: float = 1.0,
         postscale_factor: float = 1.0,
         threshold_bytes: Optional[int] = None,
-        process_set=None) -> optax.GradientTransformation:
+        process_set=None,
+        sharded_update: Optional[bool] = None
+        ) -> optax.GradientTransformation:
     """optax transformation that cross-worker-reduces gradients.
 
     ``axis_name`` given → in-jit path (fused psum over the mesh axis; use
@@ -170,10 +375,33 @@ def DistributedGradientTransform(
     With ``backward_passes_per_step > 1``, gradients accumulate locally and
     the (single) reduction fires every k-th step; intermediate steps emit
     zero updates (reference: optimizer.py backward_passes_per_step).
+
+    ``sharded_update=True`` (default from ``HOROVOD_SHARDED_UPDATE``;
+    in-jit path only) switches each bucket from
+    psum → full update to **reduce-scatter → 1/N update → allgather**
+    (ZeRO-style, arXiv:2004.13336): ``init_fn`` initializes the inner
+    optimizer state on this worker's flat bucket tiles, so per-chip
+    optimizer-state bytes are ``total/N + padding`` — composing with the
+    bf16 moments of ``optim.precision.adamw_lp``.  Params stay
+    replicated; the allgathered updates apply as usual.  Because the
+    state is per-worker, ``init_fn`` must run INSIDE the mapped program
+    (like the ``backward_passes_per_step`` accumulator) and the state
+    crosses shard_map boundaries with
+    ``state_partition_specs(..., sharded_update=True)``.
     """
     if inner is None:
         inner = optax.identity()
     k = backward_passes_per_step
+    if sharded_update and axis_name is None:
+        raise ValueError(
+            "sharded_update=True requires axis_name: the reduce-scatter "
+            "rewrite exists only on the in-jit path (the eager engine "
+            "has no mesh axis to scatter over)")
+    sharded = (bool(sharded_update) if sharded_update is not None
+               else axis_name is not None and _sharded_update_default())
+    if sharded and op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+        raise ValueError(
+            f"sharded_update supports op=Average/Sum, got {op!r}")
 
     def reduce_grads(grads):
         if axis_name is not None:
@@ -182,7 +410,7 @@ def DistributedGradientTransform(
                 compression=compression, prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor)
         from .. import api
-        leaves, names = _tree_leaves_sorted(grads)
+        leaves, names, order = _tree_leaves_sorted(grads)
         wires, ctxs = [], []
         for leaf in leaves:
             w, c = compression.compress(leaf)
@@ -194,18 +422,87 @@ def DistributedGradientTransform(
             postscale_factor=postscale_factor, process_set=process_set)
         red = [compression.decompress(r, c) for r, c in zip(red, ctxs)]
         return jax.tree_util.tree_unflatten(
-            jax.tree_util.tree_structure(grads), _restore_order(red, grads))
+            jax.tree_util.tree_structure(grads), _restore_order(red, order))
+
+    # init-time layout fingerprints (static trace metadata, not traced
+    # state): let _step validate the gradient-planned layout even when
+    # update() is called without params.  Empty when init_fn never ran
+    # in this transform's lifetime (e.g. state restored from checkpoint
+    # into a fresh transform); more than one distinct entry means the
+    # transform was reused across different models, so a params-less
+    # update can't know which layout its state came from — validation
+    # is then params-based only (no false positives either way).
+    _init_fingerprints = set()
+
+    def _step(grads, inner_state, params):
+        """One reduced optimizer step → (full-size updates, new inner)."""
+        if sharded:
+            shards, layout = fused_reduce_scatter_tree(
+                grads, axis_name, op=op, threshold_bytes=threshold_bytes,
+                compression=compression, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+            # init_fn planned the state layout from PARAMS; the gradient
+            # layout above must be the same plan, or the 1/N state tiles
+            # won't line up with the grad shards — fail with the cause
+            # instead of a deep optax mismatch
+            p_shards = None
+            if params is not None:
+                p_leaves, p_layout = _sharded_layout(
+                    params, _axis_size(axis_name), op, prescale_factor,
+                    postscale_factor, _resolve_threshold(threshold_bytes))
+                expected = (p_layout.shapes, p_layout.buckets)
+            else:
+                p_leaves = None
+                expected = (next(iter(_init_fingerprints))
+                            if len(_init_fingerprints) == 1 else None)
+            if (expected is not None
+                    and expected != (layout.shapes, layout.buckets)):
+                raise ValueError(
+                    "sharded_update requires gradients and params to "
+                    "share one bucket layout, but they plan differently "
+                    "(dtype or structure divergence between the gradient "
+                    "tree and the param tree — e.g. a cast-to-bf16 "
+                    "transform chained before this one); use the "
+                    "replicated path or align the dtypes")
+            if p_leaves is not None:
+                p_shards = _tiles_from_leaves(p_leaves, layout, axis_name)
+            upd_shards, new_inner = inner.update(
+                shards, inner_state, p_shards)
+            updates = all_gather_sharded_tree(upd_shards, layout, axis_name)
+            return updates, new_inner
+        reduced = reduce_grads(grads)
+        return inner.update(reduced, inner_state, params)
 
     def init_fn(params):
         acc = (jax.tree_util.tree_map(jnp.zeros_like, params) if k > 1
                else None)
-        return _DistState(inner=inner.init(params), acc=acc,
+        if sharded:
+            try:
+                n = _axis_size(axis_name)
+            except NameError as exc:
+                raise ValueError(
+                    f"sharded_update=True: init must run INSIDE the "
+                    f"mapped program (shard_map/pmap over axis_name="
+                    f"{axis_name!r}) because the optimizer state is this "
+                    f"worker's 1/N bucket tiles — wrap opt.init in the "
+                    f"mesh program and carry the state with "
+                    f"state_partition_specs(..., sharded_update=True). "
+                    f"(sharded mode may have been enabled by "
+                    f"HOROVOD_SHARDED_UPDATE=1)") from exc
+            _leaves, layout = _sharded_layout(
+                params, n, op, prescale_factor, postscale_factor,
+                _resolve_threshold(threshold_bytes))
+            _init_fingerprints.add((layout.shapes, layout.buckets))
+            inner_state = inner.init(
+                shard_tree_like(params, layout, axis_name))
+        else:
+            inner_state = inner.init(params)
+        return _DistState(inner=inner_state, acc=acc,
                           count=jnp.zeros([], jnp.int32))
 
     def update_fn(grads, state, params=None):
         if k == 1:
-            reduced = reduce_grads(grads)
-            updates, new_inner = inner.update(reduced, state.inner, params)
+            updates, new_inner = _step(grads, state.inner, params)
             return updates, _DistState(new_inner, state.acc, state.count)
         acc = jax.tree_util.tree_map(lambda a, g: a + g, state.acc, grads)
         count = state.count + 1
@@ -218,7 +515,9 @@ def DistributedGradientTransform(
                 lambda a: jnp.zeros(a.shape, a.dtype), tree)
 
         def _as_varying(tree):
-            if axis_name is None:
+            # pcast is the new-jax VMA API; absent (0.4.x) there is no
+            # varying-manual-axes tracking to align, so identity is right
+            if axis_name is None or not hasattr(jax.lax, "pcast"):
                 return tree
             return jax.tree_util.tree_map(
                 lambda a: jax.lax.pcast(a, axis_name, to="varying"), tree)
@@ -226,8 +525,7 @@ def DistributedGradientTransform(
         def do_step(args):
             acc, inner_state = args
             mean_acc = jax.tree_util.tree_map(lambda a: a / k, acc)
-            reduced = reduce_grads(mean_acc)
-            updates, new_inner = inner.update(reduced, inner_state, params)
+            updates, new_inner = _step(mean_acc, inner_state, params)
             return updates, _as_varying(_fresh_zeros(acc)), new_inner
 
         def skip_step(args):
@@ -248,7 +546,8 @@ def DistributedGradientTransform(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
-def state_partition_specs(state: _DistState, axis_name: str):
+def state_partition_specs(state: _DistState, axis_name: str,
+                          sharded_update: bool = False):
     """PartitionSpecs for a ``_DistState`` crossing shard_map boundaries.
 
     With ``backward_passes_per_step > 1`` the gradient accumulator holds
@@ -256,9 +555,19 @@ def state_partition_specs(state: _DistState, axis_name: str):
     worker axis and must be sharded over it; the inner optimizer state and
     counter are replicated.  Use these as in/out specs when the optimizer
     state is carried across separate shard_map'd step calls.
+
+    With ``sharded_update=True`` the inner state lives on the flat
+    bucket-tile layout: every non-scalar inner leaf is this worker's 1/N
+    tile (varying over the worker axis → sharded spec), while scalar
+    leaves (step counters) stay replicated.
     """
     from jax.sharding import PartitionSpec as P
-    inner = jax.tree_util.tree_map(lambda _: P(), state.inner)
+    if sharded_update:
+        inner = jax.tree_util.tree_map(
+            lambda leaf: P(axis_name) if getattr(leaf, "ndim", 0) else P(),
+            state.inner)
+    else:
+        inner = jax.tree_util.tree_map(lambda _: P(), state.inner)
     acc = (None if state.acc is None else
            jax.tree_util.tree_map(lambda _: P(axis_name), state.acc))
     return _DistState(inner=inner, acc=acc, count=P())
@@ -272,7 +581,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          gradient_predivide_factor: float = 1.0,
                          axis_name: Optional[str] = None,
                          threshold_bytes: Optional[int] = None,
-                         process_set=None) -> optax.GradientTransformation:
+                         process_set=None,
+                         sharded_update: Optional[bool] = None
+                         ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with distributed gradient reduction.
 
     Mirrors the reference's ``hvd.DistributedOptimizer`` signature
@@ -293,7 +604,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         backward_passes_per_step=backward_passes_per_step,
         compression=compression, prescale_factor=prescale,
         postscale_factor=postscale, threshold_bytes=threshold_bytes,
-        process_set=process_set)
+        process_set=process_set, sharded_update=sharded_update)
 
 
 def broadcast_parameters(params, root_rank: int = 0, process_set=None):

@@ -78,6 +78,18 @@ def scale_by_adam_lp(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def tree_nbytes(tree) -> int:
+    """Total bytes of the array leaves of a pytree.
+
+    The HBM-accounting companion to the compressed/sharded optimizer
+    states: ``tree_nbytes(opt_state.inner)`` is what this worker actually
+    stores — bf16 moments halve it, the ZeRO-style ``sharded_update``
+    divides it by the mesh-axis size (plus bucket padding)."""
+    return sum(int(x.size) * jnp.dtype(x.dtype).itemsize
+               for x in jax.tree_util.tree_leaves(tree)
+               if hasattr(x, "dtype"))
+
+
 def adamw_lp(learning_rate, b1: float = 0.9, b2: float = 0.999,
              eps: float = 1e-8, weight_decay: float = 1e-4,
              mu_dtype: Any = jnp.bfloat16, nu_dtype: Any = jnp.bfloat16

@@ -181,6 +181,51 @@ def test_compression_in_jit(hvd):
     np.testing.assert_allclose(out["w"], np.full((64,), 8.0))
 
 
+def test_fused_reduce_tree_empty_pytree_all_op_paths():
+    """An empty gradient pytree is returned unchanged on every op path —
+    the Adasum branch used to hand ``None`` to ``adasum_p`` and crash."""
+    from horovod_tpu.optim.distributed import fused_reduce_tree as frt
+    for op in (hvd_mod.Average, hvd_mod.Sum, hvd_mod.Adasum):
+        assert frt({}, "workers", op=op) == {}
+    nested = {"a": {}, "b": ()}
+    out = frt(nested, "workers", op=hvd_mod.Adasum)
+    assert out == nested
+
+
+def test_adasum_rejects_compression():
+    """The psum branch honors ``compression``; the Adasum branch cannot —
+    it must refuse loudly instead of silently dropping the compressor."""
+    with pytest.raises(ValueError, match="Adasum"):
+        fused_reduce_tree({"w": jnp.ones(4)}, "workers",
+                          op=hvd_mod.Adasum,
+                          compression=hvd_mod.Compression.bf16)
+    with pytest.raises(ValueError, match="Adasum"):
+        fused_reduce_tree({"w": jnp.ones(4)}, "workers",
+                          op=hvd_mod.Adasum,
+                          compression=hvd_mod.Compression.fp16)
+
+
+def test_tree_leaves_sorted_returns_reusable_permutation():
+    """Single path walk: the permutation ``_tree_leaves_sorted`` returns
+    is exactly what the old ``_restore_order`` re-derived, and inverting
+    it restores ``tree_leaves`` order (parity pin)."""
+    from horovod_tpu.optim.distributed import (
+        _restore_order, _tree_leaves_sorted)
+    tree = {"b": jnp.ones(2), "a": {"z": jnp.zeros(3),
+                                    "m": jnp.full((1,), 5.0)},
+            "c": (jnp.arange(2.0), jnp.arange(3.0))}
+    leaves, names, order = _tree_leaves_sorted(tree)
+    assert names == sorted(names)
+    # pin against the old double-walk derivation
+    paths = [jax.tree_util.keystr(k) for k, _ in
+             jax.tree_util.tree_leaves_with_path(tree)]
+    assert list(order) == sorted(range(len(paths)),
+                                 key=lambda i: paths[i])
+    restored = _restore_order(leaves, order)
+    for got, want in zip(restored, jax.tree_util.tree_leaves(tree)):
+        np.testing.assert_array_equal(got, want)
+
+
 def test_adamw_lp_fp32_matches_optax(hvd):
     """With fp32 storage the low-precision AdamW is exactly optax.adamw."""
     from horovod_tpu.optim.precision import adamw_lp

@@ -280,7 +280,7 @@ def test_fused_reduce_uses_the_fusion_planner():
     from horovod_tpu.optim.distributed import _tree_leaves_sorted
 
     grads = BUILTIN_ENTRIES["fused_reduce"]()[1][0]
-    leaves, names = _tree_leaves_sorted(grads)
+    leaves, names, _order = _tree_leaves_sorted(grads)
     sigs = [EntrySig(name=names[i], op_type="allreduce",
                      reduce_op="average", dtype=str(leaves[i].dtype),
                      shape=tuple(leaves[i].shape), process_set_id=0,
@@ -325,6 +325,53 @@ def test_distopt_step_matches_fused_reduce_plan():
     b = builtin_schedule("distopt_step")
     assert [r.canonical()[:2] for r in a.records] == \
         [r.canonical()[:2] for r in b.records]
+
+
+def test_sharded_step_schedule_is_reduce_scatter_then_allgather():
+    # the ZeRO acceptance pin: per bucket reduce_scatter → all_gather,
+    # and NO full-gradient psum anywhere in the compiled step
+    s = builtin_schedule("sharded_distopt_step")
+    prims = [r.prim for r in s.records]
+    assert "psum" not in prims
+    n_buckets = len(builtin_schedule("distopt_step").records)
+    assert prims == ["reduce_scatter"] * n_buckets + \
+        ["all_gather"] * n_buckets
+    # every collective is attributed to its fusion bucket, and each
+    # bucket gets exactly one scatter and one gather
+    assert [r.bucket for r in s.records] == \
+        list(range(n_buckets)) * 2
+    for r in s.records:
+        assert r.params["tiled"] is True
+        assert r.params["axis_size"] == 2
+    for r in s.records[:n_buckets]:
+        assert r.params["scatter_dimension"] == 0
+    for r in s.records[n_buckets:]:
+        assert r.params["all_gather_dimension"] == 0
+
+
+def test_sharded_step_shards_are_padded_fractions():
+    # reduce_scatter outputs are 1/N of the PADDED bucket, so per-chip
+    # bytes drop N× (+ padding); cross-check against the planner's
+    # BucketLayout metadata at both consistency mesh sizes
+    from horovod_tpu.ops.fusion import plan_bucket_layouts
+    from horovod_tpu.optim.distributed import _tree_leaves_sorted
+    grads = sched_mod._grads_spec()
+    leaves, names, _ = _tree_leaves_sorted(grads)
+    from horovod_tpu.ops.fusion import EntrySig, plan_fusion
+    sigs = [EntrySig(name=names[i], op_type="allreduce",
+                     reduce_op="average", dtype=str(leaves[i].dtype),
+                     shape=tuple(leaves[i].shape), process_set_id=0,
+                     stacked=False, prescale=1.0, postscale=1.0)
+            for i in range(len(leaves))]
+    plan = plan_fusion(sigs, sched_mod._THRESHOLD)
+    for size in (2, 4):
+        layouts = plan_bucket_layouts(sigs, plan, size)
+        s = builtin_schedule("sharded_distopt_step", size)
+        scatters = [r for r in s.records if r.prim == "reduce_scatter"]
+        assert len(scatters) == len(layouts)
+        for r, bl in zip(scatters, layouts):
+            assert r.outputs[0].endswith(f"[{bl.shard_numel}]")
+            assert r.inputs[0].endswith(f"[{bl.padded_numel}]")
 
 
 # ---------------------------------------------------------------------------

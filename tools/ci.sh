@@ -26,12 +26,16 @@
 #      (docs/env.md "Chaos engineering")
 #  10. bench:   tools/bench_control.py --smoke — real multi-process
 #      negotiation over the RPC KV; watch-transport invariants (one
-#      set + one watch per round, zero polled dir-gets) stay pinned
+#      set + one watch per round, zero polled dir-gets) stay pinned —
+#      and tools/bench_zero.py --smoke — CPU-mesh A/B of the ZeRO
+#      sharded update (1/N state bytes, no full-gradient psum in the
+#      sharded schedule, sharded == replicated weights)
 #  11. hvdsched: re-trace the builtin step entries to jaxprs on CPU and
 #      diff their collective schedules against tests/schedules/
-#      (HVD211 drift) + the cross-mesh-size consistency check (HVD210);
-#      any fusion-plan change is an explicit snapshot update in review
-#      (docs/analysis.md "Schedule snapshots")
+#      (HVD211 drift; incl. the sharded_distopt_step reduce_scatter →
+#      all_gather plan) + the cross-mesh-size consistency check
+#      (HVD210); any fusion-plan change is an explicit snapshot update
+#      in review (docs/analysis.md "Schedule snapshots")
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -193,6 +197,13 @@ echo "== 10/11 control-plane bench smoke (watch transport invariants) =="
 python tools/bench_control.py --smoke > /tmp/ci_bench_control.log 2>&1 \
   || { tail -30 /tmp/ci_bench_control.log; exit 1; }
 tail -1 /tmp/ci_bench_control.log
+# ZeRO sharded-update A/B: per-worker optimizer state must be 1/N-sized,
+# the sharded schedule must contain NO full-gradient psum, and sharded
+# and replicated steps must land on the same weights (docs/performance.md
+# "Sharded weight update")
+python tools/bench_zero.py --smoke > /tmp/ci_bench_zero.log 2>&1 \
+  || { tail -30 /tmp/ci_bench_zero.log; exit 1; }
+tail -1 /tmp/ci_bench_zero.log
 
 echo "== 11/11 hvdsched: collective-schedule snapshots + consistency =="
 # re-trace every builtin step entry to a jaxpr on CPU, diff against the
