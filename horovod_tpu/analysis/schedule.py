@@ -333,12 +333,12 @@ def _entry_distopt_step():
     import optax
     from ..optim.distributed import DistributedOptimizer
 
-    # sharded_update pinned off: snapshots must not flip with the
-    # operator's HOROVOD_SHARDED_UPDATE env (the sharded plan has its
-    # own entry, sharded_distopt_step)
+    # sharded_update and wire_format pinned off: snapshots must not flip
+    # with the operator's HOROVOD_SHARDED_UPDATE / HOROVOD_COMPRESSION
+    # env (each rewrite has its own entry)
     tx = DistributedOptimizer(optax.adam(1e-3), axis_name=_AXIS,
                               threshold_bytes=_THRESHOLD,
-                              sharded_update=False)
+                              sharded_update=False, wire_format="none")
     spec = _grads_spec()
     params = jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), spec)
@@ -375,7 +375,7 @@ def _entry_sharded_distopt_step():
 
     tx = DistributedOptimizer(optax.adam(1e-3), axis_name=_AXIS,
                               threshold_bytes=_THRESHOLD,
-                              sharded_update=True)
+                              sharded_update=True, wire_format="none")
     spec = _grads_spec()
 
     def step(grads, params):
@@ -389,12 +389,42 @@ def _entry_sharded_distopt_step():
     return step, (spec, spec)
 
 
+def _entry_quantized_distopt_step():
+    """The quantized-wire step (HOROVOD_COMPRESSION=int8): per bucket the
+    full-width psum is rewritten into quantize → all_to_all int8 tiles +
+    fp32 scales → fp32 accumulate → all_gather quantized tiles
+    (EQuARX-class staging, error feedback in _DistState.residual;
+    ROADMAP item 2).  The snapshot pins the wire dtype: int8 avals in
+    the exchange records ARE the compressed-bytes claim."""
+    import optax
+    from ..optim.distributed import DistributedOptimizer
+
+    # explicit format + block so the snapshot cannot flip with the
+    # operator's HOROVOD_COMPRESSION / block-size env; block 16 keeps
+    # the tiny representative pytree multi-block
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=_AXIS,
+                              threshold_bytes=_THRESHOLD,
+                              sharded_update=False, wire_format="int8",
+                              wire_block_size=16)
+    spec = _grads_spec()
+
+    def step(grads, params):
+        # the error-feedback residual is per-worker state carried in
+        # _DistState, so init runs inside the traced program; it issues
+        # no collectives of its own
+        state = tx.init(params)
+        updates, _ = tx.update(grads, state, params)
+        return updates
+    return step, (spec, spec)
+
+
 #: entry name -> builder returning (fn, example_args).
 BUILTIN_ENTRIES = {
     "fused_reduce": _entry_fused_reduce,
     "distopt_step": _entry_distopt_step,
     "jit_fused_reduce": _entry_jit_fused_reduce,
     "sharded_distopt_step": _entry_sharded_distopt_step,
+    "quantized_distopt_step": _entry_quantized_distopt_step,
 }
 
 #: Mesh sizes the consistency check traces every entry at (HVD210).

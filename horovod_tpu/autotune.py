@@ -30,27 +30,30 @@ logger = logging.getLogger("horovod_tpu")
 
 _MIB = 1024 * 1024
 # candidate grids: log2 bucket bytes 1 MiB..512 MiB × cycle time ms ×
-# response-cache on/off × hierarchical-allreduce on/off (the reference's
-# parameter_manager tunes the same categorical knobs alongside the
-# numeric pair)
+# response-cache on/off × hierarchical-allreduce on/off × quantized-wire
+# compression on/off (the reference's parameter_manager tunes the same
+# categorical knobs alongside the numeric pair)
 _THRESH_GRID = [float(e) for e in range(20, 30)]
 _CYCLE_GRID_MS = [0.0, 0.5, 1.0, 2.0, 5.0, 10.0]
 _BIN = (0.0, 1.0)
 
 
-def _make_grid(cycle_grid, cache_flags=_BIN, hier_flags=_BIN):
+def _make_grid(cycle_grid, cache_flags=_BIN, hier_flags=_BIN,
+               comp_flags=_BIN):
     """Candidate points in normalized coordinates (threshold exponent,
-    cycle index, cache flag, hier flag) — the cycle dim uses its INDEX
-    so the RBF sees uniform spacing despite the geometric ms grid."""
-    return [(t, float(ci), ca, hi) for t in _THRESH_GRID
+    cycle index, cache flag, hier flag, compression flag) — the cycle
+    dim uses its INDEX so the RBF sees uniform spacing despite the
+    geometric ms grid."""
+    return [(t, float(ci), ca, hi, cp) for t in _THRESH_GRID
             for ci in range(len(cycle_grid))
-            for ca in cache_flags for hi in hier_flags]
+            for ca in cache_flags for hi in hier_flags
+            for cp in comp_flags]
 
 
 class _GP:
     """Tiny Gaussian process (RBF kernel) for N-D expected improvement."""
 
-    def __init__(self, length_scales=(1.5, 1.0, 0.6, 0.6),
+    def __init__(self, length_scales=(1.5, 1.0, 0.6, 0.6, 0.6),
                  noise: float = 1e-2):
         self.ls = np.asarray(length_scales)
         self.noise = noise
@@ -128,18 +131,26 @@ class ParameterManager:
         # cache dimension would be inert — pin it off instead of letting
         # the GP converge to a value that cannot take effect; same for the
         # hierarchical flag when the process set has no valid
-        # (groups, group_size) factorization (single host / prime sizes)
+        # (groups, group_size) factorization (single host / prime sizes).
+        # The compression dimension explores only when the operator opted
+        # into a quantized wire (HOROVOD_COMPRESSION != none): the tuner
+        # may turn LOSSY compression off for throughput, but never on —
+        # gradient precision is not its call to make.
         cache_flags = _BIN if cfg.cache_capacity > 0 else (0.0,)
         hier_flags = _BIN if hier_available else (
             1.0 if getattr(cfg, "hierarchical_allreduce", False) else 0.0,)
+        comp_configured = getattr(cfg, "compression", "none") != "none"
+        comp_flags = _BIN if comp_configured else (0.0,)
         self._grid = _make_grid(self._cycle_grid, cache_flags=cache_flags,
-                                hier_flags=hier_flags)
+                                hier_flags=hier_flags,
+                                comp_flags=comp_flags)
         self._current = (math.log2(cfg.fusion_threshold_bytes),
                          float(self._cycle_grid.index(
                              float(cfg.cycle_time_ms))),
                          1.0 if cfg.cache_capacity > 0 else 0.0,
                          1.0 if getattr(cfg, "hierarchical_allreduce",
-                                        False) else 0.0)
+                                        False) else 0.0,
+                         1.0 if comp_configured else 0.0)
         self._sample_bytes = 0
         self._sample_time = 0.0
         self._sample_steps = 0
@@ -150,7 +161,8 @@ class ParameterManager:
         if self._log_file:
             self._log_file.write(
                 "timestamp,fusion_threshold_bytes,cycle_time_ms,"
-                "cache,hierarchical,score_bytes_per_sec,phase\n")
+                "cache,hierarchical,compression,score_bytes_per_sec,"
+                "phase\n")
 
     def current_fusion_threshold(self) -> int:
         return int(2 ** self._current[0])
@@ -163,6 +175,13 @@ class ParameterManager:
 
     def current_hierarchical(self) -> bool:
         return bool(self._current[3])
+
+    def current_compression(self) -> bool:
+        # len guard: a LIVE engine's background loop reads the tuner
+        # between a test pinning _current to a (threshold, cycle) pair
+        # (test_engine_reads_tuned_cycle_time) and restoring it — the
+        # categorical dims must degrade to off, not IndexError, there
+        return bool(self._current[4]) if len(self._current) > 4 else False
 
     @property
     def tuned(self) -> bool:
@@ -197,12 +216,13 @@ class ParameterManager:
                 logger.info(
                     "autotune converged: fusion_threshold=%d bytes "
                     "(%.1f MiB), cycle_time=%.1f ms, cache=%s, "
-                    "hierarchical=%s, score=%.3g B/s",
+                    "hierarchical=%s, compression=%s, score=%.3g B/s",
                     self.current_fusion_threshold(),
                     self.current_fusion_threshold() / _MIB,
                     self.current_cycle_time_ms(),
                     self.current_cache_enabled(),
-                    self.current_hierarchical(), self._best[1])
+                    self.current_hierarchical(),
+                    self.current_compression(), self._best[1])
             else:
                 self._current = self._gp.suggest(self._grid)
         self._log_row(measured, score, phase)
@@ -217,7 +237,7 @@ class ParameterManager:
         cyc = self._cycle_grid[int(point[1])]
         self._log_file.write(
             f"{time.time():.3f},{thr},{cyc:g},{int(point[2])},"
-            f"{int(point[3])},{score:.6g},{phase}\n")
+            f"{int(point[3])},{int(point[4])},{score:.6g},{phase}\n")
         self._log_file.flush()
 
     def _watch_regression(self, nbytes: int, elapsed_s: float):

@@ -114,6 +114,20 @@ class Config:
     # DistributedGradientTransform(sharded_update=None) when axis_name
     # is set; per-chip optimizer state drops to total/N + padding.
     sharded_update: bool = False
+    # negotiated quantized wire format for summable allreduces
+    # (EQuARX-class block-scaled int8/fp8; "none" disables).  Rides every
+    # EntrySig through negotiation, so all processes must configure the
+    # same value; the in-jit DistributedGradientTransform reads it as its
+    # wire_format default (with error feedback), the eager engine applies
+    # it per fused bucket at dispatch.
+    compression: str = "none"
+    # elements per fp32 scale block (wire overhead = 4/block_size B/elem)
+    compression_block_size: int = 256
+    # restrict the quantized wire to the cross-group (DCN) stage of the
+    # hierarchical allreduce — the OptiReduce prescription: compress where
+    # bandwidth is scarcest, keep ICI full-precision.  Off = quantize the
+    # whole fused reduction even on flat (single-stage) meshes.
+    compression_dcn_only: bool = True
 
     @staticmethod
     def from_env() -> "Config":
@@ -178,4 +192,19 @@ class Config:
             "HOROVOD_HIERARCHICAL_ALLGATHER", c.hierarchical_allgather)
         c.sharded_update = _env_bool(
             "HOROVOD_SHARDED_UPDATE", c.sharded_update)
+        c.compression = (_env_str("HOROVOD_COMPRESSION", c.compression)
+                         or "none").strip().lower()
+        from .compression import WIRE_FORMATS
+        if c.compression not in ("none",) + WIRE_FORMATS:
+            raise ValueError(
+                f"HOROVOD_COMPRESSION must be one of "
+                f"{('none',) + WIRE_FORMATS}, got {c.compression!r}")
+        c.compression_block_size = _env_int(
+            "HOROVOD_COMPRESSION_BLOCK_SIZE", c.compression_block_size)
+        if c.compression_block_size <= 0:
+            raise ValueError(
+                f"HOROVOD_COMPRESSION_BLOCK_SIZE must be positive, got "
+                f"{c.compression_block_size}")
+        c.compression_dcn_only = _env_bool(
+            "HOROVOD_COMPRESSION_DCN_ONLY", c.compression_dcn_only)
         return c

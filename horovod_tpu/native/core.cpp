@@ -40,7 +40,7 @@ namespace {
 // ---------------------------------------------------------------------------
 
 struct Sig {
-  std::string name, op_type, reduce_op, dtype;
+  std::string name, op_type, reduce_op, dtype, wire_format;
   std::vector<long long> shape;
   long long ps_id = 0;
   bool stacked = false;
@@ -50,13 +50,22 @@ struct Sig {
   long long nbytes = 0;
 };
 
+// Mirror of fusion._DTYPE_BYTES: unknown dtypes return -1 and the caller
+// raises (parity with the Python planner — a silent 4-byte guess
+// mis-sizes buckets against the fusion threshold).
 int dtype_bytes(const std::string &d) {
-  if (d == "float64" || d == "int64" || d == "uint64") return 8;
+  if (d == "float64" || d == "int64" || d == "uint64" || d == "complex64")
+    return 8;
   if (d == "float32" || d == "int32" || d == "uint32") return 4;
   if (d == "float16" || d == "bfloat16" || d == "int16" || d == "uint16")
     return 2;
-  if (d == "int8" || d == "uint8" || d == "bool") return 1;
-  return 4;
+  if (d == "int8" || d == "uint8" || d == "bool" ||
+      d == "float8_e4m3fn" || d == "float8_e5m2" || d == "float8_e4m3" ||
+      d == "float8_e3m4" || d == "float8_e4m3fnuz" ||
+      d == "float8_e5m2fnuz")
+    return 1;
+  if (d == "complex128") return 16;
+  return -1;
 }
 
 bool get_str_attr(PyObject *o, const char *attr, std::string *out) {
@@ -121,6 +130,7 @@ bool parse_sig(PyObject *o, Sig *s) {
   if (!get_str_attr(o, "op_type", &s->op_type)) return false;
   if (!get_str_attr(o, "reduce_op", &s->reduce_op)) return false;
   if (!get_str_attr(o, "dtype", &s->dtype)) return false;
+  if (!get_str_attr(o, "wire_format", &s->wire_format)) return false;
   if (!get_ll_attr(o, "process_set_id", &s->ps_id)) return false;
   if (!get_bool_attr(o, "stacked", &s->stacked)) return false;
   if (!get_ll_attr(o, "group_id", &s->group_id)) return false;
@@ -146,7 +156,16 @@ bool parse_sig(PyObject *o, Sig *s) {
     numel *= d;
   }
   Py_DECREF(seq);
-  s->nbytes = numel * dtype_bytes(s->dtype);
+  int width = dtype_bytes(s->dtype);
+  if (width < 0) {
+    PyErr_Format(PyExc_ValueError,
+                 "unknown dtype '%s' in fusion planning: add its element "
+                 "width to dtype_bytes (core.cpp) and _DTYPE_BYTES "
+                 "(fusion.py)",
+                 s->dtype.c_str());
+    return false;
+  }
+  s->nbytes = numel * width;
   return true;
 }
 
@@ -172,7 +191,7 @@ bool parse_sigs(PyObject *sigs, std::vector<Sig> *out) {
 
 // Bucket-compatibility key comparison: mirrors EntrySig.bucket_key() tuple
 // ordering (op_type, reduce_op, dtype, process_set_id, stacked,
-// prescale-or-1, postscale-or-1).
+// prescale-or-1, postscale-or-1, wire_format).
 int key_cmp(const Sig &a, const Sig &b) {
   int c = a.op_type.compare(b.op_type);
   if (c) return c;
@@ -184,6 +203,10 @@ int key_cmp(const Sig &a, const Sig &b) {
   if (a.stacked != b.stacked) return a.stacked < b.stacked ? -1 : 1;
   if (a.prescale != b.prescale) return a.prescale < b.prescale ? -1 : 1;
   if (a.postscale != b.postscale) return a.postscale < b.postscale ? -1 : 1;
+  // mixed wire formats must never fuse: a bucket is ONE staged
+  // collective, and a quantized staging cannot carry full-width members
+  c = a.wire_format.compare(b.wire_format);
+  if (c) return c;
   return 0;
 }
 
@@ -460,6 +483,7 @@ std::string cache_key(const std::vector<Sig> &sigs) {
     append_str(&k, s.op_type);
     append_str(&k, s.reduce_op);
     append_str(&k, s.dtype);
+    append_str(&k, s.wire_format);
     append_ll(&k, s.ps_id);
     append_ll(&k, s.stacked ? 1 : 0);
     append_ll(&k, s.group_id);

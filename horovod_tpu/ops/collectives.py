@@ -38,11 +38,114 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..compression import (WireFormat, dequantize_blocks, quantize_blocks,
+                           resolve_wire_format)
 from ..runtime import ReduceOp
 
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
+
+
+def axis_size_p(axis_name: str) -> int:
+    """Static size of a named mapped axis at trace time (0.4.x compat:
+    ``jax.lax.axis_size`` is new; ``jax.core.axis_frame`` returns the
+    size directly on older builds — both are trace-time constants)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return jax.core.axis_frame(axis_name)
+
+
+# ---------------------------------------------------------------------------
+# quantized collective staging (block-scaled int8/fp8 wire formats)
+# ---------------------------------------------------------------------------
+# A plain psum of a quantized payload overflows immediately (two int8
+# summands already exceed the lane), so a quantized reduction is a
+# SCHEDULE REWRITE, not a cast: quantize blocks -> exchange quantized
+# tiles + their fp32 scales (reduce-scatter staged as a tiled all_to_all,
+# all-gather staged as a tiled all_gather) -> dequantize and accumulate
+# in fp32.  Every worker applies the same dequantized tiles (its own tile
+# included, AS QUANTIZED), so replicas stay bit-identical.  EQuARX
+# (arXiv:2506.17615) is the XLA-resident precedent.
+
+
+def quantized_sum_scatter_p(flat, axis_name: str, fmt: WireFormat,
+                            error_feedback: bool = False):
+    """Reduce-scatter of a quantized 1-D buffer, fp32 accumulation.
+
+    ``flat`` is this worker's fp32 contribution, with
+    ``len(flat) % (n * fmt.block_size) == 0`` (callers pad; zero padding
+    quantizes exactly).  Each worker receives every peer's quantized tile
+    for its 1/n slice and accumulates them in fp32 — the wire carries
+    1-byte lanes plus one fp32 scale per block, never a full-width
+    gradient.  Returns ``(tile_sum, residual)`` where ``tile_sum`` is the
+    fp32 SUM tile of length ``len(flat)//n`` and ``residual`` is this
+    worker's local quantization error (``error_feedback=True``) or None.
+    """
+    n = axis_size_p(axis_name)
+    q, s = quantize_blocks(flat, fmt)
+    residual = None
+    if error_feedback:
+        residual = flat.astype(jnp.float32) - dequantize_blocks(q, s, fmt)
+    qx = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                        tiled=True)
+    sx = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
+                        tiled=True)
+    deq = dequantize_blocks(qx, sx, fmt).reshape(n, -1)
+    return jnp.sum(deq, axis=0), residual
+
+
+def quantized_all_gather_p(tile, axis_name: str, fmt: WireFormat):
+    """All-gather of a quantized 1-D tile: every worker receives the same
+    quantized payloads (its own included), so the dequantized full buffer
+    is bit-identical on every replica.  ``len(tile)`` must be a multiple
+    of ``fmt.block_size``.  Gather-side quantization is round-to-nearest
+    without feedback: the value quantized is the already-reduced tile,
+    identical everywhere, so there is no per-worker error to carry."""
+    q, s = quantize_blocks(tile, fmt)
+    qg = lax.all_gather(q, axis_name, tiled=True)
+    sg = lax.all_gather(s, axis_name, tiled=True)
+    return dequantize_blocks(qg, sg, fmt)
+
+
+def quantized_allreduce_p(x, axis_name: str, fmt: WireFormat,
+                          op: str = ReduceOp.SUM, residual=None,
+                          error_feedback: bool = False):
+    """Drop-in for ``psum``(+average) with a quantized wire: RS + AG
+    staging, fp32 accumulation, any input shape (padded internally to a
+    multiple of ``n * fmt.block_size``).
+
+    ``residual`` (optional, same shape as ``x``, fp32) is this worker's
+    carried error-feedback term: it is added to the contribution before
+    quantization, and with ``error_feedback=True`` the new residual
+    (``contribution - dequantized(quantized(contribution))``) is
+    returned.  Returns ``(reduced, new_residual_or_None)``; ``reduced``
+    has ``x``'s shape and dtype.
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"quantized allreduce supports op=Sum/Average, got {op!r}")
+    n = axis_size_p(axis_name)
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    total = flat.shape[0]
+    if residual is not None:
+        flat = flat + residual.reshape(-1).astype(jnp.float32)
+    pad = (-total) % (n * fmt.block_size)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    tile, new_res = quantized_sum_scatter_p(
+        flat, axis_name, fmt, error_feedback=error_feedback)
+    if op == ReduceOp.AVERAGE:
+        tile = tile / n
+    red = quantized_all_gather_p(tile, axis_name, fmt)
+    if pad:
+        red = red[:total]
+        if new_res is not None:
+            new_res = new_res[:total]
+    if new_res is not None:
+        new_res = new_res.reshape(shape)
+    return red.reshape(shape).astype(dtype), new_res
 
 
 def is_stacked(x, ps) -> bool:
@@ -145,26 +248,37 @@ _SUMMABLE = (ReduceOp.SUM, ReduceOp.AVERAGE)
 
 @functools.lru_cache(maxsize=1024)
 def _stacked_allreduce_fn(mesh_key, axis, op, n, shapes, dtypes,
-                          has_prescale, has_postscale, fuse):
+                          has_prescale, has_postscale, fuse,
+                          wire_format="none", wire_block=0):
     """Fused allreduce of stacked arrays: one psum per bucket.
 
     ``shapes``/``dtypes`` describe each array *without* the leading worker
     dim.  Returns a jitted fn ``f(prescale, postscale, *arrays) -> tuple``.
+    ``wire_format != "none"`` replaces the fused psum with the quantized
+    RS+AG staging (``quantized_allreduce_p``) — only reachable when
+    HOROVOD_COMPRESSION_DCN_ONLY is off, since a flat mesh has no
+    separate DCN stage to restrict to.
     """
     mesh = _MESHES[mesh_key]
+    fmt = resolve_wire_format(wire_format, wire_block or None)
 
     def shard_fn(prescale, postscale, *xs):
         # each shard arrives as [1, ...]; drop the worker dim
         locals_ = [x[0] for x in xs]
         if has_prescale:
             locals_ = [x * prescale.astype(x.dtype) for x in locals_]
-        if fuse and op in _SUMMABLE and len(locals_) > 1:
+        if fuse and op in _SUMMABLE and (len(locals_) > 1
+                                         or fmt is not None):
             # fusion buffer: flatten-concat → ONE psum → split (SURVEY §5.8)
             sizes = [int(np.prod(s)) if s else 1 for s in shapes]
-            flat = jnp.concatenate([x.reshape(-1) for x in locals_])
-            red = lax.psum(flat, axis)
-            if op == ReduceOp.AVERAGE:
-                red = red / n
+            flat = (jnp.concatenate([x.reshape(-1) for x in locals_])
+                    if len(locals_) > 1 else locals_[0].reshape(-1))
+            if fmt is not None:
+                red, _ = quantized_allreduce_p(flat, axis, fmt, op=op)
+            else:
+                red = lax.psum(flat, axis)
+                if op == ReduceOp.AVERAGE:
+                    red = red / n
             outs = []
             offset = 0
             for s, sz in zip(shapes, sizes):
@@ -211,18 +325,23 @@ def _replicated_allreduce_fn(mesh_key, op, n, nshapes,
 
 @functools.lru_cache(maxsize=1024)
 def _hier_allreduce_fn(mesh_key, axis, op, n, shapes, n_groups, group,
-                       has_prescale, has_postscale):
+                       has_prescale, has_postscale,
+                       wire_format="none", wire_block=0):
     """Two-stage hierarchical allreduce (reference:
     NCCLHierarchicalAllreduce, SURVEY §5.8): reduce-scatter within the
     group (ICI), allreduce the 1/group-size chunk across groups (DCN),
     all-gather within the group — DCN bytes drop by the group size.
 
     The worker mesh is viewed as 2-D (groups × group); the stacked dim
-    shards over both axes, process-major.
+    shards over both axes, process-major.  ``wire_format != "none"``
+    quantizes the cross-group (DCN) stage only — block-scaled tiles +
+    scales instead of a full-width psum — the negotiated per-bucket wire
+    format under its HOROVOD_COMPRESSION_DCN_ONLY default.
     """
     mesh1d = _MESHES[mesh_key]
     devs = np.asarray(mesh1d.devices).reshape(n_groups, group)
     mesh = jax.sharding.Mesh(devs, ("hvd_cross", "hvd_local"))
+    fmt = resolve_wire_format(wire_format, wire_block or None)
 
     def shard_fn(prescale, postscale, *xs):
         locals_ = [x[0] for x in xs]  # [1, ...] shard → drop worker dim
@@ -240,7 +359,11 @@ def _hier_allreduce_fn(mesh_key, axis, op, n, shapes, n_groups, group,
         chunk = lax.psum_scatter(flat, "hvd_local", scatter_dimension=0,
                                  tiled=True)
         # stage 2 (DCN): allreduce the chunk across groups
-        chunk = lax.psum(chunk, "hvd_cross")
+        if fmt is not None:
+            chunk, _ = quantized_allreduce_p(chunk, "hvd_cross", fmt,
+                                             op=ReduceOp.SUM)
+        else:
+            chunk = lax.psum(chunk, "hvd_cross")
         # stage 3 (ICI): regather the full vector within the group
         red = lax.all_gather(chunk, "hvd_local", tiled=True)
         if pad:
@@ -408,8 +531,18 @@ def _scale_arg(v) -> Tuple[jnp.ndarray, bool]:
 
 def allreduce_arrays(arrays: List, ps, op: str = ReduceOp.AVERAGE,
                      prescale_factor=None, postscale_factor=None,
-                     stacked: Optional[bool] = None) -> List:
-    """Fused allreduce of a list of arrays over a process set (one bucket)."""
+                     stacked: Optional[bool] = None,
+                     wire_format: str = "none",
+                     wire_block: int = 0) -> List:
+    """Fused allreduce of a list of arrays over a process set (one bucket).
+
+    ``wire_format`` is the bucket's negotiated quantized wire format
+    ("none" = full width): on the hierarchical path it quantizes the
+    cross-group (DCN) stage; on the flat stacked path it quantizes the
+    whole fused reduction (only requested when the DCN-only policy is
+    off).  The replicated no-communication path ignores it — there are
+    no wire bytes to shrink.
+    """
     if op == ReduceOp.ADASUM:
         from .adasum import adasum_arrays
         return adasum_arrays(arrays, ps, prescale_factor, postscale_factor)
@@ -429,6 +562,8 @@ def allreduce_arrays(arrays: List, ps, op: str = ReduceOp.AVERAGE,
         shapes = tuple(tuple(a.shape[1:]) for a in arrays)
         dtypes = tuple(str(a.dtype) for a in arrays)
         fuse = len(set(dtypes)) == 1
+        if op not in _SUMMABLE or not fuse:
+            wire_format = "none"
         hier = None
         if op in _SUMMABLE and fuse:
             from .. import runtime
@@ -444,11 +579,11 @@ def allreduce_arrays(arrays: List, ps, op: str = ReduceOp.AVERAGE,
         if hier is not None:
             fn = _hier_allreduce_fn(
                 mesh_key(ps), ps.axis, op, n, shapes, hier[0], hier[1],
-                has_pre, has_post)
+                has_pre, has_post, wire_format, wire_block)
         else:
             fn = _stacked_allreduce_fn(
                 mesh_key(ps), ps.axis, op, n, shapes, dtypes, has_pre,
-                has_post, fuse)
+                has_post, fuse, wire_format, wire_block)
     else:
         fn = _replicated_allreduce_fn(
             mesh_key(ps), op, n, len(arrays), has_pre, has_post)
@@ -639,13 +774,22 @@ def reducescatter_p(x, axis_name: str, op: str = ReduceOp.AVERAGE):
 
 
 def hierarchical_allreduce_p(x, cross_axis: str, local_axis: str,
-                             op: str = ReduceOp.AVERAGE):
+                             op: str = ReduceOp.AVERAGE,
+                             wire_format=None):
     """Traceable two-stage allreduce over a (cross, local) mesh factoring
     (reference: NCCLHierarchicalAllreduce; SURVEY §5.8 ICI/DCN analog):
     reduce-scatter over ``local_axis`` (ICI), psum the chunk over
     ``cross_axis`` (DCN), all-gather over ``local_axis`` — cross-axis
-    bytes drop by the local axis size."""
-    group = lax.axis_size(local_axis)
+    bytes drop by the local axis size.
+
+    ``wire_format`` (a name or :class:`~..compression.WireFormat`)
+    additionally quantizes the CROSS stage only: the chunk crosses DCN as
+    block-scaled int8/fp8 tiles + fp32 scales (quantize → exchange →
+    dequantize-accumulate staging), dropping cross-host bytes another
+    ~4x, while the ICI stages stay full-precision — the OptiReduce
+    prescription (compress where bandwidth is scarcest)."""
+    fmt = resolve_wire_format(wire_format)
+    group = axis_size_p(local_axis)
     shape = x.shape
     flat = x.reshape(-1)
     pad = (-flat.shape[0]) % group
@@ -653,10 +797,14 @@ def hierarchical_allreduce_p(x, cross_axis: str, local_axis: str,
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
     chunk = lax.psum_scatter(flat, local_axis, scatter_dimension=0,
                              tiled=True)
-    chunk = lax.psum(chunk, cross_axis)
+    if fmt is not None:
+        chunk, _ = quantized_allreduce_p(chunk, cross_axis, fmt,
+                                         op=ReduceOp.SUM)
+    else:
+        chunk = lax.psum(chunk, cross_axis)
     red = lax.all_gather(chunk, local_axis, tiled=True)
     if pad:
         red = red[:flat.shape[0] - pad]
     if op == ReduceOp.AVERAGE:
-        red = red / (group * lax.axis_size(cross_axis))
+        red = red / (group * axis_size_p(cross_axis))
     return red.reshape(shape)

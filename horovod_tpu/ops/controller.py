@@ -241,10 +241,15 @@ def entry_token(entry) -> str:
             shape[0] = -1
         return shape
 
+    # field 10 (wire_format) is the negotiated quantized wire: two
+    # processes configured with different HOROVOD_COMPRESSION values
+    # produce different tokens and fail the round as a detected
+    # divergence instead of disagreeing about the bytes on the wire
     sigs = [[s.name, s.op_type, s.reduce_op, s.dtype, wire_shape(s),
              s.process_set_id, bool(s.stacked),
              -1 if s.group_id == -1 else 0,
-             s.prescale, s.postscale] for s in entry.sigs()]
+             s.prescale, s.postscale, s.wire_format]
+            for s in entry.sigs()]
     splits = (None if entry.splits is None
               else [int(x) for x in entry.splits])
     return json.dumps({"s": sigs, "r": int(entry.root_rank), "sp": splits},

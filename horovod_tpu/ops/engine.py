@@ -61,6 +61,15 @@ _m_fusion_util = _metrics.histogram(
 _m_plan_cache = _metrics.counter(
     "hvd_response_cache_total",
     "Fusion-plan (response) cache lookups", labels=("result",))
+_m_wire_bytes = _metrics.counter(
+    "hvd_wire_bytes_total",
+    "Collective payload bytes at the wire format the fused dispatch "
+    "applied (quantized formats count 1-byte lanes + fp32 block scales)",
+    labels=("format",))
+_m_wire_ratio = _metrics.gauge(
+    "hvd_wire_compression_ratio",
+    "Raw payload bytes / wire bytes of the last quantized fused dispatch",
+    labels=("format",))
 
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -71,12 +80,13 @@ class TensorTableEntry:
     __slots__ = ("name", "op_type", "reduce_op", "arrays", "process_set",
                  "prescale", "postscale", "root_rank", "splits", "stacked",
                  "handle", "enqueue_time", "group_id", "callback",
-                 "peer_rows")
+                 "peer_rows", "wire_format")
 
     def __init__(self, name, op_type, arrays, process_set,
                  reduce_op=ReduceOp.AVERAGE, prescale=None, postscale=None,
                  root_rank=0, splits=None, stacked=None, group_id=-1,
-                 callback: Optional[Callable] = None):
+                 callback: Optional[Callable] = None,
+                 wire_format: str = "none"):
         self.name = name
         self.op_type = op_type
         self.arrays = arrays
@@ -93,8 +103,16 @@ class TensorTableEntry:
         self.callback = callback
         # Allgatherv: per-array (procs, sizes) agreed by negotiation
         self.peer_rows: Optional[dict] = None
+        # REQUESTED quantized wire format (HOROVOD_COMPRESSION; set by
+        # engine.submit); sigs() narrows it per array to "none" where it
+        # cannot apply (non-summable op, non-quantizable dtype)
+        self.wire_format = wire_format
 
     def sigs(self) -> List[EntrySig]:
+        from ..compression import quantizable
+        fmt_ok = (self.wire_format != "none"
+                  and self.op_type == "allreduce"
+                  and self.reduce_op in (ReduceOp.SUM, ReduceOp.AVERAGE))
         out = []
         for i, a in enumerate(self.arrays):
             stacked = (self.stacked if self.stacked is not None
@@ -110,7 +128,10 @@ class TensorTableEntry:
                 prescale=(None if self.prescale is None
                           else float(self.prescale)),
                 postscale=(None if self.postscale is None
-                           else float(self.postscale))))
+                           else float(self.postscale)),
+                wire_format=(self.wire_format
+                             if fmt_ok and quantizable(a.dtype)
+                             else "none")))
         return out
 
 
@@ -267,6 +288,12 @@ class CollectiveEngine:
             return self._group_counter
 
     def submit(self, entry: TensorTableEntry) -> Handle:
+        # stamp the job-wide negotiated wire format (HOROVOD_COMPRESSION)
+        # at submission: it rides the entry's signatures through the
+        # negotiation token, so a config mismatch between processes is a
+        # detected divergence instead of a silent wire disagreement
+        if self.cfg is not None and entry.wire_format == "none":
+            entry.wire_format = getattr(self.cfg, "compression", "none")
         # a grouped entry ALWAYS resolves to a list, even with one
         # member — grouped_* callers zip the result against their input
         # list, and a bare array would be iterated element-wise
@@ -447,7 +474,8 @@ class CollectiveEngine:
                 params = {"t": self.autotuner.current_fusion_threshold(),
                           "c": self.autotuner.current_cycle_time_ms(),
                           "ca": self.autotuner.current_cache_enabled(),
-                          "hi": self.autotuner.current_hierarchical()}
+                          "hi": self.autotuner.current_hierarchical(),
+                          "cp": self.autotuner.current_compression()}
             # Allgatherv row counts ride the round (reference: the
             # controller's tensor-size gathering): dim 0 is wildcarded
             # out of the allgather match identity, so each member
@@ -550,7 +578,11 @@ class CollectiveEngine:
             reduce_op=sigs[0][2],
             prescale=sigs[0][8], postscale=sigs[0][9],
             root_rank=fields["r"], splits=fields["sp"], stacked=False,
-            group_id=self.next_group_id() if len(sigs) > 1 else -1)
+            group_id=self.next_group_id() if len(sigs) > 1 else -1,
+            # the peers' negotiated wire format (token field 10; tolerate
+            # old-format tokens without it)
+            wire_format=next((s[10] for s in sigs
+                              if len(s) > 10 and s[10] != "none"), "none"))
         entry.handle = Handle(
             entry.name, single=(len(arrays) == 1
                                 and entry.group_id == -1))
@@ -760,6 +792,37 @@ class CollectiveEngine:
             return self.autotuner.current_hierarchical()
         return self.cfg.hierarchical_allreduce
 
+    def _compression_enabled(self) -> bool:
+        """Whether the tuned/negotiated toggle permits the configured
+        quantized wire format this cycle (the format itself is the
+        static HOROVOD_COMPRESSION config riding every signature)."""
+        configured = getattr(self.cfg, "compression", "none") != "none"
+        if not configured:
+            return False
+        if self.autotuner is not None:
+            if self._controller is not None and self._controller.enabled:
+                if self._negotiated_params is not None:
+                    return bool(self._negotiated_params.get(
+                        "cp", configured))
+                return configured
+            return self.autotuner.current_compression()
+        return configured
+
+    def _bucket_wire_format(self, first_sig, ps) -> str:
+        """Effective wire format of one fused dispatch: the bucket's
+        negotiated format, gated by the tuner toggle, the DCN-only
+        policy (a flat mesh has no DCN stage to restrict to), and the
+        no-communication replicated path (no wire bytes to shrink)."""
+        fmt = first_sig.wire_format
+        if fmt == "none" or not self._compression_enabled():
+            return "none"
+        if not first_sig.stacked and not collectives.spans_processes(ps):
+            return "none"   # replicated: computed locally, nothing sent
+        if getattr(self.cfg, "compression_dcn_only", True):
+            if not self._hierarchical_enabled() or ps.hier_shape() is None:
+                return "none"
+        return fmt
+
     # -- dispatch -----------------------------------------------------------
     def _dispatch_bucket(self, entries, sigs, owner, base, bucket, results):
         first = sigs[bucket[0]]
@@ -772,6 +835,35 @@ class CollectiveEngine:
                 # fusion efficiency: how full the bucket ran relative to
                 # the threshold the planner packed against
                 _m_fusion_util.observe(nbytes / self._last_threshold)
+            # wire accounting: bytes at the format each STAGE of this
+            # dispatch actually applies (quantized = 1-byte lanes + fp32
+            # block scales).  Under the DCN-only policy only the
+            # cross-group chunk (1/group of the payload) is quantized —
+            # the ICI stages stay in the full-width family, so the int8
+            # series never overstates what crossed the wire compressed.
+            eff = "none"
+            ps = entries[owner[bucket[0]]].process_set
+            if op_type == "allreduce":
+                eff = self._bucket_wire_format(first, ps)
+            if eff == "none":
+                _m_wire_bytes.inc(nbytes, format=str(first.dtype))
+            else:
+                from ..compression import resolve_wire_format
+                wfmt = resolve_wire_format(
+                    eff, getattr(self.cfg, "compression_block_size", None))
+                total_numel = sum(sigs[si].numel for si in bucket)
+                q_numel = total_numel
+                if getattr(self.cfg, "compression_dcn_only", True):
+                    hier = ps.hier_shape()
+                    if hier is not None:
+                        q_numel = -(-total_numel // hier[1])
+                wire = wfmt.wire_nbytes(q_numel)
+                raw_q = (q_numel * nbytes) // max(total_numel, 1)
+                _m_wire_bytes.inc(wire, format=eff)
+                if nbytes > raw_q:
+                    _m_wire_bytes.inc(nbytes - raw_q,
+                                      format=str(first.dtype))
+                _m_wire_ratio.set(raw_q / max(wire, 1), format=eff)
         # profiler range per fused dispatch (reference: nvtx_op_range.cc —
         # the NVTX analog; lands inside any active jax.profiler trace so
         # framework spans merge with the XLA device trace, SURVEY §5.1)
@@ -798,7 +890,9 @@ class CollectiveEngine:
             outs = collectives.allreduce_arrays(
                 arrays, e0.process_set, op=first.reduce_op,
                 prescale_factor=e0.prescale, postscale_factor=e0.postscale,
-                stacked=first.stacked)
+                stacked=first.stacked,
+                wire_format=self._bucket_wire_format(first, e0.process_set),
+                wire_block=getattr(self.cfg, "compression_block_size", 0))
             for si, o in zip(bucket, outs):
                 results[si] = o
         else:
@@ -843,6 +937,7 @@ class CollectiveEngine:
                 "cycle_time_ms": self._cycle_time_s() * 1000.0,
                 "cache_enabled": self._cache_enabled(),
                 "hierarchical": self._hierarchical_enabled(),
+                "compression": self._compression_enabled(),
                 "tuned": self.autotuner.tuned,
                 "retunes": getattr(self.autotuner, "retunes", 0),
                 "negotiated": self._negotiated_params is not None,

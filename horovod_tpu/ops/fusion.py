@@ -24,11 +24,29 @@ _DTYPE_BYTES = {
     "float32": 4, "float64": 8, "float16": 2, "bfloat16": 2,
     "int8": 1, "uint8": 1, "int16": 2, "uint16": 2,
     "int32": 4, "uint32": 4, "int64": 8, "uint64": 8, "bool": 1,
+    # fp8 wire/storage dtypes (quantized collectives; EQuARX-class wire
+    # formats) — these used to fall through to the 4-byte guess, which
+    # mis-sized buckets 4x against HOROVOD_FUSION_THRESHOLD
+    "float8_e4m3fn": 1, "float8_e5m2": 1, "float8_e4m3": 1,
+    "float8_e3m4": 1, "float8_e4m3fnuz": 1, "float8_e5m2fnuz": 1,
+    "complex64": 8, "complex128": 16,
 }
 
 
 def dtype_nbytes(dtype: str) -> int:
-    return _DTYPE_BYTES.get(str(dtype), 4)
+    """Element width the planner packs buckets with.
+
+    Unknown dtypes RAISE instead of guessing 4 bytes: a silent guess
+    mis-sizes every bucket holding that dtype against the fusion
+    threshold (and did, for fp8, until the entries above were added).
+    """
+    try:
+        return _DTYPE_BYTES[str(dtype)]
+    except KeyError:
+        raise ValueError(
+            f"unknown dtype {dtype!r} in fusion planning: add its element "
+            f"width to ops.fusion._DTYPE_BYTES (guessing would mis-size "
+            f"buckets against HOROVOD_FUSION_THRESHOLD)") from None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,6 +69,12 @@ class EntrySig:
     # different prescale/postscale must not share one fused collective
     prescale: Optional[float] = None
     postscale: Optional[float] = None
+    # negotiated quantized wire format ("none" = full-width).  A fused
+    # bucket is ONE staged collective, so mixed-format entries must
+    # never share a bucket; the field rides the negotiation token like
+    # every other signature field, and being part of the (astuple)
+    # ResponseCache key it invalidates cached plans on a format change.
+    wire_format: str = "none"
 
     @property
     def numel(self) -> int:
@@ -68,7 +92,8 @@ class EntrySig:
         return (self.op_type, self.reduce_op, self.dtype,
                 self.process_set_id, self.stacked,
                 1.0 if self.prescale is None else self.prescale,
-                1.0 if self.postscale is None else self.postscale)
+                1.0 if self.postscale is None else self.postscale,
+                self.wire_format)
 
 
 def plan_fusion(entries: Sequence[EntrySig],
@@ -185,19 +210,23 @@ class BucketLayout:
 
 def plan_bucket_layouts(entries: Sequence[EntrySig],
                         buckets: Sequence[Sequence[int]],
-                        shards: int) -> List[BucketLayout]:
+                        shards: int, align: int = 1) -> List[BucketLayout]:
     """Compute the padded flat-buffer layout of every planned bucket.
 
     ``buckets`` is ``plan_fusion`` output over ``entries``; ``shards`` is
     the mesh-axis size the buckets will be reduce-scattered over.  The
     layout is pure plan metadata (trace-time only) — the bucketing itself
     is unchanged, keeping the single cross-process ordering contract.
+
+    ``align`` > 1 additionally makes every shard a multiple of ``align``
+    elements (pad to ``shards * align``): the quantized wire path needs
+    block-aligned tiles so per-block scales route with their blocks.
     """
     layouts: List[BucketLayout] = []
     for bucket in buckets:
         sizes = tuple(entries[i].numel for i in bucket)
         numel = sum(sizes)
-        padded = pad_to_multiple(numel, shards)
+        padded = pad_to_multiple(numel, shards * max(int(align), 1))
         layouts.append(BucketLayout(
             indices=tuple(bucket), sizes=sizes, numel=numel,
             padded_numel=padded, shard_numel=padded // shards))

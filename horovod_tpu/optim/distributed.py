@@ -36,19 +36,16 @@ import jax.numpy as jnp
 import optax
 
 from .. import runtime
-from ..compression import Compression
+from ..compression import Compression, resolve_wire_format
 from ..runtime import ReduceOp
 
 
 def _axis_size(axis_name: str):
-    """Static size of a named mapped axis at trace time.
-
-    ``jax.lax.axis_size`` only exists on newer jax; on 0.4.x
-    ``jax.core.axis_frame(name)`` returns the size directly.  Both are
-    trace-time constants, so the jaxpr is identical either way."""
-    if hasattr(jax.lax, "axis_size"):
-        return jax.lax.axis_size(axis_name)
-    return jax.core.axis_frame(axis_name)
+    """Static size of a named mapped axis at trace time (delegates to
+    the one version shim, ``ops.collectives.axis_size_p``; import is
+    lazy to keep this module importable without the kernel module)."""
+    from ..ops.collectives import axis_size_p
+    return axis_size_p(axis_name)
 
 
 def _psum_scatter(x, axis_name: str):
@@ -93,7 +90,8 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
                       threshold_bytes: Optional[int] = None,
                       compression=Compression.none,
                       prescale_factor: float = 1.0,
-                      postscale_factor: float = 1.0):
+                      postscale_factor: float = 1.0,
+                      wire_format=None, residual=None):
     """Reduce a gradient pytree across ``axis_name`` with bucket fusion.
 
     The in-jit analog of the reference's fusion buffer: leaves are bucketed
@@ -106,16 +104,32 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
     traced under a ``jax.named_scope("hvd_bucket<i>")`` so the static
     schedule extractor (``tools/hvdsched``, ``analysis/schedule.py``) can
     attribute every ``psum`` in the jaxpr to its fusion bucket.
+
+    ``wire_format`` (a name or :class:`~..compression.WireFormat`)
+    switches every bucket from the full-width psum to the block-scaled
+    quantized staging (``ops.collectives.quantized_allreduce_p``):
+    quantize → exchange tiles + scales → dequantize-accumulate in fp32.
+    ``residual`` is the grads-shaped error-feedback tree (this worker's
+    carried quantization error, fp32; None = zeros); when a wire format
+    is active the return value becomes ``(reduced_tree, new_residual)``.
     """
     threshold_bytes = _resolve_threshold(threshold_bytes)
+    fmt = resolve_wire_format(wire_format)
     leaves, _names, order = _tree_leaves_sorted(grads)
     if not leaves:
         # an empty gradient pytree has nothing to reduce on ANY op path;
         # return it unchanged rather than handing None to a collective
-        return grads
+        return grads if fmt is None else (grads, residual)
     treedef = jax.tree_util.tree_structure(grads)
 
     if op == ReduceOp.ADASUM:
+        if fmt is not None:
+            raise ValueError(
+                "wire_format quantization is not supported with "
+                "op=Adasum: the recursive pairwise dot products operate "
+                "on the exact local gradients and are not expressible as "
+                "a quantize-exchange-accumulate staging — use "
+                "op=Average/Sum with a wire format, or Adasum full-width")
         if compression not in (None, Compression.none):
             raise ValueError(
                 "compression is not supported with op=Adasum: the "
@@ -140,21 +154,41 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
         return jax.tree_util.tree_unflatten(
             treedef, _restore_order(out, order))
 
-    buckets, _sigs = _plan_buckets(leaves, _names, op, prescale_factor,
-                                   postscale_factor, threshold_bytes)
+    if fmt is not None and compression not in (None, Compression.none):
+        raise ValueError(
+            "wire_format and compression are two definitions of the same "
+            "wire: pick the block-scaled quantized format OR the cast "
+            "compressor, not both")
 
+    buckets, _sigs = _plan_buckets(leaves, _names, op, prescale_factor,
+                                   postscale_factor, threshold_bytes,
+                                   wire_format=fmt.name if fmt else "none")
+
+    res_leaves = _residual_leaves(residual, leaves) if fmt is not None \
+        else None
     out = [None] * len(leaves)
+    new_res = [None] * len(leaves) if fmt is not None else None
     for bucket_id, bucket in enumerate(buckets):
         with jax.named_scope(f"hvd_bucket{bucket_id}"):
             parts = [leaves[i].reshape(-1) for i in bucket]
             buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
             if prescale_factor != 1.0:
                 buf = buf * jnp.asarray(prescale_factor, buf.dtype)
-            wire, ctx = compression.compress(buf)
-            red = jax.lax.psum(wire, axis_name)
-            red = compression.decompress(red, ctx)
-            if op == ReduceOp.AVERAGE:
-                red = red / _axis_size(axis_name)
+            if fmt is not None and _sigs[bucket[0]].wire_format != "none":
+                from ..ops.collectives import quantized_allreduce_p
+                rparts = [res_leaves[i].reshape(-1) for i in bucket]
+                rbuf = (jnp.concatenate(rparts) if len(rparts) > 1
+                        else rparts[0])
+                red, nres = quantized_allreduce_p(
+                    buf, axis_name, fmt, op=op, residual=rbuf,
+                    error_feedback=True)
+            else:
+                wire, ctx = compression.compress(buf)
+                red = jax.lax.psum(wire, axis_name)
+                red = compression.decompress(red, ctx)
+                if op == ReduceOp.AVERAGE:
+                    red = red / _axis_size(axis_name)
+                nres = None
             if postscale_factor != 1.0:
                 red = red * jnp.asarray(postscale_factor, red.dtype)
             off = 0
@@ -162,9 +196,35 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
                 sz = leaves[i].size
                 out[i] = jax.lax.slice_in_dim(red, off, off + sz).reshape(
                     leaves[i].shape)
+                if new_res is not None:
+                    # non-quantizable buckets under a quantized transform
+                    # carry their (zero) residual through unchanged
+                    new_res[i] = (jax.lax.slice_in_dim(
+                        nres, off, off + sz).reshape(leaves[i].shape)
+                        if nres is not None else res_leaves[i])
                 off += sz
     # out is in path-sorted leaf order; restore original leaf order
-    return jax.tree_util.tree_unflatten(treedef, _restore_order(out, order))
+    reduced = jax.tree_util.tree_unflatten(
+        treedef, _restore_order(out, order))
+    if fmt is None:
+        return reduced
+    return reduced, jax.tree_util.tree_unflatten(
+        treedef, _restore_order(new_res, order))
+
+
+def _residual_leaves(residual, leaves):
+    """Path-sorted fp32 error-feedback leaves aligned with ``leaves``
+    (None → zeros: the first quantized step starts with no carried
+    error)."""
+    if residual is None:
+        return [jnp.zeros(l.shape, jnp.float32) for l in leaves]
+    r_leaves, _names, _order = _tree_leaves_sorted(residual)
+    if len(r_leaves) != len(leaves):
+        raise ValueError(
+            f"error-feedback residual tree has {len(r_leaves)} leaves "
+            f"for {len(leaves)} gradient leaves — the residual must be "
+            f"carried from the previous step's return of the same tree")
+    return r_leaves
 
 
 def _restore_order(sorted_leaves, order):
@@ -185,18 +245,21 @@ def _resolve_threshold(threshold_bytes: Optional[int]) -> int:
 
 
 def _plan_buckets(leaves, names, op, prescale_factor, postscale_factor,
-                  threshold_bytes):
+                  threshold_bytes, wire_format: str = "none"):
     """One planner for both worlds: leaves become EntrySigs (name = the
     sorted pytree path, the controller's total order) and the eager
     engine's ``plan_fusion`` decides the buckets.  Within one dtype the
     path-sorted leaf order IS the planner's name order, so this is the
     plan every process computes."""
+    from ..compression import quantizable
     from ..ops.fusion import EntrySig, plan_fusion
     sigs = [EntrySig(name=names[i], op_type="allreduce",
                      reduce_op=str(op), dtype=str(leaves[i].dtype),
                      shape=tuple(leaves[i].shape), process_set_id=0,
                      stacked=False, prescale=prescale_factor,
-                     postscale=postscale_factor)
+                     postscale=postscale_factor,
+                     wire_format=(wire_format if quantizable(leaves[i].dtype)
+                                  else "none"))
             for i in range(len(leaves))]
     return plan_fusion(sigs, threshold_bytes), sigs
 
@@ -220,12 +283,14 @@ class ShardedLayout(NamedTuple):
 
 
 def _sharded_layout(tree, axis_size: int, op, prescale_factor,
-                    postscale_factor, threshold_bytes):
+                    postscale_factor, threshold_bytes, align: int = 1):
     """Plan the bucket/padding layout of ``tree`` for an ``axis_size``-way
     reduce-scatter — the SAME ``plan_fusion`` buckets as the replicated
     path (one cross-process ordering contract), plus per-bucket padding
-    to a multiple of ``axis_size``.  Returns ``(sorted_leaves, layout)``
-    so callers reuse the single path walk."""
+    to a multiple of ``axis_size`` (times ``align``: the quantized wire
+    needs block-aligned shards so per-block scales route with their
+    blocks).  Returns ``(sorted_leaves, layout)`` so callers reuse the
+    single path walk."""
     from ..ops.fusion import plan_bucket_layouts
     leaves, names, order = _tree_leaves_sorted(tree)
     buckets, sigs = _plan_buckets(leaves, names, op, prescale_factor,
@@ -233,7 +298,8 @@ def _sharded_layout(tree, axis_size: int, op, prescale_factor,
     return leaves, ShardedLayout(
         treedef=jax.tree_util.tree_structure(tree), order=tuple(order),
         shapes=tuple(tuple(l.shape) for l in leaves),
-        buckets=tuple(plan_bucket_layouts(sigs, buckets, axis_size)))
+        buckets=tuple(plan_bucket_layouts(sigs, buckets, axis_size,
+                                          align=align)))
 
 
 def _bucket_flat(leaves, bl):
@@ -272,7 +338,8 @@ def fused_reduce_scatter_tree(grads, axis_name: str,
                               threshold_bytes: Optional[int] = None,
                               compression=Compression.none,
                               prescale_factor: float = 1.0,
-                              postscale_factor: float = 1.0):
+                              postscale_factor: float = 1.0,
+                              wire_format=None, residual=None):
     """Reduce-scatter a gradient pytree: each worker keeps 1/N per bucket.
 
     The sharded-update half of ``fused_reduce_tree``: the SAME
@@ -285,6 +352,16 @@ def fused_reduce_scatter_tree(grads, axis_name: str,
     1/N-sized array per planned bucket (this worker's tile, fully scaled
     and averaged), ``layout`` is the static slice metadata
     ``all_gather_sharded_tree`` / ``shard_tree_like`` consume.
+
+    ``wire_format`` quantizes the gradient reduce-scatter (block-scaled
+    tiles + scales, fp32 accumulation) with error feedback: ``residual``
+    is the grads-shaped carried-error tree (None = zeros) and the return
+    becomes ``(shards, layout, new_residual)``.  Bucket padding grows to
+    a multiple of ``n * block_size`` so tiles stay block-aligned — the
+    sharded state layout therefore depends on the wire format.  The
+    updates all-gather (``all_gather_sharded_tree``) stays full-width:
+    it carries optimizer OUTPUT, which has no error-feedback state to
+    absorb quantization bias.
     """
     if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
         raise ValueError(
@@ -292,28 +369,56 @@ def fused_reduce_scatter_tree(grads, axis_name: str,
             f"{op!r}: Adasum and min/max reductions are not expressible "
             f"as a reduce-scatter of bucket tiles")
     threshold_bytes = _resolve_threshold(threshold_bytes)
+    fmt = resolve_wire_format(wire_format)
+    if fmt is not None and compression not in (None, Compression.none):
+        raise ValueError(
+            "wire_format and compression are two definitions of the same "
+            "wire: pick the block-scaled quantized format OR the cast "
+            "compressor, not both")
     if not jax.tree_util.tree_leaves(grads):
-        return (), ShardedLayout(
+        empty = ((), ShardedLayout(
             treedef=jax.tree_util.tree_structure(grads), order=(),
-            shapes=(), buckets=())
+            shapes=(), buckets=()))
+        return empty if fmt is None else empty + (residual,)
     n = _axis_size(axis_name)
     leaves, layout = _sharded_layout(grads, n, op, prescale_factor,
-                                     postscale_factor, threshold_bytes)
+                                     postscale_factor, threshold_bytes,
+                                     align=fmt.block_size if fmt else 1)
+    res_leaves = _residual_leaves(residual, leaves) if fmt is not None \
+        else None
+    new_res = [None] * len(leaves) if fmt is not None else None
     shards = []
     for bucket_id, bl in enumerate(layout.buckets):
         with jax.named_scope(f"hvd_bucket{bucket_id}"):
             buf = _bucket_flat(leaves, bl)
             if prescale_factor != 1.0:
                 buf = buf * jnp.asarray(prescale_factor, buf.dtype)
-            wire, ctx = compression.compress(buf)
-            tile = _psum_scatter(wire, axis_name)
-            tile = compression.decompress(tile, ctx)
+            if fmt is not None:
+                from ..ops.collectives import quantized_sum_scatter_p
+                rbuf = _bucket_flat(res_leaves, bl).astype(jnp.float32)
+                tile, nres = quantized_sum_scatter_p(
+                    buf.astype(jnp.float32) + rbuf, axis_name, fmt,
+                    error_feedback=True)
+                tile = tile.astype(buf.dtype)
+                off = 0
+                for i in bl.indices:
+                    sz = leaves[i].size
+                    new_res[i] = jax.lax.slice_in_dim(
+                        nres, off, off + sz).reshape(leaves[i].shape)
+                    off += sz
+            else:
+                wire, ctx = compression.compress(buf)
+                tile = _psum_scatter(wire, axis_name)
+                tile = compression.decompress(tile, ctx)
             if op == ReduceOp.AVERAGE:
                 tile = tile / n
             if postscale_factor != 1.0:
                 tile = tile * jnp.asarray(postscale_factor, tile.dtype)
             shards.append(tile)
-    return tuple(shards), layout
+    if fmt is None:
+        return tuple(shards), layout
+    return tuple(shards), layout, jax.tree_util.tree_unflatten(
+        layout.treedef, _restore_order(new_res, list(layout.order)))
 
 
 def all_gather_sharded_tree(shards, layout: ShardedLayout, axis_name: str):
@@ -347,10 +452,34 @@ def _sharded_update_default() -> bool:
     return _env_bool("HOROVOD_SHARDED_UPDATE", False)
 
 
+def _wire_format_default():
+    """Env/config default for ``wire_format`` (HOROVOD_COMPRESSION +
+    HOROVOD_COMPRESSION_BLOCK_SIZE): the quantized wire the operator
+    opted into for the whole job.
+
+    HOROVOD_COMPRESSION_DCN_ONLY is deliberately NOT consulted here: it
+    is an eager-dispatch placement policy for a path with no error-
+    feedback state.  The in-jit transform carries this worker's
+    quantization error in ``_DistState.residual``, which is exactly what
+    makes quantizing its whole bucketed reduction safe (EQuARX's
+    regime); pass ``wire_format="none"`` to opt a transform out."""
+    cfg = runtime._state().config
+    if cfg is not None:
+        return cfg.compression, cfg.compression_block_size
+    import os
+    return (os.environ.get("HOROVOD_COMPRESSION", "none") or "none",
+            int(os.environ.get("HOROVOD_COMPRESSION_BLOCK_SIZE", 0) or 0)
+            or None)
+
+
 class _DistState(NamedTuple):
     inner: Any
     acc: Any
     count: jnp.ndarray
+    # grads-shaped fp32 error-feedback tree carried by the quantized wire
+    # formats (this worker's accumulated quantization error; None when no
+    # wire format is active) — varying over the worker axis, like ``acc``
+    residual: Any = None
 
 
 def DistributedGradientTransform(
@@ -363,7 +492,9 @@ def DistributedGradientTransform(
         postscale_factor: float = 1.0,
         threshold_bytes: Optional[int] = None,
         process_set=None,
-        sharded_update: Optional[bool] = None
+        sharded_update: Optional[bool] = None,
+        wire_format: Optional[str] = None,
+        wire_block_size: Optional[int] = None
         ) -> optax.GradientTransformation:
     """optax transformation that cross-worker-reduces gradients.
 
@@ -388,6 +519,19 @@ def DistributedGradientTransform(
     (like the ``backward_passes_per_step`` accumulator) and the state
     crosses shard_map boundaries with
     ``state_partition_specs(..., sharded_update=True)``.
+
+    ``wire_format`` ("int8", "fp8_e4m3", "fp8_e5m2"; default from
+    ``HOROVOD_COMPRESSION``, "none" disables; in-jit path only) switches
+    each bucket to the block-scaled quantized staging with **error
+    feedback**: this worker's quantization error is carried in
+    ``_DistState.residual`` (grads-shaped, fp32, varying over the worker
+    axis — ``state_partition_specs`` shards it like the accumulator) and
+    added back before the next quantization, so the compressed updates
+    converge to the full-width trajectory instead of accumulating bias.
+    Composes with ``sharded_update`` (the gradient reduce-scatter is
+    quantized; the updates all-gather stays full-width) and with
+    ``backward_passes_per_step`` (the boundary reduction quantizes the
+    accumulated mean).
     """
     if inner is None:
         inner = optax.identity()
@@ -402,6 +546,31 @@ def DistributedGradientTransform(
     if sharded and op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
         raise ValueError(
             f"sharded_update supports op=Average/Sum, got {op!r}")
+    if wire_format is not None and wire_format != "none" \
+            and axis_name is None:
+        raise ValueError(
+            "wire_format requires axis_name: the quantized staging is an "
+            "in-jit schedule rewrite; the eager path's wire format is the "
+            "engine's negotiated HOROVOD_COMPRESSION setting")
+    if wire_format is None and axis_name is not None:
+        env_fmt, env_block = _wire_format_default()
+        fmt = resolve_wire_format(env_fmt,
+                                  wire_block_size or env_block or None)
+    else:
+        fmt = (resolve_wire_format(wire_format, wire_block_size)
+               if axis_name is not None else None)
+    if fmt is not None:
+        if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+            raise ValueError(
+                f"wire_format quantization supports op=Average/Sum, got "
+                f"{op!r}: Adasum operates on exact local gradients and "
+                f"min/max are not expressible as a quantize-accumulate "
+                f"staging")
+        if compression not in (None, Compression.none):
+            raise ValueError(
+                "wire_format and compression are two definitions of the "
+                "same wire: pick the block-scaled quantized format OR "
+                "the cast compressor, not both")
 
     def reduce_grads(grads):
         if axis_name is not None:
@@ -434,13 +603,25 @@ def DistributedGradientTransform(
     # is then params-based only (no false positives either way).
     _init_fingerprints = set()
 
-    def _step(grads, inner_state, params):
-        """One reduced optimizer step → (full-size updates, new inner)."""
+    def _step(grads, inner_state, params, residual):
+        """One reduced optimizer step → (full-size updates, new inner,
+        new error-feedback residual)."""
         if sharded:
-            shards, layout = fused_reduce_scatter_tree(
-                grads, axis_name, op=op, threshold_bytes=threshold_bytes,
-                compression=compression, prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor)
+            if fmt is not None:
+                shards, layout, new_res = fused_reduce_scatter_tree(
+                    grads, axis_name, op=op,
+                    threshold_bytes=threshold_bytes,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    wire_format=fmt, residual=residual)
+            else:
+                shards, layout = fused_reduce_scatter_tree(
+                    grads, axis_name, op=op,
+                    threshold_bytes=threshold_bytes,
+                    compression=compression,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor)
+                new_res = residual
             # init_fn planned the state layout from PARAMS; the gradient
             # layout above must be the same plan, or the 1/N state tiles
             # won't line up with the grad shards — fail with the cause
@@ -449,7 +630,8 @@ def DistributedGradientTransform(
             if params is not None:
                 p_leaves, p_layout = _sharded_layout(
                     params, _axis_size(axis_name), op, prescale_factor,
-                    postscale_factor, _resolve_threshold(threshold_bytes))
+                    postscale_factor, _resolve_threshold(threshold_bytes),
+                    align=fmt.block_size if fmt else 1)
                 expected = (p_layout.shapes, p_layout.buckets)
             else:
                 p_leaves = None
@@ -469,13 +651,27 @@ def DistributedGradientTransform(
             upd_shards, new_inner = inner.update(
                 shards, inner_state, p_shards)
             updates = all_gather_sharded_tree(upd_shards, layout, axis_name)
-            return updates, new_inner
-        reduced = reduce_grads(grads)
-        return inner.update(reduced, inner_state, params)
+            return updates, new_inner, new_res
+        if fmt is not None:
+            reduced, new_res = fused_reduce_tree(
+                grads, axis_name, op=op, threshold_bytes=threshold_bytes,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                wire_format=fmt, residual=residual)
+        else:
+            reduced = reduce_grads(grads)
+            new_res = residual
+        updates, new_inner = inner.update(reduced, inner_state, params)
+        return updates, new_inner, new_res
 
     def init_fn(params):
         acc = (jax.tree_util.tree_map(jnp.zeros_like, params) if k > 1
                else None)
+        # the error-feedback residual starts at zero: no carried error
+        # before the first quantized reduction
+        residual = (jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            if fmt is not None else None)
         if sharded:
             try:
                 n = _axis_size(axis_name)
@@ -491,19 +687,24 @@ def DistributedGradientTransform(
                     f"HOROVOD_SHARDED_UPDATE=1)") from exc
             _leaves, layout = _sharded_layout(
                 params, n, op, prescale_factor, postscale_factor,
-                _resolve_threshold(threshold_bytes))
+                _resolve_threshold(threshold_bytes),
+                align=fmt.block_size if fmt else 1)
             _init_fingerprints.add((layout.shapes, layout.buckets))
             inner_state = inner.init(
                 shard_tree_like(params, layout, axis_name))
         else:
             inner_state = inner.init(params)
         return _DistState(inner=inner_state, acc=acc,
-                          count=jnp.zeros([], jnp.int32))
+                          count=jnp.zeros([], jnp.int32),
+                          residual=residual)
 
     def update_fn(grads, state, params=None):
+        residual = getattr(state, "residual", None)
         if k == 1:
-            updates, new_inner = _step(grads, state.inner, params)
-            return updates, _DistState(new_inner, state.acc, state.count)
+            updates, new_inner, new_res = _step(grads, state.inner,
+                                                params, residual)
+            return updates, _DistState(new_inner, state.acc, state.count,
+                                       new_res)
         acc = jax.tree_util.tree_map(lambda a, g: a + g, state.acc, grads)
         count = state.count + 1
         is_boundary = count % k == 0
@@ -523,25 +724,30 @@ def DistributedGradientTransform(
                 lambda a: jax.lax.pcast(a, axis_name, to="varying"), tree)
 
         def do_step(args):
-            acc, inner_state = args
+            acc, inner_state, residual = args
             mean_acc = jax.tree_util.tree_map(lambda a: a / k, acc)
-            updates, new_inner = _step(mean_acc, inner_state, params)
-            return updates, _as_varying(_fresh_zeros(acc)), new_inner
+            updates, new_inner, new_res = _step(mean_acc, inner_state,
+                                                params, residual)
+            return (updates, _as_varying(_fresh_zeros(acc)), new_inner,
+                    new_res)
 
         def skip_step(args):
-            acc, inner_state = args
-            return _fresh_zeros(acc), acc, inner_state
+            acc, inner_state, residual = args
+            return _fresh_zeros(acc), acc, inner_state, residual
 
         if axis_name is not None:
-            updates, acc, new_inner = jax.lax.cond(
-                is_boundary, do_step, skip_step, (acc, state.inner))
+            updates, acc, new_inner, new_res = jax.lax.cond(
+                is_boundary, do_step, skip_step,
+                (acc, state.inner, residual))
         else:
             # eager path: python control flow is fine
             if bool(is_boundary):
-                updates, acc, new_inner = do_step((acc, state.inner))
+                updates, acc, new_inner, new_res = do_step(
+                    (acc, state.inner, residual))
             else:
-                updates, acc, new_inner = skip_step((acc, state.inner))
-        return updates, _DistState(new_inner, acc, count)
+                updates, acc, new_inner, new_res = skip_step(
+                    (acc, state.inner, residual))
+        return updates, _DistState(new_inner, acc, count, new_res)
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -560,6 +766,11 @@ def state_partition_specs(state: _DistState, axis_name: str,
     bucket-tile layout: every non-scalar inner leaf is this worker's 1/N
     tile (varying over the worker axis → sharded spec), while scalar
     leaves (step counters) stay replicated.
+
+    The quantized-wire error-feedback ``residual`` is this worker's own
+    accumulated quantization error — per-worker data exactly like the
+    ``backward_passes_per_step`` accumulator, so it is varying over the
+    worker axis and shards over it.
     """
     from jax.sharding import PartitionSpec as P
     if sharded_update:
@@ -570,7 +781,10 @@ def state_partition_specs(state: _DistState, axis_name: str,
         inner = jax.tree_util.tree_map(lambda _: P(), state.inner)
     acc = (None if state.acc is None else
            jax.tree_util.tree_map(lambda _: P(axis_name), state.acc))
-    return _DistState(inner=inner, acc=acc, count=P())
+    residual = getattr(state, "residual", None)
+    residual = (None if residual is None else
+                jax.tree_util.tree_map(lambda _: P(axis_name), residual))
+    return _DistState(inner=inner, acc=acc, count=P(), residual=residual)
 
 
 def DistributedOptimizer(optimizer: optax.GradientTransformation,
@@ -582,7 +796,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          axis_name: Optional[str] = None,
                          threshold_bytes: Optional[int] = None,
                          process_set=None,
-                         sharded_update: Optional[bool] = None
+                         sharded_update: Optional[bool] = None,
+                         wire_format: Optional[str] = None,
+                         wire_block_size: Optional[int] = None
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with distributed gradient reduction.
 
@@ -604,7 +820,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         backward_passes_per_step=backward_passes_per_step,
         compression=compression, prescale_factor=prescale,
         postscale_factor=postscale, threshold_bytes=threshold_bytes,
-        process_set=process_set, sharded_update=sharded_update)
+        process_set=process_set, sharded_update=sharded_update,
+        wire_format=wire_format, wire_block_size=wire_block_size)
 
 
 def broadcast_parameters(params, root_rank: int = 0, process_set=None):

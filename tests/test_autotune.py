@@ -73,13 +73,15 @@ def test_autotune_log_schema(tmp_path):
     pm._log_file.flush()
     lines = open(log).read().strip().splitlines()
     assert lines[0] == ("timestamp,fusion_threshold_bytes,cycle_time_ms,"
-                        "cache,hierarchical,score_bytes_per_sec,phase")
+                        "cache,hierarchical,compression,"
+                        "score_bytes_per_sec,phase")
     assert any(line.endswith("tuned") for line in lines[1:])
     # every row carries a cycle time from the grid and binary flags
     for line in lines[1:]:
         cols = line.split(",")
         assert float(cols[2]) in _CYCLE_GRID_MS
         assert cols[3] in ("0", "1") and cols[4] in ("0", "1")
+        assert cols[5] in ("0", "1")
 
 
 def test_engine_reads_tuned_cycle_time(hvd):
@@ -215,14 +217,14 @@ def test_engine_applies_cache_and_hier_toggles(hvd):
     old_hier = eng.cfg.hierarchical_allreduce
     eng.autotuner = pm
     try:
-        pm._current = (pm._current[0], pm._current[1], 0.0, 0.0)
+        pm._current = (pm._current[0], pm._current[1], 0.0, 0.0, 0.0)
         before = eng.stats()["cache"]["entries"]
         hvd.allreduce(np.ones((4,), np.float32), name="ca_off_t")
         st = eng.stats()
         assert st["cache"]["entries"] == before   # cache bypassed
         assert st["autotune"]["cache_enabled"] is False
         assert st["autotune"]["hierarchical"] is False
-        pm._current = (pm._current[0], pm._current[1], 1.0, 0.0)
+        pm._current = (pm._current[0], pm._current[1], 1.0, 0.0, 0.0)
         hvd.allreduce(np.ones((4,), np.float32), name="ca_on_t")
         st = eng.stats()
         assert st["cache"]["entries"] > before    # cache back on

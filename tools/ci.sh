@@ -27,9 +27,12 @@
 #  10. bench:   tools/bench_control.py --smoke — real multi-process
 #      negotiation over the RPC KV; watch-transport invariants (one
 #      set + one watch per round, zero polled dir-gets) stay pinned —
-#      and tools/bench_zero.py --smoke — CPU-mesh A/B of the ZeRO
+#      tools/bench_zero.py --smoke — CPU-mesh A/B of the ZeRO
 #      sharded update (1/N state bytes, no full-gradient psum in the
-#      sharded schedule, sharded == replicated weights)
+#      sharded schedule, sharded == replicated weights) — and
+#      tools/bench_compression.py --smoke — quantized-wire invariants
+#      (>=3.5x DCN bytes at int8, no overflow, error-feedback parity
+#      with bit-identical replicas)
 #  11. hvdsched: re-trace the builtin step entries to jaxprs on CPU and
 #      diff their collective schedules against tests/schedules/
 #      (HVD211 drift; incl. the sharded_distopt_step reduce_scatter →
@@ -97,8 +100,13 @@ fams = aggregate.parse_prometheus(aggregate.scrape("127.0.0.1", srv.port))
 for fam in ("hvd_engine_cycles_total", "hvd_cycle_duration_seconds",
             "hvd_negotiation_duration_seconds",
             "hvd_rpc_request_duration_seconds",
-            "hvd_response_cache_total"):
+            "hvd_response_cache_total", "hvd_wire_bytes_total"):
     assert fam in fams, f"missing metric family {fam}"
+# wire accounting (quantized collectives): the uncompressed allreduce
+# above must have recorded its payload under format="float32"
+wire = [(lbl, v) for _, lbl, v in fams["hvd_wire_bytes_total"]["samples"]
+        if lbl.get("format") == "float32"]
+assert wire and wire[0][1] >= 12, fams["hvd_wire_bytes_total"]["samples"]
 assert fams["hvd_cycle_duration_seconds"]["type"] == "histogram"
 cycles = [v for n, _, v in fams["hvd_engine_cycles_total"]["samples"]]
 assert cycles and cycles[0] >= 1, cycles
@@ -204,6 +212,14 @@ tail -1 /tmp/ci_bench_control.log
 python tools/bench_zero.py --smoke > /tmp/ci_bench_zero.log 2>&1 \
   || { tail -30 /tmp/ci_bench_zero.log; exit 1; }
 tail -1 /tmp/ci_bench_zero.log
+# quantized collectives: the DCN-stage wire-bytes ratio must hold
+# (>=3.5x for fp32 gradients at int8), a quantized SUM far outside int8
+# range must not overflow, and error-feedback training must keep every
+# replica bit-identical with final loss at parity (docs/performance.md
+# "Quantized collectives")
+python tools/bench_compression.py --smoke > /tmp/ci_bench_comp.log 2>&1 \
+  || { tail -30 /tmp/ci_bench_comp.log; exit 1; }
+tail -1 /tmp/ci_bench_comp.log
 
 echo "== 11/11 hvdsched: collective-schedule snapshots + consistency =="
 # re-trace every builtin step entry to a jaxpr on CPU, diff against the
