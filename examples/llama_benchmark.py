@@ -57,6 +57,11 @@ def main():
     p.add_argument("--moe", type=int, default=0, metavar="N_EXPERTS",
                    help="Mixtral-style MoE FFN with N experts (top-2 "
                         "routing, expert parallelism over dp)")
+    p.add_argument("--overlap", action="store_true",
+                   help="overlapped gradient dispatch: per-layer fusion "
+                        "buckets fire inside the backward scan "
+                        "(dp-only; the one-command real-chip A/B for "
+                        "HOROVOD_OVERLAP — run with and without)")
     args = p.parse_args()
 
     hvd.init()
@@ -71,15 +76,23 @@ def main():
     pmesh = ParallelMesh(mc)
     if args.fsdp:
         if args.zero1 or args.attn != "ring" or args.tp > 1 \
-                or args.sp > 1 or args.pp > 1 or args.grad_accum:
+                or args.sp > 1 or args.pp > 1 or args.grad_accum \
+                or args.overlap:
             p.error("--fsdp composes with dp only; drop "
-                    "--zero1/--attn/--tp/--sp/--pp/--grad-accum")
+                    "--zero1/--attn/--tp/--sp/--pp/--grad-accum/"
+                    "--overlap")
         ts = training.make_llama_fsdp_step(cfg, pmesh)
     else:
+        if args.overlap and (args.tp > 1 or args.sp > 1 or args.pp > 1
+                             or args.zero1 or args.grad_accum
+                             or args.moe):
+            p.error("--overlap composes with dp-only dense meshes; "
+                    "drop --tp/--sp/--pp/--zero1/--grad-accum/--moe")
         ts = training.make_llama_train_step(
             cfg, pmesh, attn=args.attn, zero1=args.zero1,
             grad_accum=args.grad_accum,
-            n_microbatches=2 * args.pp if args.pp > 1 else 0)
+            n_microbatches=2 * args.pp if args.pp > 1 else 0,
+            overlap=args.overlap)
     params, opt_state = ts.init_fn(jax.random.PRNGKey(0))
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
 

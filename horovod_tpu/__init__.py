@@ -61,6 +61,9 @@ from .optim import (  # noqa: F401
     fused_reduce_scatter_tree, all_gather_sharded_tree,
     broadcast_parameters, broadcast_optimizer_state,
 )
+# overlapped dispatch context (ROADMAP item 3): wrap value_and_grad so
+# the models' grad taps fire per-bucket collectives inside backprop
+from .optim.overlap import overlapped_backprop  # noqa: F401
 
 from . import elastic  # noqa: F401
 # deterministic fault injection (docs/env.md "Chaos engineering"); pure

@@ -418,6 +418,78 @@ def _entry_quantized_distopt_step():
     return step, (spec, spec)
 
 
+#: toy scanned-model geometry for the overlapped entry (layers, width,
+#: vocab rows) — small enough to trace fast, deep enough that the
+#: backward scan carries multiple per-layer dispatches.
+_OVERLAP_L, _OVERLAP_D, _OVERLAP_V = 3, 8, 5
+
+
+def _overlap_params_spec():
+    """Representative scanned-model param pytree: a stacked fp32+bf16
+    layer stack (two buckets per layer at ``_THRESHOLD``) plus
+    non-scanned root leaves (embed, final_norm)."""
+    import jax
+    import jax.numpy as jnp
+    sds = jax.ShapeDtypeStruct
+    L, D, V = _OVERLAP_L, _OVERLAP_D, _OVERLAP_V
+    return {
+        "embed": sds((V, D), jnp.float32),
+        "layers": {
+            "b": sds((L, D), jnp.float32),
+            "s": sds((L, D), jnp.bfloat16),
+            "w": sds((L, D, D), jnp.float32),
+        },
+        "final_norm": sds((D,), jnp.float32),
+    }
+
+
+def _entry_overlapped_distopt_step():
+    """The overlapped-dispatch step (HOROVOD_OVERLAP, ROADMAP item 3):
+    the scanned toy model's grad taps fire each layer's fusion buckets
+    INSIDE the backward scan (records sit in a scan sub-jaxpr path, in
+    reverse layer order structurally), and the non-scanned root leaves
+    reduce at the end of backprop — no post-backprop fused block.  The
+    snapshot's record positions ARE the overlap claim."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from ..optim import overlap as _ov
+    from ..optim.distributed import DistributedOptimizer
+
+    # overlap pinned on, everything else pinned off/none: the snapshot
+    # must not flip with the operator's env (each rewrite has its own
+    # entry)
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=_AXIS,
+                              threshold_bytes=_THRESHOLD,
+                              sharded_update=False, wire_format="none",
+                              overlap=True)
+
+    def model_loss(params, x):
+        params = _ov.tap_root(params)
+        h = x @ params["embed"]
+
+        def body(h, lp):
+            lp = _ov.grad_tap(lp)
+            return (jnp.tanh(h @ lp["w"] + lp["b"])
+                    * lp["s"].astype(h.dtype), None)
+
+        h, _ = jax.lax.scan(body, h, params["layers"])
+        return (h * params["final_norm"]).sum()
+
+    def step(params, x):
+        # per-step state init inside the traced program (init issues no
+        # collectives); the context arms the model taps for this trace
+        state = tx.init(params)
+        with _ov.overlapped_backprop(tx):
+            _loss, grads = jax.value_and_grad(model_loss)(params, x)
+        updates, _ = tx.update(grads, state, params)
+        return updates
+
+    spec = _overlap_params_spec()
+    x = jax.ShapeDtypeStruct((2, _OVERLAP_V), jnp.float32)
+    return step, (spec, x)
+
+
 #: entry name -> builder returning (fn, example_args).
 BUILTIN_ENTRIES = {
     "fused_reduce": _entry_fused_reduce,
@@ -425,6 +497,7 @@ BUILTIN_ENTRIES = {
     "jit_fused_reduce": _entry_jit_fused_reduce,
     "sharded_distopt_step": _entry_sharded_distopt_step,
     "quantized_distopt_step": _entry_quantized_distopt_step,
+    "overlapped_distopt_step": _entry_overlapped_distopt_step,
 }
 
 #: Mesh sizes the consistency check traces every entry at (HVD210).

@@ -1,0 +1,96 @@
+"""Ring-model wire-byte accounting over traced collective schedules.
+
+The CPU-mesh benches (``tools/bench_zero.py``, ``bench_compression.py``,
+``bench_overlap.py``) all answer the same question — "how many bytes
+does one step move per worker?" — from the SAME source of truth: the
+collective schedule ``analysis/schedule.py`` extracts from the step's
+jaxpr.  This module is the one implementation of that accounting (it
+used to live inline in each bench): per-collective transmit bytes under
+the standard ring algorithms, summed over a schedule, plus the
+primitive-count summary the A/B tables print.
+
+The model is the textbook ring cost, not a profile: psum (allreduce)
+moves ``2(n-1)/n`` of the payload per worker, reduce-scatter /
+all_to_all ``(n-1)/n`` of the *input*, all_gather ``(n-1)/n`` of the
+*output*.  Collectives over axes absent from ``axis_sizes`` (e.g. a tp
+axis when only dp is being accounted) contribute zero; ``axis_filter``
+restricts to one hop (e.g. only the DCN axis of a hierarchical
+reduction).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+_AVAL_RE = re.compile(r"^(\w+)\[([\dx]*)\]$")
+
+
+def aval_nbytes(aval: str) -> int:
+    """Bytes of one ``dtype[axb...]`` aval string from a schedule record
+    (widths from the fusion planner's table — unknown dtypes raise)."""
+    from ..ops.fusion import dtype_nbytes
+    m = _AVAL_RE.match(aval)
+    if not m:
+        raise ValueError(f"unparseable aval {aval!r}")
+    dims = [int(d) for d in m.group(2).split("x")] if m.group(2) else []
+    numel = 1
+    for d in dims:
+        numel *= d
+    return numel * dtype_nbytes(m.group(1))
+
+
+def ring_transmit_bytes(record, axis_sizes: Dict[str, int],
+                        axis_filter: Optional[str] = None) -> int:
+    """Per-worker transmit bytes of one collective under the standard
+    ring algorithms (see module docstring).  ``record`` is an
+    ``analysis.schedule.CollectiveRecord``."""
+    axes = [a for a in record.axes if a in axis_sizes]
+    if axis_filter is not None and axis_filter not in axes:
+        return 0
+    n = 1
+    for a in axes:
+        n *= axis_sizes[a]
+    if n <= 1:
+        return 0
+    in_bytes = sum(aval_nbytes(a) for a in record.inputs)
+    out_bytes = sum(aval_nbytes(a) for a in record.outputs)
+    if record.prim == "psum":
+        return (2 * (n - 1) * in_bytes) // n
+    if record.prim in ("psum_scatter", "reduce_scatter", "all_to_all"):
+        return ((n - 1) * in_bytes) // n
+    if record.prim == "all_gather":
+        return ((n - 1) * out_bytes) // n
+    return in_bytes  # conservative for anything unexpected
+
+
+def schedule_transmit_bytes(schedule, axis_sizes=None,
+                            axis_filter: Optional[str] = None) -> int:
+    """Total per-worker ring-model transmit bytes of a traced
+    :class:`~.schedule.Schedule` (default ``axis_sizes``: the
+    schedule's own axis_env)."""
+    sizes = dict(axis_sizes if axis_sizes is not None
+                 else schedule.axis_env)
+    return sum(ring_transmit_bytes(r, sizes, axis_filter)
+               for r in schedule.records)
+
+
+def schedule_prim_counts(schedule) -> Dict[str, int]:
+    """Collective primitive -> count over a traced schedule (the
+    one-line schedule summary the bench A/B tables print)."""
+    counts: Dict[str, int] = {}
+    for r in schedule.records:
+        counts[r.prim] = counts.get(r.prim, 0) + 1
+    return counts
+
+
+def trace_transmit_bytes(fn, example_args: Sequence,
+                         axis_env: Sequence[Tuple[str, int]],
+                         axis_filter: Optional[str] = None,
+                         entry: str = "wire") -> int:
+    """Trace ``fn`` and return its per-worker ring-model transmit bytes
+    in one call (the shape every bench's wire reading takes)."""
+    from .schedule import trace_schedule
+    sched = trace_schedule(fn, example_args, axis_env=axis_env,
+                           entry=entry)
+    return schedule_transmit_bytes(sched, dict(axis_env), axis_filter)

@@ -1,0 +1,81 @@
+"""Versioned jax compatibility shims (seeds ROADMAP item 4).
+
+The framework targets modern jax APIs but must run on the 0.4.x line
+too; until the multi-version CI exists, every place the two API
+generations diverge gets its shim HERE, in one module, instead of a
+private helper scattered next to its first caller.  Each shim documents
+the API shapes it bridges and degrades loudly (or not at all) — never
+silently changing semantics.
+
+Current shims (all formerly private helpers in ``optim/distributed.py``
+/ ``ops/collectives.py``):
+
+* :func:`axis_size` — ``jax.lax.axis_size`` (new) vs
+  ``jax.core.axis_frame`` (0.4.x), both trace-time constants.
+* :func:`psum_scatter` — ``jax.lax.psum_scatter`` when present, else a
+  psum+slice fallback that computes the identical per-worker tile but
+  DOES materialize the full reduction (the no-full-gradient schedule
+  gates then fail loudly by design; see the docstring).
+* :func:`pcast_varying` — ``jax.lax.pcast(..., to="varying")`` under
+  the new varying-manual-axes (VMA) tracking; identity on 0.4.x, where
+  there is no VMA state to align.
+
+Deliberately NOT here: a ``check_vma``→``check_rep`` alias for
+``shard_map`` — the transpose semantics differ between the two APIs
+(CHANGES.md PR-2), so bridging it is a feature port, not a shim.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def axis_size(axis_name: str) -> int:
+    """Static size of a named mapped axis at trace time.
+
+    New jax: ``jax.lax.axis_size(name)``.  0.4.x: ``jax.core
+    .axis_frame(name)`` returns the frame's size directly.  Both are
+    trace-time Python ints; raises ``NameError`` outside any mapped
+    program binding ``axis_name`` on both API shapes.
+    """
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return jax.core.axis_frame(axis_name)
+
+
+def psum_scatter(x, axis_name: str):
+    """Tiled 1-D reduce-scatter with a version-checked compat path.
+
+    ``jax.lax.psum_scatter`` exists on 0.4.x, but guard anyway: the
+    fallback computes the identical per-worker tile via a full ``psum``
+    plus this worker's slice — same numbers and the same 1/N optimizer
+    state, but the full reduced gradient IS materialized and the wire
+    bytes are N×.  On such a build the schedule gates (the
+    ``sharded_distopt_step`` snapshot, test_zero's no-psum pins, CI
+    stages 10/11) fail LOUDLY by design: the no-full-gradient guarantee
+    would not hold, and a reviewed snapshot update is the explicit
+    acknowledgment, not a silent degradation.
+    """
+    if hasattr(lax, "psum_scatter"):
+        return lax.psum_scatter(x, axis_name, scatter_dimension=0,
+                                tiled=True)
+    full = lax.psum(x, axis_name)
+    shard = x.shape[0] // axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(full, idx * shard, shard)
+
+
+def pcast_varying(tree, axis_name: str):
+    """Mark every leaf of ``tree`` varying over ``axis_name`` under the
+    new-jax VMA (varying-manual-axes) tracking.
+
+    ``jax.lax.pcast`` is the new API; absent (0.4.x) there is no VMA
+    state to align, so identity is the correct bridge — NOT a no-op
+    hack: the property pcast establishes does not exist on that build.
+    ``axis_name=None`` is accepted as identity for eager-path callers.
+    """
+    if axis_name is None or not hasattr(lax, "pcast"):
+        return tree
+    return jax.tree_util.tree_map(
+        lambda a: lax.pcast(a, axis_name, to="varying"), tree)

@@ -114,6 +114,13 @@ class Config:
     # DistributedGradientTransform(sharded_update=None) when axis_name
     # is set; per-chip optimizer state drops to total/N + padding.
     sharded_update: bool = False
+    # overlapped gradient dispatch on the in-jit path (ROADMAP item 3):
+    # layer-aware fusion buckets dispatched inside the backward scan the
+    # moment their gradients materialize (via the models' grad taps and
+    # optim.overlap.overlapped_backprop), hiding DCN latency behind the
+    # remaining backprop compute.  Default for
+    # DistributedGradientTransform(overlap=None) when axis_name is set.
+    overlap: bool = False
     # negotiated quantized wire format for summable allreduces
     # (EQuARX-class block-scaled int8/fp8; "none" disables).  Rides every
     # EntrySig through negotiation, so all processes must configure the
@@ -192,6 +199,7 @@ class Config:
             "HOROVOD_HIERARCHICAL_ALLGATHER", c.hierarchical_allgather)
         c.sharded_update = _env_bool(
             "HOROVOD_SHARDED_UPDATE", c.sharded_update)
+        c.overlap = _env_bool("HOROVOD_OVERLAP", c.overlap)
         c.compression = (_env_str("HOROVOD_COMPRESSION", c.compression)
                          or "none").strip().lower()
         from .compression import WIRE_FORMATS

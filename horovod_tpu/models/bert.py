@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..optim import overlap as _overlap
 from ..parallel.ring_attention import local_attention, ring_attention
 from .llama import ParallelSpec
 
@@ -237,6 +238,10 @@ def encode(params, tokens, cfg: BertConfig, par: ParallelSpec,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
 
     def scan_body(h, lp):
+        # overlapped dispatch tap (identity unless an overlapped_backprop
+        # context is armed): this layer's gradient buckets fire inside
+        # the backward scan, overlapped with the remaining backprop
+        lp = _overlap.grad_tap(lp)
         return body(h, lp, cfg, par, mask), None
 
     h, _ = lax.scan(scan_body, h, layers)
@@ -258,6 +263,11 @@ def loss_fn(params, tokens, labels, cfg: BertConfig, par: ParallelSpec,
             token_types=None, mask=None):
     """Mean classification cross-entropy over the local batch (caller
     pmeans over dp)."""
+    # overlapped dispatch: tap the non-scanned leaves (embeddings,
+    # pooler, classification head) as one group; the scanned stack is
+    # tapped per layer inside encode()'s scan body.  No-op outside an
+    # overlapped_backprop context.
+    params = _overlap.tap_root(params)
     logits = classify(params, tokens, cfg, par, token_types, mask)
     logp = jax.nn.log_softmax(logits, axis=-1)
     return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()

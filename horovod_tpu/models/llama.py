@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..optim import overlap as _overlap
 from ..parallel.ring_attention import ring_attention
 from ..parallel.ulysses import ulysses_attention
 
@@ -362,6 +363,12 @@ def _layer_stack(h, layers, cfg: LlamaConfig, par: ParallelSpec, positions):
     def scan_stack(body_fn, carry, ls):
         def scan_body(carry, lp):
             h, aux = carry
+            # overlapped dispatch (identity unless an overlapped_backprop
+            # context is armed): the tap's backward rule fires this
+            # layer's gradient buckets inside the backward scan, the
+            # moment they materialize — before the remaining layers'
+            # backprop runs
+            lp = _overlap.grad_tap(lp)
             h, aux_l = body_fn(h, lp, cfg, par, positions)
             return (h, aux + aux_l), None
         carry, _ = lax.scan(scan_body, carry, ls)
@@ -482,6 +489,12 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig, par: ParallelSpec,
             n_microbatches: int = 0):
     """Mean next-token cross-entropy over local tokens plus the MoE
     load-balance auxiliary loss (caller pmeans over dp/sp axes)."""
+    # overlapped dispatch: tap the non-scanned leaves (embed, final_norm)
+    # as one group HERE so every use — the lookup AND the tied loss head
+    # — contributes to one cotangent before the dispatch fires; the
+    # scanned stack is tapped per layer inside the scan body.  No-op
+    # outside an overlapped_backprop context.
+    params = _overlap.tap_root(params)
     h, aux = hidden(params, tokens, cfg, par, n_microbatches)
 
     def warn_unchunked():

@@ -45,6 +45,9 @@ struct Sig {
   long long ps_id = 0;
   bool stacked = false;
   long long group_id = -1;
+  // layer/topology key for overlapped dispatch: entries on different
+  // layers never fuse (-1 = no layer identity; mirrors EntrySig.layer)
+  long long layer = -1;
   bool has_prescale = false, has_postscale = false;
   double prescale = 1.0, postscale = 1.0;  // effective values (None -> 1.0)
   long long nbytes = 0;
@@ -134,6 +137,7 @@ bool parse_sig(PyObject *o, Sig *s) {
   if (!get_ll_attr(o, "process_set_id", &s->ps_id)) return false;
   if (!get_bool_attr(o, "stacked", &s->stacked)) return false;
   if (!get_ll_attr(o, "group_id", &s->group_id)) return false;
+  if (!get_ll_attr(o, "layer", &s->layer)) return false;
   if (!get_opt_double_attr(o, "prescale", &s->has_prescale, &s->prescale))
     return false;
   if (!get_opt_double_attr(o, "postscale", &s->has_postscale, &s->postscale))
@@ -207,6 +211,9 @@ int key_cmp(const Sig &a, const Sig &b) {
   // collective, and a quantized staging cannot carry full-width members
   c = a.wire_format.compare(b.wire_format);
   if (c) return c;
+  // buckets must never span layers: under overlapped dispatch a bucket
+  // goes to the wire when its layer's backward step completes
+  if (a.layer != b.layer) return a.layer < b.layer ? -1 : 1;
   return 0;
 }
 
@@ -337,6 +344,93 @@ PyObject *py_plan_fusion_sigs(PyObject *, PyObject *args) {
   std::vector<Sig> sigs;
   if (!parse_sigs(sigs_obj, &sigs)) return nullptr;
   return plan_to_py(plan(sigs, threshold));
+}
+
+// Overlapped dispatch order of a fusion plan (mirror of
+// fusion.plan_dispatch): descending layer first (the backward pass
+// materializes layer L-1's gradients first), layer-less (-1) buckets
+// last; ties keep plan order.  Returns (order, layers) tuples of ints.
+PyObject *py_plan_dispatch_sigs(PyObject *, PyObject *args) {
+  PyObject *sigs_obj, *buckets_obj;
+  if (!PyArg_ParseTuple(args, "OO", &sigs_obj, &buckets_obj))
+    return nullptr;
+  std::vector<Sig> sigs;
+  if (!parse_sigs(sigs_obj, &sigs)) return nullptr;
+  PyObject *bseq = PySequence_Fast(buckets_obj,
+                                   "buckets must be a sequence");
+  if (!bseq) return nullptr;
+  Py_ssize_t nb = PySequence_Fast_GET_SIZE(bseq);
+  std::vector<long long> layers(static_cast<size_t>(nb));
+  for (Py_ssize_t b = 0; b < nb; ++b) {
+    PyObject *inner = PySequence_Fast(PySequence_Fast_GET_ITEM(bseq, b),
+                                      "bucket must be a sequence");
+    if (!inner) {
+      Py_DECREF(bseq);
+      return nullptr;
+    }
+    Py_ssize_t ni = PySequence_Fast_GET_SIZE(inner);
+    if (ni == 0) {
+      Py_DECREF(inner);
+      Py_DECREF(bseq);
+      PyErr_Format(PyExc_ValueError, "bucket %lld is empty",
+                   static_cast<long long>(b));
+      return nullptr;
+    }
+    for (Py_ssize_t j = 0; j < ni; ++j) {
+      long long i = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(inner, j));
+      if (i == -1 && PyErr_Occurred()) {
+        Py_DECREF(inner);
+        Py_DECREF(bseq);
+        return nullptr;
+      }
+      if (i < 0 || i >= static_cast<long long>(sigs.size())) {
+        Py_DECREF(inner);
+        Py_DECREF(bseq);
+        PyErr_Format(PyExc_ValueError,
+                     "bucket %lld references sig %lld (have %lld sigs)",
+                     static_cast<long long>(b), i,
+                     static_cast<long long>(sigs.size()));
+        return nullptr;
+      }
+      long long lay = sigs[static_cast<size_t>(i)].layer;
+      if (j == 0) {
+        layers[static_cast<size_t>(b)] = lay;
+      } else if (lay != layers[static_cast<size_t>(b)]) {
+        Py_DECREF(inner);
+        Py_DECREF(bseq);
+        PyErr_Format(PyExc_ValueError,
+                     "bucket %lld spans layers %lld and %lld",
+                     static_cast<long long>(b),
+                     layers[static_cast<size_t>(b)], lay);
+        return nullptr;
+      }
+    }
+    Py_DECREF(inner);
+  }
+  Py_DECREF(bseq);
+  std::vector<size_t> order(static_cast<size_t>(nb));
+  for (size_t i = 0; i < order.size(); ++i) order[i] = i;
+  std::stable_sort(order.begin(), order.end(), [&](size_t x, size_t y) {
+    bool nx = layers[x] < 0, ny = layers[y] < 0;
+    if (nx != ny) return ny;          // layered before layer-less
+    if (!nx && layers[x] != layers[y]) return layers[x] > layers[y];
+    return x < y;
+  });
+  PyObject *po = PyTuple_New(nb), *pl = PyTuple_New(nb);
+  if (!po || !pl) {
+    Py_XDECREF(po);
+    Py_XDECREF(pl);
+    return nullptr;
+  }
+  for (Py_ssize_t b = 0; b < nb; ++b) {
+    PyTuple_SET_ITEM(
+        po, b,
+        PyLong_FromLongLong(
+            static_cast<long long>(order[static_cast<size_t>(b)])));
+    PyTuple_SET_ITEM(
+        pl, b, PyLong_FromLongLong(layers[static_cast<size_t>(b)]));
+  }
+  return Py_BuildValue("(NN)", po, pl);
 }
 
 // ---------------------------------------------------------------------------
@@ -487,6 +581,7 @@ std::string cache_key(const std::vector<Sig> &sigs) {
     append_ll(&k, s.ps_id);
     append_ll(&k, s.stacked ? 1 : 0);
     append_ll(&k, s.group_id);
+    append_ll(&k, s.layer);
     char buf[64];
     int n = std::snprintf(buf, sizeof(buf), "%d:%.17g|%d:%.17g;",
                           s.has_prescale ? 1 : 0, s.prescale,
@@ -909,6 +1004,10 @@ PyMethodDef module_methods[] = {
      "plan_fusion_sigs(sigs, threshold_bytes) -> list[list[int]]\n"
      "Deterministic fused-bucket planner (parity with "
      "horovod_tpu.ops.fusion.plan_fusion)."},
+    {"plan_dispatch_sigs", py_plan_dispatch_sigs, METH_VARARGS,
+     "plan_dispatch_sigs(sigs, buckets) -> (order, layers)\n"
+     "Overlapped dispatch order of a fusion plan (parity with "
+     "horovod_tpu.ops.fusion.plan_dispatch)."},
     {"negotiate_decide", py_negotiate_decide, METH_VARARGS,
      "negotiate_decide(full, active) -> (counts, lagging, deferred)\n"
      "Readiness-intersection decision over announced token multisets "

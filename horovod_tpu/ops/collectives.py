@@ -48,12 +48,11 @@ from ..runtime import ReduceOp
 
 
 def axis_size_p(axis_name: str) -> int:
-    """Static size of a named mapped axis at trace time (0.4.x compat:
-    ``jax.lax.axis_size`` is new; ``jax.core.axis_frame`` returns the
-    size directly on older builds — both are trace-time constants)."""
-    if hasattr(lax, "axis_size"):
-        return lax.axis_size(axis_name)
-    return jax.core.axis_frame(axis_name)
+    """Static size of a named mapped axis at trace time (the version
+    shim lives in :mod:`horovod_tpu.compat`; this alias keeps the
+    kernel-module call sites stable)."""
+    from ..compat import axis_size
+    return axis_size(axis_name)
 
 
 # ---------------------------------------------------------------------------

@@ -75,6 +75,14 @@ class EntrySig:
     # every other signature field, and being part of the (astuple)
     # ResponseCache key it invalidates cached plans on a format change.
     wire_format: str = "none"
+    # layer/topology key for overlapped dispatch (ROADMAP item 3): the
+    # backward pass materializes gradients one layer at a time, so a
+    # bucket spanning layers could only dispatch after its LAST layer's
+    # gradients exist — the exposed-latency problem again.  Entries with
+    # different layer keys therefore never fuse (-1 = no layer identity:
+    # the eager engine and the non-overlapped in-jit path, where the
+    # whole plan dispatches at once and existing plans must not change).
+    layer: int = -1
 
     @property
     def numel(self) -> int:
@@ -93,7 +101,7 @@ class EntrySig:
                 self.process_set_id, self.stacked,
                 1.0 if self.prescale is None else self.prescale,
                 1.0 if self.postscale is None else self.postscale,
-                self.wire_format)
+                self.wire_format, self.layer)
 
 
 def plan_fusion(entries: Sequence[EntrySig],
@@ -231,6 +239,56 @@ def plan_bucket_layouts(entries: Sequence[EntrySig],
             indices=tuple(bucket), sizes=sizes, numel=numel,
             padded_numel=padded, shard_numel=padded // shards))
     return layouts
+
+
+@dataclasses.dataclass(frozen=True)
+class DispatchSchedule:
+    """Explicit dispatch order of a layer-aware fusion plan.
+
+    ``plan_fusion`` decides *what* fuses; this records *when* each bucket
+    may go to the wire under overlapped dispatch (ROADMAP item 3): the
+    backward pass produces gradients in reverse layer order, so a
+    bucket's collective can dispatch the moment its layer's backward
+    step completes.  ``order`` lists bucket indices in dispatch order —
+    descending layer first (layer L-1's gradients materialize first in
+    backprop), then the layer-less (-1) buckets, whose members (embeds,
+    final norms — parameters used outside the scanned stack) only
+    complete at the very end of the backward pass.  Pure plan metadata:
+    the traced program realizes this order structurally (the collectives
+    sit inside the backward scan), and the boundary fallback path
+    executes buckets in this order so both paths are one reviewable
+    schedule.
+    """
+    order: Tuple[int, ...]        # bucket indices, dispatch order
+    layers: Tuple[int, ...]       # layer key per bucket, plan order
+
+
+def plan_dispatch(entries: Sequence[EntrySig],
+                  buckets: Sequence[Sequence[int]]) -> DispatchSchedule:
+    """Compute the overlapped dispatch schedule of a fusion plan.
+
+    ``buckets`` is ``plan_fusion`` output over ``entries``; because
+    ``layer`` participates in ``bucket_key``, every bucket has exactly
+    one layer key.  Ties (several buckets on one layer — e.g. the
+    float32 and bfloat16 buckets of the same layer) keep plan order,
+    which is deterministic cross-process.
+    """
+    layers = tuple(entries[bucket[0]].layer for bucket in buckets)
+    for bi, bucket in enumerate(buckets):
+        for i in bucket:
+            if entries[i].layer != layers[bi]:
+                raise ValueError(
+                    f"bucket {bi} spans layers {layers[bi]} and "
+                    f"{entries[i].layer}: a bucket can only dispatch "
+                    f"when its LAST layer's gradients exist, so the "
+                    f"planner must never fuse across layers (is layer "
+                    f"missing from bucket_key()?)")
+    order = sorted(
+        range(len(buckets)),
+        # descending layer; layer -1 (no layer identity: gradients
+        # complete only at the end of backprop) dispatches last
+        key=lambda b: (0, -layers[b], b) if layers[b] >= 0 else (1, 0, b))
+    return DispatchSchedule(order=tuple(order), layers=layers)
 
 
 class ResponseCache:

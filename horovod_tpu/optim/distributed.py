@@ -41,33 +41,20 @@ from ..runtime import ReduceOp
 
 
 def _axis_size(axis_name: str):
-    """Static size of a named mapped axis at trace time (delegates to
-    the one version shim, ``ops.collectives.axis_size_p``; import is
-    lazy to keep this module importable without the kernel module)."""
-    from ..ops.collectives import axis_size_p
-    return axis_size_p(axis_name)
+    """Static size of a named mapped axis at trace time (the version
+    shim lives in ``horovod_tpu.compat``; import is lazy to keep this
+    module importable without jax fully initialized)."""
+    from ..compat import axis_size
+    return axis_size(axis_name)
 
 
 def _psum_scatter(x, axis_name: str):
-    """Tiled 1-D reduce-scatter with a version-checked compat path (the
-    sibling of ``_axis_size``).
-
-    ``jax.lax.psum_scatter`` exists on 0.4.x, but guard anyway: the
-    fallback computes the identical per-worker tile via a full ``psum``
-    plus this worker's slice — same numbers and the same 1/N optimizer
-    state, but the full reduced gradient IS materialized and the wire
-    bytes are N×.  On such a build the schedule gates (the
-    ``sharded_distopt_step`` snapshot, test_zero's no-psum pins, CI
-    stages 10/11) fail LOUDLY by design: the no-full-gradient guarantee
-    would not hold, and a reviewed snapshot update is the explicit
-    acknowledgment, not a silent degradation."""
-    if hasattr(jax.lax, "psum_scatter"):
-        return jax.lax.psum_scatter(x, axis_name, scatter_dimension=0,
-                                    tiled=True)
-    full = jax.lax.psum(x, axis_name)
-    shard = x.shape[0] // _axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
-    return jax.lax.dynamic_slice_in_dim(full, idx * shard, shard)
+    """Tiled 1-D reduce-scatter (``compat.psum_scatter``: on a jax
+    without ``lax.psum_scatter`` the psum+slice fallback materializes
+    the full reduction and the no-psum schedule gates fail LOUDLY by
+    design — see the shim's docstring)."""
+    from ..compat import psum_scatter
+    return psum_scatter(x, axis_name)
 
 
 def _tree_leaves_sorted(tree):
@@ -452,6 +439,15 @@ def _sharded_update_default() -> bool:
     return _env_bool("HOROVOD_SHARDED_UPDATE", False)
 
 
+def _overlap_default() -> bool:
+    """Env/config default for ``overlap`` (HOROVOD_OVERLAP)."""
+    cfg = runtime._state().config
+    if cfg is not None:
+        return cfg.overlap
+    from ..config import _env_bool
+    return _env_bool("HOROVOD_OVERLAP", False)
+
+
 def _wire_format_default():
     """Env/config default for ``wire_format`` (HOROVOD_COMPRESSION +
     HOROVOD_COMPRESSION_BLOCK_SIZE): the quantized wire the operator
@@ -494,7 +490,9 @@ def DistributedGradientTransform(
         process_set=None,
         sharded_update: Optional[bool] = None,
         wire_format: Optional[str] = None,
-        wire_block_size: Optional[int] = None
+        wire_block_size: Optional[int] = None,
+        overlap: Optional[bool] = None,
+        overlap_layers: str = "layers"
         ) -> optax.GradientTransformation:
     """optax transformation that cross-worker-reduces gradients.
 
@@ -532,6 +530,25 @@ def DistributedGradientTransform(
     quantized; the updates all-gather stays full-width) and with
     ``backward_passes_per_step`` (the boundary reduction quantizes the
     accumulated mean).
+
+    ``overlap=True`` (default from ``HOROVOD_OVERLAP``; in-jit only,
+    Average/Sum only) switches to **overlapped dispatch** (ROADMAP item
+    3, arXiv:2305.06942): the fusion plan becomes layer-aware (buckets
+    never span layers of the scanned stack under ``overlap_layers``,
+    and the plan carries an explicit reverse-layer dispatch schedule),
+    and when the step's backward pass runs under
+    :func:`~horovod_tpu.optim.overlap.overlapped_backprop`, each
+    bucket's ``psum`` (or ``psum_scatter`` under ``sharded_update``)
+    fires inside the backward scan the moment its layer's gradients
+    materialize — hiding DCN latency behind the remaining backprop
+    compute.  Without the context (or for models without tap sites) the
+    same layer-aware plan runs at the step boundary, landing on
+    bit-identical weights.  With a ``wire_format`` the early-dispatched
+    buckets quantize WITHOUT error feedback (the residual is per-step
+    state the backward pass cannot thread; ``_DistState.residual``
+    stays untouched at ``None``).  With ``backward_passes_per_step > 1``
+    the taps gate on the accumulation boundary — pass
+    ``count=state.count`` to ``overlapped_backprop``.
     """
     if inner is None:
         inner = optax.identity()
@@ -571,6 +588,32 @@ def DistributedGradientTransform(
                 "wire_format and compression are two definitions of the "
                 "same wire: pick the block-scaled quantized format OR "
                 "the cast compressor, not both")
+
+    if overlap and axis_name is None:
+        raise ValueError(
+            "overlap=True requires axis_name: overlapped dispatch "
+            "places per-bucket collectives inside the compiled backward "
+            "pass (the eager engine already overlaps via its background "
+            "loop)")
+    ov_enabled = (bool(overlap) if overlap is not None
+                  else axis_name is not None and _overlap_default())
+    _ov_plan = None
+    if ov_enabled:
+        if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+            raise ValueError(
+                f"overlap supports op=Average/Sum, got {op!r}: Adasum's "
+                f"recursive pairwise reduction needs every gradient at "
+                f"once and cannot dispatch per-layer")
+        if compression not in (None, Compression.none):
+            raise ValueError(
+                "overlap does not support the cast compressor: use "
+                "wire_format for a quantized wire (feedback-free under "
+                "overlap) or no compression")
+        from . import overlap as _ov
+        _ov_plan = _ov.OverlapPlan(
+            axis_name=axis_name, op=op, threshold_bytes=threshold_bytes,
+            prescale=prescale_factor, postscale=postscale_factor,
+            sharded=sharded, fmt=fmt, k=k, layers_key=overlap_layers)
 
     def reduce_grads(grads):
         if axis_name is not None:
@@ -664,14 +707,91 @@ def DistributedGradientTransform(
         updates, new_inner = inner.update(reduced, inner_state, params)
         return updates, new_inner, new_res
 
+    def _ov_step(grads, inner_state, params, fired, extra_acc=None,
+                 fire=None):
+        """One overlapped optimizer step (layer-aware plan).
+
+        ``fired``: taps were armed in this trace, so ``grads`` arrive
+        pre-reduced (sharded: tile-placed) from the backward scan —
+        otherwise the identical plan runs here at the boundary.
+        ``fire``: the context's explicit runtime gate — when set, BOTH
+        paths are traced under one ``lax.cond`` (grads are reduced iff
+        the taps fired at runtime), making overlapped-vs-boundary a
+        same-program A/B.  ``extra_acc`` (``backward_passes_per_step >
+        1`` boundary): the accumulated raw local gradients of the k-1
+        intermediate micro-steps, reduced here and folded in as
+        ``(R(extra_acc) + grads) / k`` — linearity of Sum/Average makes
+        that the reduction of the accumulated mean.
+        """
+        from . import overlap as _ov
+        from ..compat import pcast_varying
+        if sharded:
+            if fired:
+                if fire is not None:
+                    # plan once; both cond branches reuse the layout
+                    _leaves, layout = _ov.build_layout(
+                        grads, _ov_plan, shards=_axis_size(axis_name))
+                    tiles = jax.lax.cond(
+                        fire,
+                        lambda g: _ov.carve_tiles(g, _ov_plan,
+                                                  layout)[0],
+                        lambda g: _ov.scatter_tiles(g, _ov_plan,
+                                                    layout=layout)[0],
+                        grads)
+                else:
+                    tiles, layout = _ov.carve_tiles(grads, _ov_plan)
+            else:
+                tiles, layout = _ov.scatter_tiles(grads, _ov_plan)
+            if extra_acc is not None:
+                acc_tiles, _ = _ov.scatter_tiles(extra_acc, _ov_plan)
+                tiles = tuple((a + t) / k
+                              for a, t in zip(acc_tiles, tiles))
+            if params is not None:
+                p_tiles, p_layout = _ov.carve_tiles(params, _ov_plan)
+                expected = p_layout.fingerprint()
+            else:
+                p_tiles = None
+                expected = (next(iter(_init_fingerprints))
+                            if len(_init_fingerprints) == 1 else None)
+            if expected is not None and expected != layout.fingerprint():
+                raise ValueError(
+                    "overlap + sharded_update requires gradients and "
+                    "params to share one layer-aware bucket layout, but "
+                    "they plan differently (dtype or structure "
+                    "divergence between the gradient tree and the param "
+                    "tree — e.g. a cast-to-bf16 transform chained "
+                    "before this one); use the replicated path or align "
+                    "the dtypes")
+            upd_tiles, new_inner = inner.update(tiles, inner_state,
+                                                p_tiles)
+            updates = _ov.gather_updates(upd_tiles, layout, _ov_plan)
+            return updates, new_inner
+        if fired and fire is not None:
+            reduced = jax.lax.cond(
+                fire,
+                lambda g: pcast_varying(g, axis_name),
+                lambda g: pcast_varying(_ov.reduce_full(g, _ov_plan),
+                                        axis_name),
+                grads)
+        else:
+            reduced = grads if fired else _ov.reduce_full(grads, _ov_plan)
+        if extra_acc is not None:
+            racc = _ov.reduce_full(extra_acc, _ov_plan)
+            reduced = jax.tree_util.tree_map(
+                lambda a, g: (a + g) / k, racc, reduced)
+        updates, new_inner = inner.update(reduced, inner_state, params)
+        return updates, new_inner
+
     def init_fn(params):
         acc = (jax.tree_util.tree_map(jnp.zeros_like, params) if k > 1
                else None)
         # the error-feedback residual starts at zero: no carried error
-        # before the first quantized reduction
+        # before the first quantized reduction.  Overlapped dispatch is
+        # feedback-free (the backward pass cannot thread per-step
+        # state), so its residual stays None — untouched.
         residual = (jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            if fmt is not None else None)
+            if fmt is not None and _ov_plan is None else None)
         if sharded:
             try:
                 n = _axis_size(axis_name)
@@ -685,13 +805,21 @@ def DistributedGradientTransform(
                     f"state_partition_specs(..., sharded_update=True). "
                     f"(sharded mode may have been enabled by "
                     f"HOROVOD_SHARDED_UPDATE=1)") from exc
-            _leaves, layout = _sharded_layout(
-                params, n, op, prescale_factor, postscale_factor,
-                _resolve_threshold(threshold_bytes),
-                align=fmt.block_size if fmt else 1)
-            _init_fingerprints.add((layout.shapes, layout.buckets))
-            inner_state = inner.init(
-                shard_tree_like(params, layout, axis_name))
+            if _ov_plan is not None:
+                # layer-aware layout: the state tiles must line up with
+                # the per-layer buckets the backward-scan taps scatter
+                from . import overlap as _ov
+                p_tiles, layout = _ov.carve_tiles(params, _ov_plan)
+                _init_fingerprints.add(layout.fingerprint())
+                inner_state = inner.init(p_tiles)
+            else:
+                _leaves, layout = _sharded_layout(
+                    params, n, op, prescale_factor, postscale_factor,
+                    _resolve_threshold(threshold_bytes),
+                    align=fmt.block_size if fmt else 1)
+                _init_fingerprints.add((layout.shapes, layout.buckets))
+                inner_state = inner.init(
+                    shard_tree_like(params, layout, axis_name))
         else:
             inner_state = inner.init(params)
         return _DistState(inner=inner_state, acc=acc,
@@ -699,6 +827,45 @@ def DistributedGradientTransform(
                           residual=residual)
 
     def update_fn(grads, state, params=None):
+        if _ov_plan is not None:
+            # overlapped dispatch: a trace-time handshake with the
+            # overlapped_backprop context tells us whether the model's
+            # taps already staged the reductions inside the backward
+            # pass (fired) or the identical layer-aware plan must run
+            # here at the boundary — both land on the same weights
+            n_fired, fire = _ov_plan.consume_fired()
+            fired = n_fired > 0
+            if k == 1:
+                updates, new_inner = _ov_step(grads, state.inner,
+                                              params, fired, fire=fire)
+                return updates, _DistState(new_inner, state.acc,
+                                           state.count, state.residual)
+            count = state.count + 1
+            is_boundary = count % k == 0
+
+            def _zeros(tree):
+                return jax.tree_util.tree_map(
+                    lambda a: jnp.zeros(a.shape, a.dtype), tree)
+
+            def ov_do_step(args):
+                acc_prev, g, inner_state = args
+                updates, new_inner = _ov_step(g, inner_state, params,
+                                              fired, extra_acc=acc_prev)
+                from ..compat import pcast_varying
+                return (updates,
+                        pcast_varying(_zeros(acc_prev), axis_name),
+                        new_inner)
+
+            def ov_skip_step(args):
+                acc_prev, g, inner_state = args
+                return (_zeros(g), jax.tree_util.tree_map(
+                    lambda a, b: a + b, acc_prev, g), inner_state)
+
+            updates, acc, new_inner = jax.lax.cond(
+                is_boundary, ov_do_step, ov_skip_step,
+                (state.acc, grads, state.inner))
+            return updates, _DistState(new_inner, acc, count,
+                                       state.residual)
         residual = getattr(state, "residual", None)
         if k == 1:
             updates, new_inner, new_res = _step(grads, state.inner,
@@ -716,12 +883,10 @@ def DistributedGradientTransform(
                 lambda a: jnp.zeros(a.shape, a.dtype), tree)
 
         def _as_varying(tree):
-            # pcast is the new-jax VMA API; absent (0.4.x) there is no
-            # varying-manual-axes tracking to align, so identity is right
-            if axis_name is None or not hasattr(jax.lax, "pcast"):
-                return tree
-            return jax.tree_util.tree_map(
-                lambda a: jax.lax.pcast(a, axis_name, to="varying"), tree)
+            # compat.pcast_varying: pcast on new jax, identity on 0.4.x
+            # (no varying-manual-axes tracking to align there)
+            from ..compat import pcast_varying
+            return pcast_varying(tree, axis_name)
 
         def do_step(args):
             acc, inner_state, residual = args
@@ -749,6 +914,9 @@ def DistributedGradientTransform(
                     (acc, state.inner, residual))
         return updates, _DistState(new_inner, acc, count, new_res)
 
+    if _ov_plan is not None:
+        from . import overlap as _ov
+        _ov.register_transform(update_fn, _ov_plan)
     return optax.GradientTransformation(init_fn, update_fn)
 
 
@@ -798,7 +966,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          process_set=None,
                          sharded_update: Optional[bool] = None,
                          wire_format: Optional[str] = None,
-                         wire_block_size: Optional[int] = None
+                         wire_block_size: Optional[int] = None,
+                         overlap: Optional[bool] = None,
+                         overlap_layers: str = "layers"
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with distributed gradient reduction.
 
@@ -821,7 +991,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         compression=compression, prescale_factor=prescale,
         postscale_factor=postscale, threshold_bytes=threshold_bytes,
         process_set=process_set, sharded_update=sharded_update,
-        wire_format=wire_format, wire_block_size=wire_block_size)
+        wire_format=wire_format, wire_block_size=wire_block_size,
+        overlap=overlap, overlap_layers=overlap_layers)
 
 
 def broadcast_parameters(params, root_rank: int = 0, process_set=None):

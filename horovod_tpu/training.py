@@ -90,7 +90,8 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
                           attn: str = "ring",
                           n_microbatches: int = 0,
                           zero1: bool = False,
-                          grad_accum: int = 0) -> TrainStep:
+                          grad_accum: int = 0,
+                          overlap: bool = False) -> TrainStep:
     """Build the full data/tensor/sequence/pipeline/expert-parallel step.
 
     ``zero1=True`` additionally shards the optimizer state over the dp
@@ -105,6 +106,17 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
     update) — the jit-path form of the reference's
     ``backward_passes_per_step`` (horovod/torch/optimizer.py), trading
     activation memory for k× the per-step batch.
+
+    ``overlap=True`` (dp-only meshes; the real-chip A/B lever behind
+    ``examples/llama_benchmark.py --overlap``) routes the gradient
+    reduction through ``DistributedGradientTransform(overlap=True)``:
+    the model's grad taps dispatch each layer's fusion buckets inside
+    the backward scan (reverse layer order), hiding DCN latency behind
+    the remaining backprop compute, instead of relying on one fused
+    post-backprop block.  The step's shard_map runs with
+    ``check_vma=False`` so the explicit per-bucket collectives are the
+    ONLY dp reduction (no transpose-inserted psums to double-count);
+    tp/sp/pp meshes need those transposes and are not composed yet.
     """
     par = make_llama_parallel_spec(pmesh, attn, use_ep=cfg.n_experts > 0)
     mesh = pmesh.mesh
@@ -251,6 +263,59 @@ def make_llama_train_step(cfg: LlamaConfig, pmesh: ParallelMesh,
         inv_k = 1.0 / k
         return loss * inv_k, jax.tree_util.tree_map(
             lambda g: g * jnp.asarray(inv_k, g.dtype), grads)
+
+    if overlap:
+        if (tp > 1 or sp > 1 or pp > 1 or ep_dedicated > 1 or zero1
+                or grad_accum > 1 or par.dp_axis is None
+                or cfg.n_experts > 0):
+            raise ValueError(
+                "overlap=True currently composes with dp-only DENSE "
+                "meshes (the grad taps psum every leaf over dp, but "
+                "MoE aliases ep onto dp so expert weights are "
+                "dp-SHARDED — averaging them across ranks holding "
+                "different experts would corrupt training; tp/sp/pp "
+                "need the transpose-inserted psums of the check_vma "
+                "path) — drop --tp/--sp/--pp/--zero1/--grad-accum/"
+                "--moe")
+        from .optim import overlap as _ovl
+        from .optim.distributed import DistributedGradientTransform
+        from .runtime import ReduceOp
+        ov_tx = DistributedGradientTransform(
+            inner=opt, axis_name=par.dp_axis, op=ReduceOp.AVERAGE,
+            overlap=True)
+
+        def ov_shard_step(params, opt_state, tokens, targets):
+            with _ovl.overlapped_backprop(ov_tx):
+                loss, grads = jax.value_and_grad(local_loss)(
+                    params, tokens, targets)
+            updates, opt_state = ov_tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, _mean_loss(loss)
+
+        ov_state_shape = jax.eval_shape(lambda p: ov_tx.init(p),
+                                        param_shapes)
+        ov_specs = opt_state_partition_specs(
+            ov_state_shape, param_shapes, pspec_tree)
+        ov_sharding = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), ov_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        step_fn = jax.jit(jax.shard_map(
+            ov_shard_step, mesh=mesh,
+            in_specs=(pspec_tree, ov_specs, data_spec, data_spec),
+            out_specs=(pspec_tree, ov_specs, P()),
+            check_vma=False), donate_argnums=(0, 1))
+
+        def ov_init_fn(rng):
+            params = jax.jit(
+                partial(llama_mod.init_params, cfg, tp=1),
+                out_shardings=param_sharding)(rng)
+            opt_state = jax.jit(
+                ov_tx.init, out_shardings=ov_sharding)(params)
+            return params, opt_state
+
+        return TrainStep(step_fn=step_fn, init_fn=ov_init_fn, par=par,
+                         mesh=mesh, data_spec=data_spec,
+                         param_sharding=param_sharding)
 
     def shard_step(params, opt_state, tokens, targets):
         loss, grads = loss_and_grads(params, tokens, targets)

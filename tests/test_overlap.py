@@ -1,0 +1,599 @@
+"""Overlapped dispatch (ROADMAP item 3): layer-aware fusion planning,
+the custom_vjp grad taps, and DistributedGradientTransform(overlap=True).
+
+Three layers of coverage, all CPU:
+
+* planner units — EntrySig.layer keeps buckets from spanning layers,
+  plan_dispatch orders them reverse-layer with the layer-less buckets
+  last, native core parity;
+* jaxpr position — the acceptance pin: the armed step's per-layer
+  collectives sit INSIDE the backward scan's sub-jaxpr (interleaved
+  with the remaining backprop), sharded's updates all-gather stays at
+  the step boundary, and under backward_passes_per_step > 1 every tap
+  collective is gated under the boundary cond;
+* runtime parity on the pmap mesh — the one-program fire-gated A/B is
+  bit-exact (incl. sharded x int8), the boundary fallback matches the
+  plain fused path, and k>1 overlapped training matches the replicated
+  path at mesh 2 AND 4 while dispatching only at the boundary.
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.fusion import (DispatchSchedule, EntrySig,
+                                    plan_dispatch, plan_fusion)
+from horovod_tpu.optim import overlap as ov
+from horovod_tpu.optim.distributed import (DistributedOptimizer,
+                                           state_partition_specs)
+
+AXIS = "ow"
+
+
+def _sig(name, layer=-1, dtype="float32", shape=(8,)):
+    return EntrySig(name=name, op_type="allreduce", reduce_op="average",
+                    dtype=dtype, shape=shape, process_set_id=0,
+                    stacked=False, prescale=1.0, postscale=1.0,
+                    layer=layer)
+
+
+# ---------------------------------------------------------------------------
+# planner: the layer key and the dispatch schedule
+# ---------------------------------------------------------------------------
+
+def test_layer_key_prevents_cross_layer_fusion():
+    sigs = [_sig("a", layer=0), _sig("b", layer=1), _sig("c", layer=0)]
+    plan = plan_fusion(sigs, 1 << 20)
+    # same dtype, tiny sizes — WOULD fuse into one bucket without the
+    # layer key; with it, layer 0 and layer 1 never share a bucket
+    assert plan == [[0, 2], [1]]
+
+
+def test_default_layer_changes_no_existing_plan():
+    sigs = [_sig("a"), _sig("b"), _sig("c")]
+    assert plan_fusion(sigs, 1 << 20) == [[0, 1, 2]]
+    assert _sig("a").layer == -1
+
+
+def test_plan_dispatch_reverse_layer_order_root_last():
+    sigs = [_sig("root_x"), _sig("a", layer=0), _sig("a", layer=1),
+            _sig("a", layer=2)]
+    plan = plan_fusion(sigs, 1 << 20)
+    # plan order: layer -1 first (sorts lowest), then 0, 1, 2
+    layers = [sigs[b[0]].layer for b in plan]
+    assert layers == [-1, 0, 1, 2]
+    sched = plan_dispatch(sigs, plan)
+    assert isinstance(sched, DispatchSchedule)
+    assert sched.layers == (-1, 0, 1, 2)
+    # dispatch: layer 2 first (backprop runs it first), root (-1) last
+    assert [sched.layers[b] for b in sched.order] == [2, 1, 0, -1]
+
+
+def test_plan_dispatch_rejects_layer_spanning_bucket():
+    sigs = [_sig("a", layer=0), _sig("b", layer=1)]
+    with pytest.raises(ValueError, match="spans layers"):
+        plan_dispatch(sigs, [[0, 1]])
+
+
+def test_native_planner_parity_with_layers():
+    from horovod_tpu.native import loader
+    core = loader.load()
+    if core is None:
+        pytest.skip("native core not built")
+    sigs = [_sig("r1"), _sig("a", layer=2), _sig("a", layer=0),
+            _sig("b", layer=0), _sig("z", layer=1),
+            _sig("bf", layer=0, dtype="bfloat16")]
+    for threshold in (16, 64, 1 << 20):
+        py = plan_fusion(sigs, threshold)
+        nat = core.plan_fusion_sigs(sigs, threshold)
+        assert [list(b) for b in nat] == py, threshold
+        py_d = plan_dispatch(sigs, py)
+        order, layers = core.plan_dispatch_sigs(sigs, py)
+        assert tuple(order) == py_d.order
+        assert tuple(layers) == py_d.layers
+
+
+def test_native_dispatch_rejects_spanning_bucket():
+    from horovod_tpu.native import loader
+    core = loader.load()
+    if core is None:
+        pytest.skip("native core not built")
+    sigs = [_sig("a", layer=0), _sig("b", layer=1)]
+    with pytest.raises(ValueError, match="spans layers"):
+        core.plan_dispatch_sigs(sigs, [[0, 1]])
+
+
+# ---------------------------------------------------------------------------
+# layout building
+# ---------------------------------------------------------------------------
+
+def _toy_params(L=3, D=8, V=5):
+    rng = np.random.default_rng(0)
+    return {
+        "embed": jnp.asarray(rng.standard_normal((V, D)), jnp.float32),
+        "layers": {"w": jnp.asarray(
+            rng.standard_normal((L, D, D)) * 0.1, jnp.float32),
+            "b": jnp.zeros((L, D), jnp.float32)},
+        "final_norm": jnp.ones((D,), jnp.float32),
+    }
+
+
+def _toy_loss(params, x):
+    params = ov.tap_root(params)
+    h = x @ params["embed"]
+
+    def body(h, lp):
+        lp = ov.grad_tap(lp)
+        return jnp.tanh(h @ lp["w"] + lp["b"]), None
+
+    h, _ = jax.lax.scan(body, h, params["layers"])
+    return ((h * params["final_norm"]) ** 2).sum()
+
+
+def _plan(**kw):
+    defaults = dict(axis_name=AXIS, op="average", threshold_bytes=256,
+                    prescale=1.0, postscale=1.0, sharded=False, fmt=None,
+                    k=1)
+    defaults.update(kw)
+    return ov.OverlapPlan(**defaults)
+
+
+def test_build_layout_expands_layers():
+    params = _toy_params(L=3)
+    leaves, layout = ov.build_layout(params, _plan(), shards=1)
+    # b and w expand to 3 per-layer entries each; embed/final_norm are
+    # single layer=-1 entries
+    layered = [e for e in layout.entries if e.layer >= 0]
+    roots = [e for e in layout.entries if e.layer < 0]
+    assert len(layered) == 6 and len(roots) == 2
+    assert {e.layer for e in layered} == {0, 1, 2}
+    # every bucket is single-layer and the dispatch runs reverse-layer
+    # with roots last
+    by_bucket = [layout.dispatch.layers[b] for b in layout.dispatch.order]
+    layered_part = [l for l in by_bucket if l >= 0]
+    assert layered_part == sorted(layered_part, reverse=True)
+    assert all(l == -1 for l in by_bucket[len(layered_part):])
+
+
+def test_build_layout_force_root_no_expansion():
+    params = _toy_params(L=3)
+    _leaves, layout = ov.build_layout(params, _plan(), shards=1,
+                                      force_root=True)
+    assert all(e.layer == -1 for e in layout.entries)
+    assert len(layout.entries) == 4
+
+
+def test_build_layout_inconsistent_layer_count_raises():
+    params = {"layers": {"a": jnp.zeros((3, 4)), "b": jnp.zeros((2, 4))}}
+    with pytest.raises(ValueError, match="disagree on the layer count"):
+        ov.build_layout(params, _plan(), shards=1)
+
+
+# ---------------------------------------------------------------------------
+# context / tap plumbing
+# ---------------------------------------------------------------------------
+
+def test_grad_tap_is_identity_outside_context():
+    tree = {"a": jnp.ones((3,))}
+    assert ov.grad_tap(tree) is tree
+    assert ov.tap_root(tree) is tree
+
+
+def test_plan_for_rejects_plain_transform():
+    with pytest.raises(ValueError, match="overlap=True"):
+        ov.plan_for(optax.adam(1e-3))
+    with pytest.raises(ValueError, match="overlap=True"):
+        ov.plan_for(DistributedOptimizer(optax.adam(1e-3),
+                                         axis_name=AXIS, overlap=False))
+
+
+def test_context_nesting_rejected():
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=AXIS,
+                              overlap=True)
+    with ov.overlapped_backprop(tx):
+        with pytest.raises(RuntimeError, match="do not nest"):
+            with ov.overlapped_backprop(tx):
+                pass
+    assert not ov.active()
+
+
+def test_k_gt_1_requires_count_and_rejects_fire():
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=AXIS,
+                              overlap=True, backward_passes_per_step=2)
+    with pytest.raises(ValueError, match="count=state.count"):
+        with ov.overlapped_backprop(tx):
+            pass
+    with pytest.raises(ValueError, match="not an explicit fire"):
+        with ov.overlapped_backprop(tx, count=jnp.int32(0),
+                                    fire=jnp.bool_(True)):
+            pass
+
+
+def test_overlap_requires_axis_name_and_summable_op():
+    with pytest.raises(ValueError, match="requires axis_name"):
+        DistributedOptimizer(optax.adam(1e-3), overlap=True)
+    with pytest.raises(ValueError, match="Average/Sum"):
+        DistributedOptimizer(optax.adam(1e-3), axis_name=AXIS,
+                             overlap=True, op=hvd.Adasum)
+
+
+def test_no_taps_fired_warns(caplog):
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=AXIS,
+                              overlap=True)
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        with ov.overlapped_backprop(tx):
+            pass
+    assert any("no grad taps fired" in r.message for r in caplog.records)
+    tx.update  # keep the transform alive past the context
+
+
+def test_failed_trace_does_not_commit_the_handshake():
+    # a body that raises must NOT leave a stale fired count: the next
+    # context-less update would treat raw grads as pre-reduced
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=AXIS,
+                              overlap=True)
+    plan = ov.plan_for(tx)
+    with pytest.raises(RuntimeError, match="boom"):
+        with ov.overlapped_backprop(tx):
+            ov.grad_tap({"a": jnp.ones((4,))})
+            raise RuntimeError("boom")
+    assert not ov.active()
+    assert plan.consume_fired() == (0, None)
+
+
+def test_tap_root_rejects_non_dict_params_when_armed():
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=AXIS,
+                              overlap=True)
+    tup = (jnp.ones((2,)),)
+    assert ov.tap_root(tup) is tup          # unarmed: pass-through
+    with ov.overlapped_backprop(tx):
+        ov.grad_tap({"a": jnp.ones((2,))})  # silence the no-taps warning
+        with pytest.raises(TypeError, match="dict param tree"):
+            ov.tap_root(tup)
+
+
+def test_tap_root_honors_the_armed_plans_layers_key():
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=AXIS,
+                              overlap=True, overlap_layers="blocks")
+    params = {"blocks": {"w": jnp.zeros((2, 4))}, "embed": jnp.ones((4,))}
+    with ov.overlapped_backprop(tx) as token:
+        out = ov.tap_root(params)
+        # the custom stack key is excluded (NOT double-tapped: the
+        # subtree object passes through untouched) while the root leaf
+        # went through one tap
+        assert out["blocks"] is params["blocks"]
+        assert token.fired == 1
+        assert set(out) == set(params)
+
+
+def test_new_context_discards_unconsumed_handshake(caplog):
+    # an armed trace that never reached tx.update must not poison the
+    # next armed trace's count; arming again supersedes (with a warning)
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=AXIS,
+                              overlap=True)
+    plan = ov.plan_for(tx)
+    with ov.overlapped_backprop(tx):
+        ov.grad_tap({"a": jnp.ones((4,))})
+    assert plan._fired == 1  # pending: no update consumed it
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        with ov.overlapped_backprop(tx):
+            ov.grad_tap({"a": jnp.ones((4,))})
+    assert any("discarding an unconsumed" in r.message
+               for r in caplog.records)
+    assert plan.consume_fired()[0] == 1  # only the NEW trace's tap
+
+
+def test_train_step_overlap_rejects_moe():
+    # MoE aliases ep onto dp: expert weights are dp-SHARDED, so the
+    # dp-averaging taps would corrupt them — the builder must refuse
+    from horovod_tpu.models import llama as llama_mod
+    from horovod_tpu.parallel.mesh import MeshConfig, ParallelMesh
+    from horovod_tpu import training
+    cfg = llama_mod.tiny()
+    cfg = __import__("dataclasses").replace(cfg, n_experts=4)
+    pmesh = ParallelMesh(MeshConfig(dp=2))
+    with pytest.raises(ValueError, match="DENSE"):
+        training.make_llama_train_step(cfg, pmesh, overlap=True)
+
+
+def test_env_default_enables_overlap(monkeypatch):
+    monkeypatch.setenv("HOROVOD_OVERLAP", "1")
+    from horovod_tpu.config import Config
+    assert Config.from_env().overlap is True
+    # env fallback path (no initialized runtime config snapshot)
+    from horovod_tpu import runtime
+    monkeypatch.setattr(runtime._state(), "config", None)
+    from horovod_tpu.optim.distributed import _overlap_default
+    assert _overlap_default() is True
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=AXIS)
+    ov.plan_for(tx)  # registered => overlap mode took the env default
+
+
+def test_overlap_metrics_counter_increments():
+    from horovod_tpu import metrics as _metrics
+    if not _metrics.ACTIVE:
+        pytest.skip("metrics disabled")
+    tx = DistributedOptimizer(optax.sgd(1e-2), axis_name=AXIS,
+                              threshold_bytes=128, overlap=True)
+
+    def step(g):
+        with ov.overlapped_backprop(tx):
+            _, gr = jax.value_and_grad(
+                lambda p: (ov.grad_tap(p)["a"] ** 2).sum())({"a": g})
+        return gr
+
+    jax.make_jaxpr(step, axis_env=[(AXIS, 2)])(jnp.zeros((8,)))
+    # trace-time accounting, registry-global: a positive bwd sample
+    # must now ride the Prometheus exposition
+    text = _metrics.render_prometheus()
+    assert "hvd_overlap_buckets_dispatched_total" in text
+    assert 'phase="bwd"' in text
+
+
+# ---------------------------------------------------------------------------
+# jaxpr position: the acceptance pin
+# ---------------------------------------------------------------------------
+
+def _trace_armed(tx, use_ctx=True, count=None, L=3):
+    from horovod_tpu.analysis.schedule import trace_schedule
+    params = _toy_params(L=L)
+    spec = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+    x = jax.ShapeDtypeStruct((2, 5), jnp.float32)
+
+    def step(p, xb):
+        s = tx.init(p)
+        if use_ctx:
+            kw = {} if count is None else {"count": s.count}
+            with hvd.overlapped_backprop(tx, **kw):
+                _l, g = jax.value_and_grad(_toy_loss)(p, xb)
+        else:
+            _l, g = jax.value_and_grad(_toy_loss)(p, xb)
+        u, _ = tx.update(g, s, p)
+        return u
+
+    return trace_schedule(step, (spec, x), axis_env=[(AXIS, 2)],
+                          entry="t")
+
+
+def test_collectives_interleave_inside_backward_scan():
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=AXIS,
+                              threshold_bytes=256, overlap=True)
+    s = _trace_armed(tx)
+    in_scan = [r for r in s.records if "scan" in r.path]
+    at_top = [r for r in s.records if "scan" not in r.path]
+    # per-layer buckets dispatch inside the backward scan (interleaving
+    # depth >= 1: the record's path descends into the scan sub-jaxpr),
+    # NOT as a post-backprop block
+    assert in_scan and all(r.prim == "psum" for r in in_scan)
+    assert all(r.bucket is not None for r in in_scan)
+    # the root (embed/final_norm) bucket reduces at the end of backprop
+    assert len(at_top) == 1 and at_top[0].prim == "psum"
+    # trace order: the scan's dispatches precede the root's
+    assert max(r.index for r in in_scan) < at_top[0].index
+
+
+def test_unarmed_step_keeps_collectives_out_of_the_scan():
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=AXIS,
+                              threshold_bytes=256, overlap=True)
+    s = _trace_armed(tx, use_ctx=False)
+    assert s.records and all("scan" not in r.path for r in s.records)
+
+
+def test_sharded_overlap_schedule_scatter_in_scan_gather_at_boundary():
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=AXIS,
+                              threshold_bytes=256, overlap=True,
+                              sharded_update=True)
+    s = _trace_armed(tx)
+    in_scan = [r for r in s.records if "scan" in r.path]
+    assert in_scan and all(r.prim == "reduce_scatter" for r in in_scan)
+    gathers = [r for r in s.records if r.prim == "all_gather"]
+    # the updates all-gather stays at the step boundary
+    assert gathers and all("scan" not in r.path for r in gathers)
+    scatters = [r for r in s.records if r.prim == "reduce_scatter"]
+    assert all(r.params["tiled"] is True for r in scatters)
+
+
+def test_k2_taps_are_gated_under_the_boundary_cond():
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=AXIS,
+                              threshold_bytes=256, overlap=True,
+                              backward_passes_per_step=2)
+    s = _trace_armed(tx, count=True)
+    # every backward-scan dispatch is inside a cond branch (the
+    # accumulation-boundary gate): intermediate micro-steps move zero
+    # gradient bytes
+    in_scan = [r for r in s.records if "scan" in r.path]
+    assert in_scan
+    assert all("cond" in r.path for r in in_scan), \
+        [(r.prim, r.path) for r in in_scan]
+
+
+def test_builtin_overlapped_entry_position_pins():
+    # the committed snapshot's structural claim, pinned in-process
+    from horovod_tpu.analysis.schedule import builtin_schedule
+    s = builtin_schedule("overlapped_distopt_step")
+    in_scan = [r for r in s.records if "scan" in r.path]
+    at_top = [r for r in s.records if "scan" not in r.path]
+    assert len(in_scan) == 2          # fp32 + bf16 per-layer buckets
+    assert [r.bucket for r in in_scan] == [0, 1]
+    assert len(at_top) == 1           # the root tap's bucket
+    assert max(r.index for r in in_scan) < at_top[0].index
+
+
+# ---------------------------------------------------------------------------
+# runtime parity on the pmap mesh
+# ---------------------------------------------------------------------------
+
+def _run_traj(tx, params, X, n, steps=3, mode="armed", count=False):
+    """mode: armed | unarmed | fire_true | fire_false."""
+    state0 = jax.pmap(lambda p, _: tx.init(p), axis_name=AXIS,
+                      in_axes=(None, 0))(params, np.zeros(n))
+
+    def step(p, s, xb, fire):
+        if mode == "unarmed":
+            _l, g = jax.value_and_grad(_toy_loss)(p, xb)
+        elif mode == "armed":
+            kw = {"count": s.count} if count else {}
+            with hvd.overlapped_backprop(tx, **kw):
+                _l, g = jax.value_and_grad(_toy_loss)(p, xb)
+        else:
+            with hvd.overlapped_backprop(tx, fire=fire):
+                _l, g = jax.value_and_grad(_toy_loss)(p, xb)
+        u, ns = tx.update(g, s, p)
+        return optax.apply_updates(p, u), ns
+
+    f = jax.pmap(step, axis_name=AXIS, in_axes=(None, 0, 0, None))
+    fire = jnp.asarray(mode == "fire_true")
+    p, s = params, state0
+    for _ in range(steps):
+        pk, s = f(p, s, X, fire)
+        for leaf in jax.tree_util.tree_leaves(pk):
+            a = np.asarray(leaf)
+            assert (a[0] == a[-1]).all(), "replicas diverged"
+        p = jax.tree_util.tree_map(lambda a: a[0], pk)
+    return p
+
+
+def _bit_equal(a, b):
+    return all((np.asarray(x) == np.asarray(y)).all()
+               for x, y in zip(jax.tree_util.tree_leaves(a),
+                               jax.tree_util.tree_leaves(b)))
+
+
+def _allclose(a, b, rtol=2e-5, atol=1e-7):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("kw", [
+    {},
+    {"sharded_update": True},
+    {"wire_format": "int8", "wire_block_size": 16},
+    {"sharded_update": True, "wire_format": "int8",
+     "wire_block_size": 16},
+], ids=["plain", "sharded", "int8", "int8_sharded"])
+def test_fire_gated_ab_is_bit_exact(kw):
+    n = 2
+    params = _toy_params()
+    rng = np.random.default_rng(1)
+    X = jnp.asarray(rng.standard_normal((n, 2, 5)), jnp.float32)
+    tx = DistributedOptimizer(optax.adam(1e-2), axis_name=AXIS,
+                              threshold_bytes=256, overlap=True, **kw)
+    p_on = _run_traj(tx, params, X, n, mode="fire_true")
+    p_off = _run_traj(tx, params, X, n, mode="fire_false")
+    assert _bit_equal(p_on, p_off)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_overlap_matches_plain_fused_path(n):
+    params = _toy_params()
+    rng = np.random.default_rng(1)
+    X = jnp.asarray(rng.standard_normal((n, 2, 5)), jnp.float32)
+    tx_ov = DistributedOptimizer(optax.adam(1e-2), axis_name=AXIS,
+                                 threshold_bytes=256, overlap=True)
+    tx_pl = DistributedOptimizer(optax.adam(1e-2), axis_name=AXIS,
+                                 threshold_bytes=256, overlap=False)
+    p_ov = _run_traj(tx_ov, params, X, n, mode="armed")
+    p_pl = _run_traj(tx_pl, params, X, n, mode="unarmed")
+    _allclose(p_ov, p_pl)
+
+
+def test_boundary_fallback_matches_armed():
+    # forgot-the-context safety: same transform, taps never armed —
+    # the identical layer-aware plan runs at the boundary
+    n = 2
+    params = _toy_params()
+    rng = np.random.default_rng(1)
+    X = jnp.asarray(rng.standard_normal((n, 2, 5)), jnp.float32)
+    tx = DistributedOptimizer(optax.adam(1e-2), axis_name=AXIS,
+                              threshold_bytes=256, overlap=True)
+    p_armed = _run_traj(tx, params, X, n, mode="armed")
+    p_fall = _run_traj(tx, params, X, n, mode="unarmed")
+    _allclose(p_armed, p_fall, rtol=1e-6, atol=1e-8)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+@pytest.mark.parametrize("sharded", [False, True],
+                         ids=["replicated", "sharded"])
+def test_k2_overlap_parity_vs_replicated_path(n, sharded):
+    # the backward_passes_per_step satellite: overlapped dispatch fires
+    # only at the accumulation boundary (schedule pin above) and the
+    # training trajectory matches the non-overlapped k=2 path
+    params = _toy_params()
+    rng = np.random.default_rng(2)
+    Xs = [jnp.asarray(rng.standard_normal((n, 2, 5)), jnp.float32)
+          for _ in range(4)]
+    def run(tx, mode, count=False):
+        state0 = jax.pmap(lambda p, _: tx.init(p), axis_name=AXIS,
+                          in_axes=(None, 0))(params, np.zeros(n))
+
+        def step(p, s, xb):
+            if mode == "armed":
+                with hvd.overlapped_backprop(tx, count=s.count):
+                    _l, g = jax.value_and_grad(_toy_loss)(p, xb)
+            else:
+                _l, g = jax.value_and_grad(_toy_loss)(p, xb)
+            u, ns = tx.update(g, s, p)
+            return optax.apply_updates(p, u), ns
+
+        f = jax.pmap(step, axis_name=AXIS, in_axes=(None, 0, 0))
+        p, s = params, state0
+        for X in Xs:
+            pk, s = f(p, s, X)
+            for leaf in jax.tree_util.tree_leaves(pk):
+                a = np.asarray(leaf)
+                assert (a[0] == a[-1]).all(), "replicas diverged"
+            p = jax.tree_util.tree_map(lambda a: a[0], pk)
+        return p
+
+    tx_ov = DistributedOptimizer(optax.adam(1e-2), axis_name=AXIS,
+                                 threshold_bytes=256, overlap=True,
+                                 backward_passes_per_step=2,
+                                 sharded_update=sharded)
+    tx_ref = DistributedOptimizer(optax.adam(1e-2), axis_name=AXIS,
+                                  threshold_bytes=256, overlap=False,
+                                  backward_passes_per_step=2)
+    _allclose(run(tx_ov, "armed"), run(tx_ref, "unarmed"))
+
+
+def test_sharded_overlap_state_is_fractional_and_specs_shard():
+    n = 4
+    params = _toy_params()
+    tx = DistributedOptimizer(optax.adam(1e-2), axis_name=AXIS,
+                              threshold_bytes=256, overlap=True,
+                              sharded_update=True)
+    state = jax.pmap(lambda p, _: tx.init(p), axis_name=AXIS,
+                     in_axes=(None, 0))(params, np.zeros(n))
+    from horovod_tpu.optim.precision import tree_nbytes
+    per_worker = jax.tree_util.tree_map(lambda a: a[0], state)
+    total = sum(int(a.size) for a in jax.tree_util.tree_leaves(params))
+    # adam: mu+nu per bucket tile; per worker ~ 2*total/n + padding
+    got = tree_nbytes(per_worker.inner)
+    assert got < 2 * total * 4 / n * 1.25, (got, total)
+    specs = state_partition_specs(per_worker, AXIS, sharded_update=True)
+    from jax.sharding import PartitionSpec as P
+    non_scalar = [s for s in jax.tree_util.tree_leaves(
+        specs.inner, is_leaf=lambda x: isinstance(x, P))
+        if s == P(AXIS)]
+    assert non_scalar
+
+
+def test_bf16_leaves_keep_their_own_buckets():
+    # mixed dtypes inside one layer: separate buckets, still per-layer
+    params = {"layers": {"w": jnp.zeros((2, 4, 4), jnp.float32),
+                         "s": jnp.zeros((2, 4), jnp.bfloat16)}}
+    _leaves, layout = ov.build_layout(params, _plan(), shards=1)
+    assert len(layout.buckets) == 4  # 2 dtypes x 2 layers
+    dtypes_per_bucket = set()
+    for bl in layout.buckets:
+        ds = {str(layout.entry_shapes[i]) for i in bl.indices}
+        dtypes_per_bucket.add(tuple(sorted(ds)))
+    layers = layout.dispatch.layers
+    assert sorted(layers) == [0, 0, 1, 1]

@@ -1,0 +1,84 @@
+"""analysis/wire.py: the shared ring-model wire-byte accounting used by
+tools/bench_zero.py, bench_compression.py and bench_overlap.py."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from horovod_tpu.analysis.schedule import CollectiveRecord, trace_schedule
+from horovod_tpu.analysis.wire import (aval_nbytes, ring_transmit_bytes,
+                                       schedule_prim_counts,
+                                       schedule_transmit_bytes,
+                                       trace_transmit_bytes)
+
+
+def _rec(prim, inputs, outputs, axes=("w",)):
+    return CollectiveRecord(index=0, prim=prim, axes=list(axes),
+                            inputs=inputs, outputs=outputs, path="",
+                            bucket=None, params={})
+
+
+def test_aval_nbytes():
+    assert aval_nbytes("float32[8x16]") == 8 * 16 * 4
+    assert aval_nbytes("bfloat16[10]") == 20
+    assert aval_nbytes("int8[256]") == 256
+    assert aval_nbytes("float32[]") == 4
+
+
+def test_aval_nbytes_rejects_garbage():
+    with pytest.raises(ValueError, match="unparseable"):
+        aval_nbytes("float32[8,16]")
+
+
+def test_ring_formulas():
+    sizes = {"w": 4}
+    # psum: 2(n-1)/n of the payload
+    assert ring_transmit_bytes(
+        _rec("psum", ["float32[100]"], ["float32[100]"]), sizes) == \
+        2 * 3 * 400 // 4
+    # reduce-scatter / all_to_all: (n-1)/n of the INPUT
+    assert ring_transmit_bytes(
+        _rec("reduce_scatter", ["float32[100]"], ["float32[25]"]),
+        sizes) == 3 * 400 // 4
+    assert ring_transmit_bytes(
+        _rec("all_to_all", ["int8[64]"], ["int8[64]"]), sizes) == \
+        3 * 64 // 4
+    # all_gather: (n-1)/n of the OUTPUT
+    assert ring_transmit_bytes(
+        _rec("all_gather", ["float32[25]"], ["float32[100]"]),
+        sizes) == 3 * 400 // 4
+
+
+def test_axis_filter_and_unknown_axes():
+    sizes = {"dcn": 2, "ici": 4}
+    r = _rec("psum", ["float32[64]"], ["float32[64]"], axes=("ici",))
+    assert ring_transmit_bytes(r, sizes, axis_filter="dcn") == 0
+    assert ring_transmit_bytes(r, sizes, axis_filter="ici") == \
+        2 * 3 * 256 // 4
+    # collectives over axes not being accounted contribute zero
+    assert ring_transmit_bytes(
+        _rec("psum", ["float32[64]"], ["float32[64]"], axes=("tp",)),
+        sizes) == 0
+
+
+def test_single_worker_axis_is_free():
+    assert ring_transmit_bytes(
+        _rec("psum", ["float32[64]"], ["float32[64]"]), {"w": 1}) == 0
+
+
+def test_schedule_accounting_from_a_trace():
+    def step(x):
+        a = jax.lax.psum(x, "w")                       # 2(n-1)/n * 256
+        b = jax.lax.psum_scatter(x, "w", tiled=True)   # (n-1)/n * 256
+        return a, b
+
+    sched = trace_schedule(step, (jax.ShapeDtypeStruct((64,),
+                                                       jnp.float32),),
+                           axis_env=[("w", 4)], entry="t")
+    assert schedule_prim_counts(sched) == {"psum": 1,
+                                           "reduce_scatter": 1}
+    want = 2 * 3 * 256 // 4 + 3 * 256 // 4
+    assert schedule_transmit_bytes(sched) == want
+    # the one-call convenience form the benches use
+    assert trace_transmit_bytes(step, (jax.ShapeDtypeStruct(
+        (64,), jnp.float32),), [("w", 4)]) == want

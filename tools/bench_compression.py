@@ -31,7 +31,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -47,52 +46,12 @@ def _setup_jax(n_devices: int):
     return jax
 
 
-_AVAL_RE = re.compile(r"^(\w+)\[([\dx]*)\]$")
-
-
-def _aval_bytes(aval: str) -> int:
-    from horovod_tpu.ops.fusion import dtype_nbytes
-    m = _AVAL_RE.match(aval)
-    if not m:
-        raise ValueError(f"unparseable aval {aval!r}")
-    dims = [int(d) for d in m.group(2).split("x")] if m.group(2) else []
-    numel = 1
-    for d in dims:
-        numel *= d
-    return numel * dtype_nbytes(m.group(1))
-
-
-def ring_transmit_bytes(record, axis_sizes, axis_filter=None) -> int:
-    """Per-worker transmit bytes of one collective under the standard
-    ring algorithms: psum (allreduce) moves 2(n-1)/n of the payload,
-    reduce-scatter/all_to_all (n-1)/n of the input, all_gather (n-1)/n
-    of the OUTPUT.  ``axis_filter`` restricts accounting to collectives
-    over that axis (e.g. only the DCN hop)."""
-    axes = [a for a in record.axes if a in axis_sizes]
-    if axis_filter is not None and axis_filter not in axes:
-        return 0
-    n = 1
-    for a in axes:
-        n *= axis_sizes[a]
-    if n <= 1:
-        return 0
-    in_bytes = sum(_aval_bytes(a) for a in record.inputs)
-    out_bytes = sum(_aval_bytes(a) for a in record.outputs)
-    if record.prim == "psum":
-        return (2 * (n - 1) * in_bytes) // n
-    if record.prim in ("psum_scatter", "reduce_scatter", "all_to_all"):
-        return ((n - 1) * in_bytes) // n
-    if record.prim == "all_gather":
-        return ((n - 1) * out_bytes) // n
-    return in_bytes  # conservative for anything unexpected
-
-
 def _schedule_bytes(fn, args, axis_env, axis_filter=None):
-    from horovod_tpu.analysis.schedule import trace_schedule
-    sched = trace_schedule(fn, args, axis_env=axis_env, entry="bench")
-    sizes = dict(axis_env)
-    return sum(ring_transmit_bytes(r, sizes, axis_filter)
-               for r in sched.records)
+    # ring-model accounting shared with bench_zero/bench_overlap
+    # (horovod_tpu/analysis/wire.py; unit-tested in tests/test_wire.py)
+    from horovod_tpu.analysis.wire import trace_transmit_bytes
+    return trace_transmit_bytes(fn, args, axis_env, axis_filter,
+                                entry="bench")
 
 
 def bench_dcn_wire(jax, numel: int, groups: int, group: int, fmt):

@@ -1,0 +1,311 @@
+#!/usr/bin/env python
+"""Overlapped-dispatch microbench: schedule position, bit-exact parity,
+and step-time A/B on the CPU mesh.
+
+Measures what ROADMAP item 3 changes — WHERE the in-jit gradient
+collectives sit relative to the backward pass — on the virtual CPU mesh
+(``pmap`` over ``--xla_force_host_platform_device_count`` devices).
+Three readings per configuration (plain / sharded_update / int8 wire /
+int8 × sharded):
+
+  * **schedule position**: the traced collective schedule
+    (``analysis/schedule.py``) of the armed step must carry every
+    per-layer fusion bucket INSIDE the backward scan's sub-jaxpr (the
+    overlap claim), with only the root buckets — and, sharded, the
+    updates all-gather — at the step boundary; the un-armed step's
+    schedule must have NO collective inside the scan.  Ring-model wire
+    bytes (``analysis/wire.py``) of the two schedules must match:
+    overlap moves the bytes earlier, it does not change them.
+  * **bit-exact weight parity**: the A/B runs ONE compiled program with
+    a runtime ``fire`` gate (``overlapped_backprop(tx, fire=...)``) —
+    overlapped dispatch in the true branch, the identical layer-aware
+    plan at the boundary in the false branch — so after ``--steps``
+    adam steps the weights must be BIT-IDENTICAL, including under
+    sharded_update and the int8 wire format where block partitioning
+    decides the bits.  (Two separately compiled programs differ by XLA
+    fusion ulps in the optimizer arithmetic — outside this rewrite's
+    surface — which is exactly why the gate is a runtime input.)
+  * **step time**: median over ``--repeats`` of the same program with
+    the gate on vs off (CPU collectives are memcpys, so this is a
+    regression canary, not a DCN claim; the real-chip A/B is
+    ``examples/llama_benchmark.py --overlap``).
+
+    python tools/bench_overlap.py               # 4-way mesh
+    python tools/bench_overlap.py --smoke       # CI: fast, asserts only
+
+Results print as JSON; see docs/performance.md "Overlapped dispatch".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _setup_jax(n_devices: int):
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+def _make_params(jax, n_layers: int, width: int):
+    """A scanned-model param tree: stacked layers (the lax.scan stack
+    the taps cover) plus non-scanned root leaves (tied embed + norm)."""
+    import jax.numpy as jnp
+    import numpy as np
+    rng = np.random.default_rng(0)
+
+    def r(*shape):
+        return jnp.asarray(rng.standard_normal(shape) * 0.1, jnp.float32)
+
+    return {
+        "embed": r(width // 2 + 3, width),
+        "layers": {
+            "w_in": r(n_layers, width, width),
+            "w_out": r(n_layers, width, width),
+            "b": jnp.zeros((n_layers, width), jnp.float32),
+        },
+        "final_norm": jnp.ones((width,), jnp.float32),
+    }
+
+
+def _model_loss(ov, params, x):
+    import jax
+    import jax.numpy as jnp
+    params = ov.tap_root(params)
+    h = x @ params["embed"]
+
+    def body(h, lp):
+        lp = ov.grad_tap(lp)
+        h = jnp.tanh(h @ lp["w_in"] + lp["b"]) @ lp["w_out"]
+        return h, None
+
+    h, _ = jax.lax.scan(body, h, params["layers"])
+    return ((h * params["final_norm"]) ** 2).sum()
+
+
+def _trace_schedules(jax, tx, params, axis, n):
+    """(overlapped, boundary) schedules of the same step — armed vs
+    un-armed context."""
+    import functools
+    import horovod_tpu as hvd
+    from horovod_tpu.analysis.schedule import trace_schedule
+    from horovod_tpu.optim import overlap as ov
+    spec = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+    x = jax.ShapeDtypeStruct((2, params["embed"].shape[0]), params[
+        "embed"].dtype)
+    loss_fn = functools.partial(_model_loss, ov)
+
+    def step_armed(p, xb):
+        s = tx.init(p)
+        with hvd.overlapped_backprop(tx):
+            _l, g = jax.value_and_grad(loss_fn)(p, xb)
+        u, _ = tx.update(g, s, p)
+        return u
+
+    def step_boundary(p, xb):
+        s = tx.init(p)
+        _l, g = jax.value_and_grad(loss_fn)(p, xb)
+        u, _ = tx.update(g, s, p)
+        return u
+
+    env = [(axis, n)]
+    return (trace_schedule(step_armed, (spec, x), axis_env=env,
+                           entry="bench_overlap"),
+            trace_schedule(step_boundary, (spec, x), axis_env=env,
+                           entry="bench_overlap_boundary"))
+
+
+def _check_schedules(sched_ov, sched_bd, sharded: bool, n_layers: int):
+    """The schedule-position invariants — the overlap claim itself."""
+    from horovod_tpu.analysis.wire import (ring_transmit_bytes,
+                                           schedule_prim_counts,
+                                           schedule_transmit_bytes)
+    in_scan = [r for r in sched_ov.records if "scan" in r.path]
+    at_top = [r for r in sched_ov.records if "scan" not in r.path]
+    # every per-layer bucket dispatches inside the backward scan; the
+    # scan body is traced once, so the records are per-bucket-per-layer
+    # templates (reverse layer order is the scan's execution order)
+    assert in_scan, "no collective inside the backward scan"
+    assert all(r.bucket is not None for r in in_scan), in_scan
+    # sharded: only the reduce-scatter side ever enters the scan (the
+    # quantized staging exchanges tiles with all_to_all); non-sharded
+    # allreduce may stage its own RS+AG (quantized) or one psum
+    allowed = (("reduce_scatter", "all_to_all") if sharded
+               else ("psum", "all_to_all", "all_gather"))
+    assert all(r.prim in allowed for r in in_scan), \
+        [r.prim for r in in_scan]
+    if sharded:
+        # the updates all-gather stays at the step boundary
+        gathers = [r for r in sched_ov.records if r.prim == "all_gather"]
+        assert gathers and all("scan" not in r.path for r in gathers), \
+            [(r.prim, r.path) for r in gathers]
+    # every scan-resident record precedes every boundary record of the
+    # gradient reduction (the root taps + updates path run after the
+    # backward scan completes)
+    first_top = min((r.index for r in at_top), default=len(
+        sched_ov.records))
+    assert all(r.index < first_top for r in in_scan), \
+        "scan records after boundary records"
+    # the un-armed step keeps ALL collectives out of the scan (one
+    # fused block after backprop — the exposed-latency baseline)
+    assert all("scan" not in r.path for r in sched_bd.records), \
+        [(r.prim, r.path) for r in sched_bd.records]
+    # overlap moves bytes, it does not change them: the backward scan's
+    # records are per-layer TEMPLATES executed n_layers times at
+    # runtime, so runtime ring bytes = boundary-resident bytes +
+    # n_layers x scan-resident bytes — and that must equal the un-armed
+    # step's schedule exactly (same plan, different positions)
+    sizes = dict(sched_ov.axis_env)
+    scan_bytes = sum(ring_transmit_bytes(r, sizes) for r in in_scan)
+    top_bytes = sum(ring_transmit_bytes(r, sizes) for r in at_top)
+    ov_bytes = top_bytes + n_layers * scan_bytes
+    bd_bytes = schedule_transmit_bytes(sched_bd)
+    assert ov_bytes == bd_bytes, (ov_bytes, bd_bytes)
+    counts_ov = schedule_prim_counts(sched_ov)
+    counts_bd = schedule_prim_counts(sched_bd)
+    return {
+        "collectives_in_backward_scan": len(in_scan),
+        "collectives_at_boundary": len(at_top),
+        "overlapped_prims": counts_ov,
+        "boundary_prims": counts_bd,
+        "overlapped_wire_bytes": ov_bytes,
+        "boundary_wire_bytes": bd_bytes,
+    }
+
+
+def _run_ab(jax, tx, params, axis, n, steps, repeats):
+    """One compiled program, fire on/off: bit-exact weights + timing."""
+    import functools
+    import numpy as np
+    import optax
+    import horovod_tpu as hvd
+    from horovod_tpu.optim import overlap as ov
+    loss_fn = functools.partial(_model_loss, ov)
+    rng = np.random.default_rng(1)
+    X = jax.numpy.asarray(
+        rng.standard_normal((n, 4, params["embed"].shape[0])),
+        jax.numpy.float32)
+
+    def step(p, s, xb, fire):
+        with hvd.overlapped_backprop(tx, fire=fire):
+            _l, g = jax.value_and_grad(loss_fn)(p, xb)
+        u, ns = tx.update(g, s, p)
+        return optax.apply_updates(p, u), ns
+
+    f = jax.pmap(step, axis_name=axis, in_axes=(None, 0, 0, None))
+    state0 = jax.pmap(lambda p, _: tx.init(p), axis_name=axis,
+                      in_axes=(None, 0))(params, np.zeros(n))
+
+    def trajectory(fire):
+        p, s = params, state0
+        for _ in range(steps):
+            pk, s = f(p, s, X, jax.numpy.asarray(fire))
+            for leaf in jax.tree_util.tree_leaves(pk):
+                a = np.asarray(leaf)
+                assert (a[0] == a[-1]).all(), \
+                    "replicas diverged under overlapped dispatch"
+            p = jax.tree_util.tree_map(lambda a: a[0], pk)
+        return p
+
+    p_on = trajectory(True)
+    p_off = trajectory(False)
+    for a, b in zip(jax.tree_util.tree_leaves(p_on),
+                    jax.tree_util.tree_leaves(p_off)):
+        a, b = np.asarray(a), np.asarray(b)
+        assert (a == b).all(), \
+            f"weights not bit-identical: max delta {np.abs(a - b).max()}"
+
+    def timed(fire):
+        fire = jax.numpy.asarray(fire)
+        times = []
+        for _ in range(repeats):
+            p, s = params, state0
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                pk, s = f(p, s, X, fire)
+                p = jax.tree_util.tree_map(lambda a: a[0], pk)
+            jax.block_until_ready(pk)
+            times.append((time.perf_counter() - t0) / steps)
+        return round(statistics.median(times) * 1e3, 3)
+
+    return {"steps": steps, "weights_bit_identical": True,
+            "step_ms_overlapped": timed(True),
+            "step_ms_boundary": timed(False)}
+
+
+def bench_config(jax, tag, params, axis, n, threshold, steps, repeats,
+                 **tx_kwargs):
+    import optax
+    from horovod_tpu.optim.distributed import DistributedOptimizer
+    tx = DistributedOptimizer(optax.adam(1e-2), axis_name=axis,
+                              threshold_bytes=threshold, overlap=True,
+                              **tx_kwargs)
+    sched_ov, sched_bd = _trace_schedules(jax, tx, params, axis, n)
+    n_layers = int(params["layers"]["b"].shape[0])
+    out = _check_schedules(sched_ov, sched_bd,
+                           bool(tx_kwargs.get("sharded_update")),
+                           n_layers)
+    out.update(_run_ab(jax, tx, params, axis, n, steps, repeats))
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--devices", type=int, default=4,
+                    help="CPU mesh size (default 4)")
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--width", type=int, default=128)
+    ap.add_argument("--threshold", type=int, default=32 << 10,
+                    help="fusion threshold bytes (default 32 KiB: "
+                         "multiple buckets per layer)")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI: tiny model, assert invariants, fast")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.layers, args.width = 3, 32
+        args.threshold = 2 << 10
+        args.steps, args.repeats = 4, 1
+
+    jax = _setup_jax(args.devices)
+    sys.path.insert(0, REPO)
+
+    axis, n = "ow", args.devices
+    params = _make_params(jax, args.layers, args.width)
+    total = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+    result = {"devices": n, "params": total,
+              "threshold_bytes": args.threshold}
+    configs = [
+        ("plain", {}),
+        ("sharded", {"sharded_update": True}),
+        ("int8", {"wire_format": "int8", "wire_block_size": 16}),
+        ("int8_sharded", {"sharded_update": True, "wire_format": "int8",
+                          "wire_block_size": 16}),
+    ]
+    for tag, kw in configs:
+        result[tag] = bench_config(jax, tag, params, axis, n,
+                                   args.threshold, args.steps,
+                                   args.repeats, **kw)
+    print(json.dumps(result, indent=2, sort_keys=True))
+    if args.smoke:
+        print("bench_overlap smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

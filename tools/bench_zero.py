@@ -60,6 +60,8 @@ def _make_params(jax, n_layers: int, width: int):
 
 def _schedule_counts(jax, tx, params, axis, n):
     from horovod_tpu.analysis.schedule import trace_schedule
+    from horovod_tpu.analysis.wire import (schedule_prim_counts,
+                                           schedule_transmit_bytes)
     spec = jax.tree_util.tree_map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
 
@@ -68,9 +70,11 @@ def _schedule_counts(jax, tx, params, axis, n):
         return u
     sched = trace_schedule(step, (spec, spec), axis_env=[(axis, n)],
                            entry="bench_zero")
-    counts = {}
-    for r in sched.records:
-        counts[r.prim] = counts.get(r.prim, 0) + 1
+    counts = schedule_prim_counts(sched)
+    # ring-model per-worker wire bytes of the whole step (shared
+    # accounting: analysis/wire.py) — sharded (RS+AG) must not exceed
+    # the replicated fused-psum plan's bytes
+    counts["_wire_bytes"] = schedule_transmit_bytes(sched)
     return counts
 
 
@@ -177,6 +181,11 @@ def main() -> int:
     assert "psum" in rep["schedule"] and \
         "reduce_scatter" not in rep["schedule"], rep["schedule"]
     assert "psum" not in sh["schedule"], sh["schedule"]
+    # same total ring bytes as the fused allreduce plan, modulo the
+    # reduce-scatter's divisibility padding (shared accounting:
+    # analysis/wire.py)
+    assert sh["schedule"]["_wire_bytes"] <= \
+        rep["schedule"]["_wire_bytes"] * 1.05, (sh, rep)
     assert sh["schedule"]["reduce_scatter"] == \
         sh["schedule"]["all_gather"], sh["schedule"]
     assert sh["inner_state_bytes_per_worker"] < \

@@ -32,7 +32,10 @@
 #      sharded schedule, sharded == replicated weights) — and
 #      tools/bench_compression.py --smoke — quantized-wire invariants
 #      (>=3.5x DCN bytes at int8, no overflow, error-feedback parity
-#      with bit-identical replicas)
+#      with bit-identical replicas) — and tools/bench_overlap.py
+#      --smoke — overlapped-dispatch invariants (per-layer buckets
+#      inside the backward scan, boundary/overlapped weights
+#      bit-identical incl. sharded x int8)
 #  11. hvdsched: re-trace the builtin step entries to jaxprs on CPU and
 #      diff their collective schedules against tests/schedules/
 #      (HVD211 drift; incl. the sharded_distopt_step reduce_scatter →
@@ -143,10 +146,29 @@ finally:
     ctl_mod._client = orig_client
     ctl_mod.jax.process_index = orig_pi
     kv_srv.close()
+# overlapped-dispatch accounting (ROADMAP item 3): arm a toy grad tap
+# and assert the trace-time bucket counter rides /metrics
+import jax.numpy as jnp
+import optax
+from horovod_tpu.optim import overlap as ovl
+from horovod_tpu.optim.distributed import DistributedOptimizer
+otx = DistributedOptimizer(optax.sgd(1e-2), axis_name="smk",
+                           threshold_bytes=1024, overlap=True)
+def _ov_step(g):
+    with ovl.overlapped_backprop(otx):
+        _, gr = jax.value_and_grad(
+            lambda p: (ovl.grad_tap(p)["a"] ** 2).sum())({"a": g})
+    return gr
+jax.make_jaxpr(_ov_step, axis_env=[("smk", 2)])(jnp.zeros((8,)))
+
 fams = aggregate.parse_prometheus(aggregate.scrape("127.0.0.1", srv.port))
 def _family_count(fam, **want):
     return sum(v for _, lbl, v in fams[fam]["samples"]
                if all(lbl.get(k) == w for k, w in want.items()))
+overlap_buckets = _family_count("hvd_overlap_buckets_dispatched_total",
+                                phase="bwd")
+assert overlap_buckets >= 1, \
+    fams["hvd_overlap_buckets_dispatched_total"]["samples"]
 watch_rounds = _family_count("hvd_negotiation_rounds_total", kind="watch")
 assert watch_rounds >= 2, fams["hvd_negotiation_rounds_total"]["samples"]
 reuse_hits = _family_count("hvd_rpc_conn_reuse_total", result="hit")
@@ -156,7 +178,8 @@ srv.close()
 hvd.shutdown()
 print(f"dist smoke OK (incl. /metrics + /healthz scrape, "
       f"{int(watch_rounds)} watch rounds, {int(reuse_hits)} keep-alive "
-      f"hits), imported from", os.path.dirname(hvd.__file__))
+      f"hits, {int(overlap_buckets)} overlap buckets), imported from",
+      os.path.dirname(hvd.__file__))
 PYEOF
   )
 }
@@ -220,12 +243,23 @@ tail -1 /tmp/ci_bench_zero.log
 python tools/bench_compression.py --smoke > /tmp/ci_bench_comp.log 2>&1 \
   || { tail -30 /tmp/ci_bench_comp.log; exit 1; }
 tail -1 /tmp/ci_bench_comp.log
+# overlapped dispatch: every per-layer fusion bucket must sit INSIDE
+# the backward scan of the armed step (boundary step: none), the
+# updates all-gather stays at the step boundary, and the one-program
+# fire-gated A/B must land on bit-identical weights for plain /
+# sharded / int8 / int8+sharded (docs/performance.md "Overlapped
+# dispatch")
+python tools/bench_overlap.py --smoke > /tmp/ci_bench_overlap.log 2>&1 \
+  || { tail -30 /tmp/ci_bench_overlap.log; exit 1; }
+tail -1 /tmp/ci_bench_overlap.log
 
 echo "== 11/11 hvdsched: collective-schedule snapshots + consistency =="
 # re-trace every builtin step entry to a jaxpr on CPU, diff against the
 # committed tests/schedules/*.json (HVD211 — any fusion-plan change is
 # an explicit `tools/hvdsched --update` in review) and require identical
-# canonical schedules across mesh sizes (HVD210)
+# canonical schedules across mesh sizes (HVD210); incl. the
+# overlapped_distopt_step entry whose per-layer collectives must sit
+# inside the backward-scan sub-jaxpr
 bash tools/hvdsched --check --consistency
 
 echo "CI matrix: all stages green"
