@@ -490,7 +490,49 @@ def _entry_overlapped_distopt_step():
     return step, (spec, x)
 
 
-#: entry name -> builder returning (fn, example_args).
+#: fixed local (ICI) axis of the hierarchical tail entry: the
+#: consistency check varies the CROSS (DCN) axis — the one the tail
+#: policy rewrites — through ``_AXIS``.
+_TAIL_LOCAL = 2
+
+
+def _entry_tail_distopt_step():
+    """The tail-tolerant hierarchical step (HOROVOD_TAIL_POLICY; ISSUE
+    11, OptiReduce arXiv:2310.06993): per bucket psum_scatter over the
+    local (ICI) axis, then the REWRITTEN DCN stage — a pmin
+    membership-agreement round over the mask plus an all_gather of
+    per-group chunk contributions (the transpose-allreduce shape that
+    makes a missing host's slot substitutable), never a cross-group
+    psum — then the local all_gather.  Policy pinned to ``stale`` (the
+    maximally rewritten schedule; ``bounded`` keeps the psum shape and
+    is pinned by tests/test_tail.py), mask/state initialized inside the
+    traced step so the snapshot cannot flip with the operator's
+    HOROVOD_TAIL_* env."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from ..compat import axis_size
+    from ..optim.distributed import fused_tail_reduce_tree
+
+    spec = _grads_spec()
+    tx = optax.adam(1e-3)
+
+    def step(grads, params):
+        present = jnp.ones((axis_size(_AXIS),), jnp.float32)
+        reduced, _state = fused_tail_reduce_tree(
+            grads, _AXIS, "hvd_local", op="average",
+            threshold_bytes=_THRESHOLD, tail_policy="stale",
+            present=present, max_staleness=3)
+        state = tx.init(params)
+        updates, _ = tx.update(reduced, state, params)
+        return updates
+    return step, (spec, spec), (("hvd_local", _TAIL_LOCAL),)
+
+
+#: entry name -> builder returning (fn, example_args) or
+#: (fn, example_args, extra_axes): ``extra_axes`` extends the trace's
+#: axis_env past the varied ``_AXIS`` (hierarchical entries need a
+#: second, fixed axis alongside the one the consistency check sweeps).
 BUILTIN_ENTRIES = {
     "fused_reduce": _entry_fused_reduce,
     "distopt_step": _entry_distopt_step,
@@ -498,6 +540,7 @@ BUILTIN_ENTRIES = {
     "sharded_distopt_step": _entry_sharded_distopt_step,
     "quantized_distopt_step": _entry_quantized_distopt_step,
     "overlapped_distopt_step": _entry_overlapped_distopt_step,
+    "tail_distopt_step": _entry_tail_distopt_step,
 }
 
 #: Mesh sizes the consistency check traces every entry at (HVD210).
@@ -505,9 +548,14 @@ _CONSISTENCY_SIZES = (2, 4)
 
 
 def builtin_schedule(name: str, axis_size: int = 2) -> Schedule:
-    fn, args = BUILTIN_ENTRIES[name]()
-    return trace_schedule(fn, args, axis_env=[(_AXIS, axis_size)],
-                          entry=name)
+    built = BUILTIN_ENTRIES[name]()
+    fn, args = built[0], built[1]
+    extra_axes = built[2] if len(built) > 2 else ()
+    return trace_schedule(
+        fn, args,
+        axis_env=[(_AXIS, axis_size)] + [(n, int(s))
+                                         for n, s in extra_axes],
+        entry=name)
 
 
 def snapshot_dir() -> str:

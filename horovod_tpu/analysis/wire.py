@@ -41,10 +41,20 @@ def aval_nbytes(aval: str) -> int:
 
 
 def ring_transmit_bytes(record, axis_sizes: Dict[str, int],
-                        axis_filter: Optional[str] = None) -> int:
+                        axis_filter: Optional[str] = None,
+                        strict: bool = False) -> int:
     """Per-worker transmit bytes of one collective under the standard
     ring algorithms (see module docstring).  ``record`` is an
-    ``analysis.schedule.CollectiveRecord``."""
+    ``analysis.schedule.CollectiveRecord``.
+
+    ``pmax``/``pmin`` cost like ``psum`` (a combining allreduce moves
+    the same bytes whatever the combiner) — they used to fall into the
+    conservative unknown-prim fallback, which overstated the
+    tail-reduce's pmin membership-agreement round ~2x.  ``strict=True``
+    RAISES on a primitive the model doesn't know instead of guessing
+    ``in_bytes``: byte-conservation gates (``tools/bench_tail.py``)
+    must fail loudly when a schedule grows a collective the accounting
+    silently mis-prices."""
     axes = [a for a in record.axes if a in axis_sizes]
     if axis_filter is not None and axis_filter not in axes:
         return 0
@@ -55,23 +65,31 @@ def ring_transmit_bytes(record, axis_sizes: Dict[str, int],
         return 0
     in_bytes = sum(aval_nbytes(a) for a in record.inputs)
     out_bytes = sum(aval_nbytes(a) for a in record.outputs)
-    if record.prim == "psum":
+    if record.prim in ("psum", "pmax", "pmin"):
         return (2 * (n - 1) * in_bytes) // n
     if record.prim in ("psum_scatter", "reduce_scatter", "all_to_all"):
         return ((n - 1) * in_bytes) // n
     if record.prim == "all_gather":
         return ((n - 1) * out_bytes) // n
+    if strict:
+        raise ValueError(
+            f"no ring-cost model for collective {record.prim!r} "
+            f"(index {record.index}, axes {record.axes}): add one to "
+            f"analysis.wire.ring_transmit_bytes before trusting a "
+            f"byte-conservation gate over this schedule")
     return in_bytes  # conservative for anything unexpected
 
 
 def schedule_transmit_bytes(schedule, axis_sizes=None,
-                            axis_filter: Optional[str] = None) -> int:
+                            axis_filter: Optional[str] = None,
+                            strict: bool = False) -> int:
     """Total per-worker ring-model transmit bytes of a traced
     :class:`~.schedule.Schedule` (default ``axis_sizes``: the
-    schedule's own axis_env)."""
+    schedule's own axis_env).  ``strict=True`` raises on primitives
+    the ring model doesn't cover (see :func:`ring_transmit_bytes`)."""
     sizes = dict(axis_sizes if axis_sizes is not None
                  else schedule.axis_env)
-    return sum(ring_transmit_bytes(r, sizes, axis_filter)
+    return sum(ring_transmit_bytes(r, sizes, axis_filter, strict=strict)
                for r in schedule.records)
 
 
@@ -82,6 +100,10 @@ def schedule_prim_counts(schedule) -> Dict[str, int]:
     for r in schedule.records:
         counts[r.prim] = counts.get(r.prim, 0) + 1
     return counts
+
+
+#: Short alias (the name the bench tables/docs use).
+prim_counts = schedule_prim_counts
 
 
 def trace_transmit_bytes(fn, example_args: Sequence,

@@ -391,5 +391,6 @@ broadcast_p = collectives.broadcast_p
 alltoall_p = collectives.alltoall_p
 reducescatter_p = collectives.reducescatter_p
 hierarchical_allreduce_p = collectives.hierarchical_allreduce_p
+tail_allreduce_p = collectives.tail_allreduce_p
 stack_on_workers = collectives.stack_on_workers
 worker_values = collectives.worker_values

@@ -135,13 +135,20 @@ def from_env(environ=os.environ) -> Optional[FaultSchedule]:
     return FaultSchedule.parse(spec, seed=seed)
 
 
-def fire(site: str, **ctx) -> Optional[Action]:
+def fire(site: str, _defer=(), **ctx) -> Optional[Action]:
     """Evaluate the installed schedule at an injection point.
 
     Executes self-contained actions (``delay`` sleeps; ``drop``/
     ``reset``/``http500``/``error`` raise; ``crash`` exits the process)
     and returns caller-interpreted ones (``dup``/``stale``/``flap``).
     Returns None when no rule fires.
+
+    ``_defer`` lists action kinds the CALLER interprets at this site
+    instead of having them executed here: sites that model the fault
+    rather than suffer it (``collective.dcn`` turns ``delay`` into a
+    per-host arrival lateness the tail-policy deadline gate reasons
+    about — sleeping inside fire() would bypass the very deadline under
+    test) receive the fired :class:`Action` back unexecuted.
     """
     sched = _SCHEDULE
     if sched is None:
@@ -156,6 +163,8 @@ def fire(site: str, **ctx) -> Optional[Action]:
         _metrics.event("chaos.injection", site=site, action=act.kind,
                        rule=act.rule)
     kind = act.kind
+    if kind in _defer:
+        return act
     if kind == "delay":
         time.sleep(act.arg_float(0.05))
         return None

@@ -135,6 +135,25 @@ class Config:
     # bandwidth is scarcest, keep ICI full-precision.  Off = quantize the
     # whole fused reduction even on flat (single-stage) meshes.
     compression_dcn_only: bool = True
+    # negotiated straggler tolerance for the DCN stage of the
+    # hierarchical allreduce (OptiReduce's tail prescription): "strict"
+    # waits for every host; "bounded" proceeds at the deadline with the
+    # k contributions present (n/k scale correction); "stale"
+    # substitutes a missing host's previous-round chunk under a
+    # staleness cap.  Rides every EntrySig/negotiation token (field 11),
+    # so all processes must configure the same value; applies only where
+    # a DCN stage exists (hierarchical path).
+    tail_policy: str = "strict"
+    # deadline (milliseconds) the bounded/stale DCN stage waits before
+    # proceeding without stragglers
+    tail_deadline_ms: float = 250.0
+    # max consecutive rounds a host may be substituted-from-stale before
+    # the round waits it out (0 = never substitute)
+    tail_max_staleness: int = 4
+    # straggler-score bar: a host whose stall-inspector EWMA lateness
+    # score (seconds) crosses this feeds the elastic blacklist as a SOFT
+    # failure before it dies outright (0 disables)
+    tail_blacklist_score: float = 0.0
 
     @staticmethod
     def from_env() -> "Config":
@@ -215,4 +234,29 @@ class Config:
                 f"{c.compression_block_size}")
         c.compression_dcn_only = _env_bool(
             "HOROVOD_COMPRESSION_DCN_ONLY", c.compression_dcn_only)
+        c.tail_policy = (_env_str("HOROVOD_TAIL_POLICY", c.tail_policy)
+                         or "strict").strip().lower()
+        from .ops.collectives import TAIL_POLICIES
+        if c.tail_policy not in TAIL_POLICIES:
+            raise ValueError(
+                f"HOROVOD_TAIL_POLICY must be one of {TAIL_POLICIES}, "
+                f"got {c.tail_policy!r}")
+        c.tail_deadline_ms = _env_float(
+            "HOROVOD_TAIL_DEADLINE_MS", c.tail_deadline_ms)
+        if c.tail_deadline_ms <= 0:
+            raise ValueError(
+                f"HOROVOD_TAIL_DEADLINE_MS must be positive, got "
+                f"{c.tail_deadline_ms}")
+        c.tail_max_staleness = _env_int(
+            "HOROVOD_TAIL_MAX_STALENESS", c.tail_max_staleness)
+        if c.tail_max_staleness < 0:
+            raise ValueError(
+                f"HOROVOD_TAIL_MAX_STALENESS must be >= 0, got "
+                f"{c.tail_max_staleness}")
+        c.tail_blacklist_score = _env_float(
+            "HOROVOD_TAIL_BLACKLIST_SCORE", c.tail_blacklist_score)
+        if c.tail_blacklist_score < 0:
+            raise ValueError(
+                f"HOROVOD_TAIL_BLACKLIST_SCORE must be >= 0, got "
+                f"{c.tail_blacklist_score}")
         return c

@@ -66,6 +66,10 @@ _m_restarts = _metrics.counter(
 _m_discovery_failures = _metrics.counter(
     "hvd_elastic_discovery_failures_total",
     "Host-discovery poll failures absorbed by the driver")
+_m_stragglers = _metrics.counter(
+    "hvd_elastic_straggler_reports_total",
+    "Straggler reports received, by disposition (counted = fed the "
+    "blacklist as a soft failure)", labels=("disposition",))
 
 DEFAULT_DISCOVERY_INTERVAL = float(
     os.environ.get("HOROVOD_ELASTIC_DISCOVERY_INTERVAL", "1.0"))
@@ -97,7 +101,8 @@ class ElasticDriver:
                  reset_limit: Optional[int] = None,
                  env: Optional[Dict[str, str]] = None,
                  verbose: bool = False,
-                 network_interface: Optional[str] = None):
+                 network_interface: Optional[str] = None,
+                 straggler_blacklist_score: Optional[float] = None):
         self.discovery = discovery
         self.command = list(command)
         self.min_np = min_np
@@ -110,6 +115,19 @@ class ElasticDriver:
         self.verbose = verbose
         self.network_interface = network_interface
         self.registry = registration.WorkerStateRegistry(blacklist_threshold)
+        # straggler-score bar (HOROVOD_TAIL_BLACKLIST_SCORE): reports at
+        # or above it count as SOFT host failures toward the same
+        # blacklist crashes feed — a chronically slow host rotates out
+        # before it dies outright.  Debounced per (host, epoch): one
+        # soft failure per epoch however many peers report the host.
+        if straggler_blacklist_score is None:
+            try:
+                straggler_blacklist_score = float(os.environ.get(
+                    "HOROVOD_TAIL_BLACKLIST_SCORE", "0") or 0.0)
+            except ValueError:
+                straggler_blacklist_score = 0.0
+        self.straggler_blacklist_score = straggler_blacklist_score
+        self._straggler_counted: set = set()   # (host, epoch) debounce
         # hosts_updated pushes are retried: a lost notification leaves an
         # incumbent training on the stale epoch until its own collective
         # failure detection fires — the leader-join flake (see
@@ -191,6 +209,7 @@ class ElasticDriver:
             "running": self._handle_running,
             "register_notification": self._handle_register_notification,
             "request_reform": self._handle_request_reform,
+            "straggler": self._handle_straggler,
         }, port=self.port, get_routes={
             # job-level view: every registered worker scraped and merged
             # (histograms bucket-wise, gauges per-worker min/max/sum) so
@@ -387,6 +406,62 @@ class ElasticDriver:
                 self._apply_hosts(hosts, HostUpdateResult.MIXED)
         with self._lock:
             return {"ok": True, "epoch": self._epoch}
+
+    def _handle_straggler(self, payload):
+        """A worker's stall inspector reports a chronically slow peer
+        (straggler EWMA past HOROVOD_TAIL_BLACKLIST_SCORE).  The
+        process rank maps to its host through the current assignment;
+        at-or-above-bar reports count ONE soft failure per (host,
+        epoch) toward the blacklist — the host rotates out at the
+        normal threshold without ever crashing."""
+        rank = int(payload["process"])
+        score = float(payload.get("score", 0.0))
+        with self._lock:
+            epoch = self._epoch
+            host = None
+            for wid, asg in self._assignment.items():
+                if asg.get("rank") == rank:
+                    w = self._workers.get(wid)
+                    host = w.slot.hostname if w is not None else None
+                    break
+        if host is None:
+            if _metrics.ACTIVE:
+                _m_stragglers.inc(disposition="unknown_rank")
+            return {"ok": False, "error": f"no live worker at rank {rank}"}
+        bar = self.straggler_blacklist_score
+        if bar is None or bar <= 0:
+            # feature disabled on THIS driver (HOROVOD_TAIL_BLACKLIST_
+            # SCORE unset/0): never count — a worker launched with the
+            # var set must not feed a blacklist its driver disabled
+            if _metrics.ACTIVE:
+                _m_stragglers.inc(disposition="disabled")
+            return {"ok": True, "counted": False}
+        if score < bar:
+            if _metrics.ACTIVE:
+                _m_stragglers.inc(disposition="below_bar")
+            return {"ok": True, "counted": False}
+        with self._lock:
+            key = (host, epoch)
+            if key in self._straggler_counted:
+                if _metrics.ACTIVE:
+                    _m_stragglers.inc(disposition="debounced")
+                return {"ok": True, "counted": False}
+            self._straggler_counted.add(key)
+        self.registry.record_soft_failure(host)
+        if _metrics.ACTIVE:
+            _m_stragglers.inc(disposition="counted")
+            _m_blacklist.set(len(self.registry.blacklisted_hosts()))
+        logger.warning(
+            "straggler report: host %s (rank %d) score %.3fs >= %.3fs; "
+            "soft failure %d/%d toward blacklist", host, rank, score,
+            bar or 0.0, self.registry.failure_count(host),
+            self.registry.blacklist_threshold)
+        self._emit("straggler_reported", host=host, rank=rank,
+                   score=round(score, 3), epoch=epoch,
+                   failures=self.registry.failure_count(host),
+                   blacklisted=self.registry.is_blacklisted(host))
+        return {"ok": True, "counted": True,
+                "blacklisted": self.registry.is_blacklisted(host)}
 
     def _handle_running(self, payload):
         wid = int(payload["worker_id"])

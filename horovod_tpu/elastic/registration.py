@@ -21,6 +21,7 @@ class WorkerStateRegistry:
         self._states: Dict[int, str] = {}        # worker_id → state
         self._hosts: Dict[int, str] = {}         # worker_id → hostname
         self._host_failures: Dict[str, int] = {}
+        self._soft_failures: Dict[str, int] = {}  # straggler reports
         self._blacklist_threshold = blacklist_threshold
 
     def record_ready(self, worker_id: int, hostname: str):
@@ -36,6 +37,26 @@ class WorkerStateRegistry:
             if state == FAILURE and host is not None:
                 self._host_failures[host] = \
                     self._host_failures.get(host, 0) + 1
+
+    def record_soft_failure(self, hostname: str):
+        """Count a SOFT failure against ``hostname``: the host is alive
+        but chronically degraded (straggler score past
+        HOROVOD_TAIL_BLACKLIST_SCORE).  Feeds the same per-host failure
+        count as a crash, so repeat offenders reach the blacklist
+        threshold and rotate out BEFORE they fail outright."""
+        with self._lock:
+            self._host_failures[hostname] = \
+                self._host_failures.get(hostname, 0) + 1
+            self._soft_failures[hostname] = \
+                self._soft_failures.get(hostname, 0) + 1
+
+    def soft_failure_count(self, hostname: str) -> int:
+        with self._lock:
+            return self._soft_failures.get(hostname, 0)
+
+    @property
+    def blacklist_threshold(self) -> int:
+        return self._blacklist_threshold
 
     def state(self, worker_id: int) -> Optional[str]:
         with self._lock:

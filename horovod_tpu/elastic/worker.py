@@ -162,6 +162,32 @@ def record_running():
         logger.debug("running report failed", exc_info=True)
 
 
+def report_straggler(process: int, score: float):
+    """Report a chronically slow peer to the elastic driver (best
+    effort).  Fired by the stall inspector's straggler EWMA crossing
+    HOROVOD_TAIL_BLACKLIST_SCORE; the driver maps the process rank to
+    its host and counts it as a SOFT failure toward the blacklist —
+    a host that stalls every DCN round gets rotated out before it
+    fails outright."""
+    ep = _driver_endpoint()
+    wid = worker_id()
+    if ep is None or wid is None:
+        return
+    if _metrics.RECORDING:
+        _metrics.event("elastic.straggler_reported", worker_id=wid,
+                       process=int(process), score=round(float(score), 3))
+    try:
+        # idempotent=False: the driver debounces per (host, epoch), but
+        # a chaos-duplicated delivery must not double-count even before
+        # that debounce existed on older drivers
+        json_request(ep[0], ep[1], "straggler",
+                     {"worker_id": wid, "process": int(process),
+                      "score": float(score), "epoch": _last_epoch},
+                     timeout=5.0, idempotent=False)
+    except Exception:  # noqa: BLE001 - scoring must not fail training
+        logger.debug("straggler report failed", exc_info=True)
+
+
 def record_result(status: str):
     """Report this worker's terminal state to the driver (best effort)."""
     ep = _driver_endpoint()

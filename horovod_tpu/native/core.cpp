@@ -41,6 +41,9 @@ namespace {
 
 struct Sig {
   std::string name, op_type, reduce_op, dtype, wire_format;
+  // negotiated DCN straggler tolerance ("strict"/"bounded"/"stale"):
+  // mixed policies never fuse (mirrors EntrySig.tail_policy)
+  std::string tail_policy;
   std::vector<long long> shape;
   long long ps_id = 0;
   bool stacked = false;
@@ -134,6 +137,7 @@ bool parse_sig(PyObject *o, Sig *s) {
   if (!get_str_attr(o, "reduce_op", &s->reduce_op)) return false;
   if (!get_str_attr(o, "dtype", &s->dtype)) return false;
   if (!get_str_attr(o, "wire_format", &s->wire_format)) return false;
+  if (!get_str_attr(o, "tail_policy", &s->tail_policy)) return false;
   if (!get_ll_attr(o, "process_set_id", &s->ps_id)) return false;
   if (!get_bool_attr(o, "stacked", &s->stacked)) return false;
   if (!get_ll_attr(o, "group_id", &s->group_id)) return false;
@@ -195,7 +199,7 @@ bool parse_sigs(PyObject *sigs, std::vector<Sig> *out) {
 
 // Bucket-compatibility key comparison: mirrors EntrySig.bucket_key() tuple
 // ordering (op_type, reduce_op, dtype, process_set_id, stacked,
-// prescale-or-1, postscale-or-1, wire_format).
+// prescale-or-1, postscale-or-1, wire_format, layer, tail_policy).
 int key_cmp(const Sig &a, const Sig &b) {
   int c = a.op_type.compare(b.op_type);
   if (c) return c;
@@ -214,6 +218,10 @@ int key_cmp(const Sig &a, const Sig &b) {
   // buckets must never span layers: under overlapped dispatch a bucket
   // goes to the wire when its layer's backward step completes
   if (a.layer != b.layer) return a.layer < b.layer ? -1 : 1;
+  // mixed tail policies must never fuse: a fused bucket runs ONE
+  // deadline gate and one participation mask
+  c = a.tail_policy.compare(b.tail_policy);
+  if (c) return c;
   return 0;
 }
 
@@ -578,6 +586,7 @@ std::string cache_key(const std::vector<Sig> &sigs) {
     append_str(&k, s.reduce_op);
     append_str(&k, s.dtype);
     append_str(&k, s.wire_format);
+    append_str(&k, s.tail_policy);
     append_ll(&k, s.ps_id);
     append_ll(&k, s.stacked ? 1 : 0);
     append_ll(&k, s.group_id);

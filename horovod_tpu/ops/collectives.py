@@ -30,7 +30,8 @@ the reference's response-cache steady state.
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence, Tuple
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,9 +39,31 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import chaos as _chaos
+from .. import metrics as _metrics
 from ..compression import (WireFormat, dequantize_blocks, quantize_blocks,
                            resolve_wire_format)
 from ..runtime import ReduceOp
+
+#: Negotiated straggler-tolerance policies for the DCN stage of a
+#: hierarchical reduce (OptiReduce, arXiv:2310.06993 — tail latency, not
+#: the mean, governs cloud allreduce throughput):
+#:
+#: * ``strict``  — today's behavior: the cross-group psum waits for every
+#:   host, one straggler stalls the fused bucket.
+#: * ``bounded`` — the DCN stage proceeds at HOROVOD_TAIL_DEADLINE_MS
+#:   with the k contributions that arrived, applying an n/k scale
+#:   correction so the expected reduction is unbiased.
+#: * ``stale``   — a missing host's previous-round chunk is substituted
+#:   (bounded staleness), with a per-bucket per-host staleness counter
+#:   capped by HOROVOD_TAIL_MAX_STALENESS: a host at the cap is waited
+#:   out (strict for that host) until it contributes fresh data again.
+TAIL_POLICIES = ("strict", "bounded", "stale")
+
+_m_tail_rounds = _metrics.counter(
+    "hvd_tail_rounds_total",
+    "DCN tail rounds of the hierarchical reduce, by effective policy",
+    labels=("policy",))
 
 # ---------------------------------------------------------------------------
 # helpers
@@ -145,6 +168,117 @@ def quantized_allreduce_p(x, axis_name: str, fmt: WireFormat,
     if new_res is not None:
         new_res = new_res.reshape(shape)
     return red.reshape(shape).astype(dtype), new_res
+
+
+# ---------------------------------------------------------------------------
+# tail-tolerant DCN reduce (deadline-bounded / bounded-staleness policies)
+# ---------------------------------------------------------------------------
+# An XLA collective always completes — the *deadline* lives in the eager
+# runtime gate (tail_round below), which decides per round which hosts'
+# contributions count and feeds the compiled program a participation
+# mask.  The compiled side here is the policy arithmetic: masked sum
+# with n/k scale correction (bounded), or per-host substitution from
+# the previous round's gathered contributions (stale).  The mask is
+# agreed with a pmin over the mesh axes first — the membership-agreement
+# round a real tail-tolerant transport (OptiReduce) must run, and the
+# reason replicas can never diverge on which contributions were summed.
+
+
+def tail_allreduce_p(chunk, cross_axis: str, tail_policy: str = "strict",
+                     present=None, prev=None, staleness=None,
+                     max_staleness: int = 0, wire_format=None,
+                     agree_axes: Tuple[str, ...] = ()):
+    """Tail-tolerant SUM reduce of a 1-D ``chunk`` over ``cross_axis``
+    (the DCN hop of a hierarchical reduce).
+
+    ``present`` is the round's participation mask (shape
+    ``[axis_size(cross_axis)]``, 1.0 = arrived by the deadline) — a
+    *runtime input*, so strict/bounded A/B runs as one compiled program.
+    It is hardened with ``lax.pmin`` over ``cross_axis`` and
+    ``agree_axes`` before use: every replica sums exactly the commonly
+    agreed contributions (a host counts only if EVERY replica has it).
+
+    * ``strict``: plain (or quantized) psum — byte-identical to the
+      pre-tail schedule; ``present`` is ignored.
+    * ``bounded``: ``psum(chunk * m) * n/k`` with the scale correction
+      gated by ``where(k == n)`` — an all-ones mask is bit-identical to
+      strict (×1.0 and the skipped correction are exact).
+    * ``stale``: the chunk crosses DCN as an ``all_gather`` (the
+      transpose-allreduce shape tail-tolerant transports use: per-host
+      contributions must be addressable to substitute one), missing
+      hosts take their slot from ``prev`` (the previous round's agreed
+      per-host contributions, ``[n, len(chunk)]``), and ``staleness``
+      (int32 ``[n]``) counts consecutive substitutions per host —
+      a host at ``max_staleness`` is forced present (waited out).
+
+    Returns ``(reduced, new_prev, new_staleness)``; the state outputs
+    are None except under ``stale``.
+    """
+    if tail_policy not in TAIL_POLICIES:
+        raise ValueError(
+            f"tail_policy must be one of {TAIL_POLICIES}, got "
+            f"{tail_policy!r}")
+    fmt = resolve_wire_format(wire_format)
+    n = axis_size_p(cross_axis)
+    if tail_policy == "strict":
+        if fmt is not None:
+            red, _ = quantized_allreduce_p(chunk, cross_axis, fmt,
+                                           op=ReduceOp.SUM)
+        else:
+            red = lax.psum(chunk, cross_axis)
+        return red, None, None
+    if present is None:
+        raise ValueError(
+            f"tail_policy={tail_policy!r} needs a participation mask "
+            f"(present=[{n}] floats; all-ones = no deadline fired)")
+    m = jnp.asarray(present).astype(jnp.float32)
+    # membership agreement: the conservative intersection across every
+    # replica of the mesh — the collective the tail schedule ADDS
+    for ax in (cross_axis,) + tuple(agree_axes):
+        m = lax.pmin(m, ax)
+    if tail_policy == "bounded":
+        own = m[lax.axis_index(cross_axis)]
+        contrib = chunk * own.astype(chunk.dtype)
+        if fmt is not None:
+            red, _ = quantized_allreduce_p(contrib, cross_axis, fmt,
+                                           op=ReduceOp.SUM)
+        else:
+            red = lax.psum(contrib, cross_axis)
+        k = jnp.sum(m)
+        # n/k scale correction for the k contributors present; gated so
+        # a full round never pays a (×1.0) rounding step
+        corrected = red * (n / jnp.maximum(k, 1.0)).astype(red.dtype)
+        return jnp.where(k >= n, red, corrected), None, None
+    # stale
+    if prev is None or staleness is None:
+        raise ValueError(
+            "tail_policy='stale' carries per-bucket state: pass prev "
+            f"([{n}, len(chunk)] previous-round contributions) and "
+            f"staleness (int32 [{n}]) — zeros on the first round")
+    if max_staleness >= 0:
+        # cap: a host substituted max_staleness consecutive rounds must
+        # be waited out — its CURRENT contribution is used (the eager
+        # gate enforces the matching wait on the wall clock)
+        m = jnp.where(staleness >= max_staleness, jnp.float32(1.0), m)
+    if fmt is not None:
+        pad = (-chunk.shape[0]) % fmt.block_size
+        padded = (jnp.concatenate([chunk, jnp.zeros((pad,), chunk.dtype)])
+                  if pad else chunk)
+        q, s = quantize_blocks(padded, fmt)
+        qg = lax.all_gather(q, cross_axis, tiled=False)
+        sg = lax.all_gather(s, cross_axis, tiled=False)
+        gathered = dequantize_blocks(
+            qg.reshape(-1), sg.reshape(-1), fmt).reshape(n, -1)
+        if pad:
+            gathered = gathered[:, :chunk.shape[0]]
+        gathered = gathered.astype(chunk.dtype)
+    else:
+        gathered = lax.all_gather(chunk, cross_axis, tiled=False)
+    eff = jnp.where((m > 0)[:, None], gathered, prev.astype(chunk.dtype))
+    red = jnp.sum(eff, axis=0)
+    new_staleness = jnp.where(m > 0, 0, staleness + 1).astype(
+        staleness.dtype)
+    return red, eff, new_staleness
 
 
 def is_stacked(x, ps) -> bool:
@@ -325,7 +459,8 @@ def _replicated_allreduce_fn(mesh_key, op, n, nshapes,
 @functools.lru_cache(maxsize=1024)
 def _hier_allreduce_fn(mesh_key, axis, op, n, shapes, n_groups, group,
                        has_prescale, has_postscale,
-                       wire_format="none", wire_block=0):
+                       wire_format="none", wire_block=0,
+                       tail_policy="strict", max_staleness=0):
     """Two-stage hierarchical allreduce (reference:
     NCCLHierarchicalAllreduce, SURVEY §5.8): reduce-scatter within the
     group (ICI), allreduce the 1/group-size chunk across groups (DCN),
@@ -336,13 +471,35 @@ def _hier_allreduce_fn(mesh_key, axis, op, n, shapes, n_groups, group,
     quantizes the cross-group (DCN) stage only — block-scaled tiles +
     scales instead of a full-width psum — the negotiated per-bucket wire
     format under its HOROVOD_COMPRESSION_DCN_ONLY default.
+
+    ``tail_policy != "strict"`` makes the DCN stage tail-tolerant
+    (``tail_allreduce_p``): the jitted fn grows a runtime participation
+    mask argument (``present``, fp32 ``[n_groups]``, from the eager
+    deadline gate ``tail_round``), and under ``stale`` additionally the
+    per-bucket state arguments/outputs (``prev`` global
+    ``[n, n_groups, chunk]`` sharded over the mesh, ``staleness`` int32
+    ``[n_groups]`` replicated):
+
+    * strict : ``f(pre, post, *arrays) -> outs``
+    * bounded: ``f(pre, post, present, *arrays) -> outs``
+    * stale  : ``f(pre, post, present, prev, staleness, *arrays)
+               -> outs + (new_prev, new_staleness)``
     """
     mesh1d = _MESHES[mesh_key]
     devs = np.asarray(mesh1d.devices).reshape(n_groups, group)
     mesh = jax.sharding.Mesh(devs, ("hvd_cross", "hvd_local"))
     fmt = resolve_wire_format(wire_format, wire_block or None)
 
-    def shard_fn(prescale, postscale, *xs):
+    def shard_fn(prescale, postscale, *rest):
+        if tail_policy == "strict":
+            present = prev = staleness = None
+            xs = rest
+        elif tail_policy == "bounded":
+            present, xs = rest[0], rest[1:]
+            prev = staleness = None
+        else:
+            present, prev, staleness = rest[0], rest[1][0], rest[2]
+            xs = rest[3:]
         locals_ = [x[0] for x in xs]  # [1, ...] shard → drop worker dim
         if has_prescale:
             locals_ = [x * prescale.astype(x.dtype) for x in locals_]
@@ -358,7 +515,14 @@ def _hier_allreduce_fn(mesh_key, axis, op, n, shapes, n_groups, group,
         chunk = lax.psum_scatter(flat, "hvd_local", scatter_dimension=0,
                                  tiled=True)
         # stage 2 (DCN): allreduce the chunk across groups
-        if fmt is not None:
+        new_prev = new_stal = None
+        if tail_policy != "strict":
+            chunk, new_prev, new_stal = tail_allreduce_p(
+                chunk, "hvd_cross", tail_policy, present=present,
+                prev=prev, staleness=staleness,
+                max_staleness=max_staleness, wire_format=fmt,
+                agree_axes=("hvd_local",))
+        elif fmt is not None:
             chunk, _ = quantized_allreduce_p(chunk, "hvd_cross", fmt,
                                              op=ReduceOp.SUM)
         else:
@@ -375,11 +539,20 @@ def _hier_allreduce_fn(mesh_key, axis, op, n, shapes, n_groups, group,
             offset += sz
         if has_postscale:
             outs = [x * postscale.astype(x.dtype) for x in outs]
+        if tail_policy == "stale":
+            return tuple(outs) + (new_prev[None], new_stal)
         return tuple(outs)
 
-    in_specs = (P(), P()) + tuple(
-        P(("hvd_cross", "hvd_local")) for _ in shapes)
-    out_specs = tuple(P() for _ in shapes)
+    axis2d = P(("hvd_cross", "hvd_local"))
+    tail_in = ()
+    tail_out = ()
+    if tail_policy == "bounded":
+        tail_in = (P(),)                      # present: replicated
+    elif tail_policy == "stale":
+        tail_in = (P(), axis2d, P())          # present, prev, staleness
+        tail_out = (axis2d, P())              # new_prev, new_staleness
+    in_specs = (P(), P()) + tail_in + tuple(axis2d for _ in shapes)
+    out_specs = tuple(P() for _ in shapes) + tail_out
     f = jax.shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_vma=False)
     return jax.jit(f)
@@ -513,8 +686,133 @@ def reset_kernel_caches():
     _alltoall_fn.cache_clear()
     _stacked_reducescatter_fn.cache_clear()
     _MESHES.clear()
+    _TAIL_STATE.clear()
     from .adasum import reset_kernel_caches as _adasum_reset
     _adasum_reset()
+
+
+# ---------------------------------------------------------------------------
+# eager tail-round gate: the deadline decision the compiled program can't make
+# ---------------------------------------------------------------------------
+
+#: Per-bucket stale state (prev gathered contributions + staleness
+#: counters), keyed by the same tuple that keys the compiled kernel —
+#: one state per (mesh, signature) bucket identity.  Cleared with the
+#: kernel caches on re-init.
+_TAIL_STATE: Dict[Tuple, tuple] = {}
+
+
+def plan_tail_round(name: str, tail_policy: str, n_groups: int,
+                    deadline_s: float, max_staleness: int = 0,
+                    staleness=None, stall=None):
+    """Decide one DCN tail round: which cross-groups count, and how long
+    the round waits on the wall clock.
+
+    Pure decision function (no sleeping — ``tail_round`` sleeps), so
+    tests pin it deterministically.  Per-group arrival lateness comes
+    from the ``collective.dcn`` chaos site (``action=delay:<secs>`` =
+    that group's DCN contribution arrives that late; ``action=drop`` =
+    it never arrives this round); without an installed schedule every
+    group arrives instantly.  Decision:
+
+    * ``strict``  — wait out the slowest group (``wait = max lateness``);
+      a dropped contribution is a transport error
+      (:class:`~..chaos.ChaosConnectionError`), exactly like the other
+      eager injection sites.
+    * ``bounded``/``stale`` — groups later than ``deadline_s`` are
+      excluded (mask 0) and the round waits ``deadline_s`` at most;
+      rounds where every group makes the deadline never pay it.  Under
+      ``stale``, a group whose ``staleness`` counter has reached
+      ``max_staleness`` is *waited out* instead (the compiled clamp
+      mirrors this, so mask and arithmetic agree).
+
+    Observed lateness (including 0.0 for on-time groups) feeds the stall
+    inspector's per-host straggler EWMA (``stall.note_lateness``).
+
+    Returns ``(present, wait_s, lateness)``: the fp32 mask
+    ``[n_groups]``, the wall-clock wait, and the per-group lateness list.
+    """
+    if tail_policy not in TAIL_POLICIES:
+        raise ValueError(
+            f"tail_policy must be one of {TAIL_POLICIES}, got "
+            f"{tail_policy!r}")
+    lateness = [0.0] * n_groups
+    dropped = [False] * n_groups
+    if _chaos.ACTIVE:
+        for g in range(n_groups):
+            act = _chaos.fire("collective.dcn", name=name, group=g,
+                              policy=tail_policy,
+                              _defer=("delay", "drop"))
+            if act is None:
+                continue
+            if act.kind == "delay":
+                lateness[g] = act.arg_float(0.05)
+            elif act.kind == "drop":
+                dropped[g] = True
+    present = np.ones((n_groups,), np.float32)
+    if tail_policy == "strict":
+        if any(dropped):
+            raise _chaos.ChaosConnectionError(
+                f"chaos: DCN contribution of groups "
+                f"{[g for g in range(n_groups) if dropped[g]]} dropped "
+                f"at collective.dcn ({name})")
+        wait_s = max(lateness) if lateness else 0.0
+    else:
+        waited = []
+        deadline_fired = False
+        for g in range(n_groups):
+            late = float("inf") if dropped[g] else lateness[g]
+            at_cap = (tail_policy == "stale" and staleness is not None
+                      and int(staleness[g]) >= max_staleness)
+            if late > deadline_s and not at_cap:
+                present[g] = 0.0
+                deadline_fired = True
+            else:
+                # waited out: on time, or stale-capped (cap beats drop —
+                # the round must block until the host answers)
+                waited.append(min(late, deadline_s)
+                              if not at_cap else lateness[g])
+        wait_s = max(waited) if waited else 0.0
+        if deadline_fired:
+            wait_s = max(wait_s, deadline_s)
+    if stall is not None:
+        for g in range(n_groups):
+            # a DROPPED contribution never arrived: feed the censored
+            # observation (>= the deadline) — else a host that drops
+            # every round would score as perfectly on-time and the
+            # straggler → blacklist path could never fire for total
+            # loss, only for delay
+            obs = (max(lateness[g], deadline_s) if dropped[g]
+                   else lateness[g])
+            stall.note_lateness(g, obs)
+    return present, wait_s, lateness
+
+
+def tail_round(name: str, tail_policy: str, n_groups: int,
+               deadline_s: float, max_staleness: int = 0,
+               staleness=None, stall=None):
+    """One eager DCN tail round: plan (``plan_tail_round``), wait the
+    planned wall-clock time, count the round
+    (``hvd_tail_rounds_total{policy}``), and return the mask."""
+    present, wait_s, lateness = plan_tail_round(
+        name, tail_policy, n_groups, deadline_s,
+        max_staleness=max_staleness, staleness=staleness, stall=stall)
+    if _metrics.ACTIVE:
+        _m_tail_rounds.inc(policy=tail_policy)
+    if wait_s > 0:
+        time.sleep(wait_s)
+    return present
+
+
+def _tail_params():
+    """(deadline_s, max_staleness, stall) from the live runtime config."""
+    from .. import runtime
+    st = runtime._state()
+    cfg = st.config
+    deadline_s = (cfg.tail_deadline_ms / 1000.0 if cfg is not None
+                  else 0.25)
+    max_stal = cfg.tail_max_staleness if cfg is not None else 4
+    return deadline_s, max_stal, st.stall_inspector
 
 
 # ---------------------------------------------------------------------------
@@ -532,7 +830,11 @@ def allreduce_arrays(arrays: List, ps, op: str = ReduceOp.AVERAGE,
                      prescale_factor=None, postscale_factor=None,
                      stacked: Optional[bool] = None,
                      wire_format: str = "none",
-                     wire_block: int = 0) -> List:
+                     wire_block: int = 0,
+                     tail_policy: str = "strict",
+                     tail_name: str = "allreduce",
+                     tail_bucket_names: Optional[Tuple[str, ...]] = None
+                     ) -> List:
     """Fused allreduce of a list of arrays over a process set (one bucket).
 
     ``wire_format`` is the bucket's negotiated quantized wire format
@@ -541,6 +843,15 @@ def allreduce_arrays(arrays: List, ps, op: str = ReduceOp.AVERAGE,
     whole fused reduction (only requested when the DCN-only policy is
     off).  The replicated no-communication path ignores it — there are
     no wire bytes to shrink.
+
+    ``tail_policy`` is the bucket's negotiated straggler tolerance
+    (:data:`TAIL_POLICIES`); it only takes effect on the hierarchical
+    path — a flat mesh has no DCN stage to bound — where each dispatch
+    runs one ``tail_round`` (deadline gate + chaos arrival injection +
+    straggler scoring) and feeds the resulting participation mask to the
+    compiled program.  ``stale`` buckets carry their previous-round DCN
+    contributions and staleness counters in a per-bucket state slot
+    keyed like the kernel cache.
     """
     if op == ReduceOp.ADASUM:
         from .adasum import adasum_arrays
@@ -575,14 +886,58 @@ def allreduce_arrays(arrays: List, ps, op: str = ReduceOp.AVERAGE,
                 hier_on = st.engine._hierarchical_enabled()
             if hier_on:
                 hier = ps.hier_shape()
+        if hier is None or op not in _SUMMABLE or not fuse:
+            tail_policy = "strict"
         if hier is not None:
-            fn = _hier_allreduce_fn(
-                mesh_key(ps), ps.axis, op, n, shapes, hier[0], hier[1],
-                has_pre, has_post, wire_format, wire_block)
-        else:
-            fn = _stacked_allreduce_fn(
-                mesh_key(ps), ps.axis, op, n, shapes, dtypes, has_pre,
-                has_post, fuse, wire_format, wire_block)
+            key = (mesh_key(ps), ps.axis, op, n, shapes, hier[0], hier[1],
+                   has_pre, has_post, wire_format, wire_block)
+            deadline_s, max_stal, stall = _tail_params()
+            fn = _hier_allreduce_fn(*key, tail_policy, max_stal)
+            if tail_policy == "strict":
+                if _chaos.ACTIVE or _metrics.ACTIVE:
+                    # strict rounds still observe injected DCN arrival
+                    # delays (they wait them out — the straggler
+                    # baseline) and count toward the round metric
+                    tail_round(tail_name, "strict", hier[0], deadline_s,
+                               stall=stall)
+                return list(fn(pre, post, *arrays))
+            if tail_policy == "bounded":
+                present = tail_round(tail_name, "bounded", hier[0],
+                                     deadline_s, stall=stall)
+                return list(fn(pre, post, jnp.asarray(present), *arrays))
+            # stale: thread the per-bucket (prev, staleness) state.
+            # The kernel-cache tuple alone is NOT a bucket identity —
+            # two buckets with identical shapes/op/scales (e.g. twin
+            # layers split across buckets) would share and clobber each
+            # other's prev chunks — so the state key adds the bucket's
+            # full tensor-name tuple (identical-name duplicates within
+            # one cycle remain a documented aliasing edge)
+            key = key + (tail_bucket_names
+                         if tail_bucket_names is not None
+                         else (tail_name,))
+            state = _TAIL_STATE.get(key)
+            if state is None:
+                total = sum(int(np.prod(s)) if s else 1 for s in shapes)
+                chunk_len = (total + (-total) % hier[1]) // hier[1]
+                mesh1d = _MESHES[key[0]]
+                devs = np.asarray(mesh1d.devices).reshape(hier[0], hier[1])
+                mesh2d = jax.sharding.Mesh(devs, ("hvd_cross", "hvd_local"))
+                prev = jax.device_put(
+                    jnp.zeros((n, hier[0], chunk_len),
+                              jnp.dtype(dtypes[0])),
+                    NamedSharding(mesh2d, P(("hvd_cross", "hvd_local"))))
+                state = (prev, jnp.zeros((hier[0],), jnp.int32))
+            present = tail_round(tail_name, "stale", hier[0], deadline_s,
+                                 max_staleness=max_stal,
+                                 staleness=np.asarray(state[1]),
+                                 stall=stall)
+            outs = fn(pre, post, jnp.asarray(present), state[0], state[1],
+                      *arrays)
+            _TAIL_STATE[key] = (outs[-2], outs[-1])
+            return list(outs[:-2])
+        fn = _stacked_allreduce_fn(
+            mesh_key(ps), ps.axis, op, n, shapes, dtypes, has_pre,
+            has_post, fuse, wire_format, wire_block)
     else:
         fn = _replicated_allreduce_fn(
             mesh_key(ps), op, n, len(arrays), has_pre, has_post)
@@ -774,7 +1129,10 @@ def reducescatter_p(x, axis_name: str, op: str = ReduceOp.AVERAGE):
 
 def hierarchical_allreduce_p(x, cross_axis: str, local_axis: str,
                              op: str = ReduceOp.AVERAGE,
-                             wire_format=None):
+                             wire_format=None,
+                             tail_policy: str = "strict",
+                             tail_present=None, tail_state=None,
+                             tail_max_staleness: int = 0):
     """Traceable two-stage allreduce over a (cross, local) mesh factoring
     (reference: NCCLHierarchicalAllreduce; SURVEY §5.8 ICI/DCN analog):
     reduce-scatter over ``local_axis`` (ICI), psum the chunk over
@@ -786,7 +1144,19 @@ def hierarchical_allreduce_p(x, cross_axis: str, local_axis: str,
     block-scaled int8/fp8 tiles + fp32 scales (quantize → exchange →
     dequantize-accumulate staging), dropping cross-host bytes another
     ~4x, while the ICI stages stay full-precision — the OptiReduce
-    prescription (compress where bandwidth is scarcest)."""
+    prescription (compress where bandwidth is scarcest).
+
+    ``tail_policy`` makes the CROSS stage straggler-tolerant
+    (:func:`tail_allreduce_p`; OptiReduce's other prescription — bound
+    the tail where it is longest).  ``tail_present`` is the round's
+    runtime participation mask (fp32 ``[axis_size(cross_axis)]``).
+    ``stale`` additionally threads per-call state: ``tail_state`` is
+    ``(prev, staleness)`` (previous-round gathered chunk contributions
+    ``[n_cross, chunk_len]`` and int32 staleness counters ``[n_cross]``;
+    zeros on the first round) and the return value becomes
+    ``(reduced, (new_prev, new_staleness))``.  The default ``strict``
+    path is byte-identical to the pre-tail schedule.
+    """
     fmt = resolve_wire_format(wire_format)
     group = axis_size_p(local_axis)
     shape = x.shape
@@ -796,7 +1166,18 @@ def hierarchical_allreduce_p(x, cross_axis: str, local_axis: str,
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
     chunk = lax.psum_scatter(flat, local_axis, scatter_dimension=0,
                              tiled=True)
-    if fmt is not None:
+    new_state = None
+    if tail_policy != "strict":
+        prev, staleness = (tail_state if tail_state is not None
+                           else (None, None))
+        chunk, new_prev, new_stal = tail_allreduce_p(
+            chunk, cross_axis, tail_policy, present=tail_present,
+            prev=prev, staleness=staleness,
+            max_staleness=tail_max_staleness, wire_format=fmt,
+            agree_axes=(local_axis,))
+        if tail_policy == "stale":
+            new_state = (new_prev, new_stal)
+    elif fmt is not None:
         chunk, _ = quantized_allreduce_p(chunk, cross_axis, fmt,
                                          op=ReduceOp.SUM)
     else:
@@ -806,4 +1187,7 @@ def hierarchical_allreduce_p(x, cross_axis: str, local_axis: str,
         red = red[:flat.shape[0] - pad]
     if op == ReduceOp.AVERAGE:
         red = red / (group * axis_size_p(cross_axis))
-    return red.reshape(shape)
+    red = red.reshape(shape)
+    if tail_policy == "stale":
+        return red, new_state
+    return red

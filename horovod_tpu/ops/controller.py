@@ -244,11 +244,16 @@ def entry_token(entry) -> str:
     # field 10 (wire_format) is the negotiated quantized wire: two
     # processes configured with different HOROVOD_COMPRESSION values
     # produce different tokens and fail the round as a detected
-    # divergence instead of disagreeing about the bytes on the wire
+    # divergence instead of disagreeing about the bytes on the wire.
+    # field 11 (tail_policy) rides the same way: a bucket's straggler
+    # tolerance decides WHICH contributions a round may sum, so a
+    # config mismatch must surface as a divergence, never as replicas
+    # disagreeing about a deadline (peers tolerate old 11-field tokens
+    # without it — see engine._synthesize)
     sigs = [[s.name, s.op_type, s.reduce_op, s.dtype, wire_shape(s),
              s.process_set_id, bool(s.stacked),
              -1 if s.group_id == -1 else 0,
-             s.prescale, s.postscale, s.wire_format]
+             s.prescale, s.postscale, s.wire_format, s.tail_policy]
             for s in entry.sigs()]
     splits = (None if entry.splits is None
               else [int(x) for x in entry.splits])

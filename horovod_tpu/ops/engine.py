@@ -80,13 +80,14 @@ class TensorTableEntry:
     __slots__ = ("name", "op_type", "reduce_op", "arrays", "process_set",
                  "prescale", "postscale", "root_rank", "splits", "stacked",
                  "handle", "enqueue_time", "group_id", "callback",
-                 "peer_rows", "wire_format")
+                 "peer_rows", "wire_format", "tail_policy")
 
     def __init__(self, name, op_type, arrays, process_set,
                  reduce_op=ReduceOp.AVERAGE, prescale=None, postscale=None,
                  root_rank=0, splits=None, stacked=None, group_id=-1,
                  callback: Optional[Callable] = None,
-                 wire_format: str = "none"):
+                 wire_format: str = "none",
+                 tail_policy: str = "strict"):
         self.name = name
         self.op_type = op_type
         self.arrays = arrays
@@ -107,12 +108,21 @@ class TensorTableEntry:
         # engine.submit); sigs() narrows it per array to "none" where it
         # cannot apply (non-summable op, non-quantizable dtype)
         self.wire_format = wire_format
+        # REQUESTED DCN straggler tolerance (HOROVOD_TAIL_POLICY; set by
+        # engine.submit); sigs() narrows it to "strict" where a tail
+        # round cannot apply (non-summable op) — the hierarchical-path
+        # gate itself is dispatch-time (_bucket_tail_policy)
+        self.tail_policy = tail_policy
 
     def sigs(self) -> List[EntrySig]:
         from ..compression import quantizable
         fmt_ok = (self.wire_format != "none"
                   and self.op_type == "allreduce"
                   and self.reduce_op in (ReduceOp.SUM, ReduceOp.AVERAGE))
+        tail = (self.tail_policy
+                if self.op_type == "allreduce"
+                and self.reduce_op in (ReduceOp.SUM, ReduceOp.AVERAGE)
+                else "strict")
         out = []
         for i, a in enumerate(self.arrays):
             stacked = (self.stacked if self.stacked is not None
@@ -131,7 +141,8 @@ class TensorTableEntry:
                            else float(self.postscale)),
                 wire_format=(self.wire_format
                              if fmt_ok and quantizable(a.dtype)
-                             else "none")))
+                             else "none"),
+                tail_policy=tail))
         return out
 
 
@@ -294,6 +305,11 @@ class CollectiveEngine:
         # detected divergence instead of a silent wire disagreement
         if self.cfg is not None and entry.wire_format == "none":
             entry.wire_format = getattr(self.cfg, "compression", "none")
+        # same stamping for the negotiated straggler tolerance
+        # (HOROVOD_TAIL_POLICY): it rides the signatures/token so a
+        # cross-process config mismatch is a detected divergence
+        if self.cfg is not None and entry.tail_policy == "strict":
+            entry.tail_policy = getattr(self.cfg, "tail_policy", "strict")
         # a grouped entry ALWAYS resolves to a list, even with one
         # member — grouped_* callers zip the result against their input
         # list, and a bare array would be iterated element-wise
@@ -579,10 +595,15 @@ class CollectiveEngine:
             prescale=sigs[0][8], postscale=sigs[0][9],
             root_rank=fields["r"], splits=fields["sp"], stacked=False,
             group_id=self.next_group_id() if len(sigs) > 1 else -1,
-            # the peers' negotiated wire format (token field 10; tolerate
-            # old-format tokens without it)
+            # the peers' negotiated wire format (token field 10) and
+            # tail policy (field 11); tolerate old-format tokens without
+            # either — a peer running the previous release synthesizes
+            # strict/full-width entries, which still match its own sigs
             wire_format=next((s[10] for s in sigs
-                              if len(s) > 10 and s[10] != "none"), "none"))
+                              if len(s) > 10 and s[10] != "none"), "none"),
+            tail_policy=next((s[11] for s in sigs
+                              if len(s) > 11 and s[11] != "strict"),
+                             "strict"))
         entry.handle = Handle(
             entry.name, single=(len(arrays) == 1
                                 and entry.group_id == -1))
@@ -823,6 +844,20 @@ class CollectiveEngine:
                 return "none"
         return fmt
 
+    def _bucket_tail_policy(self, first_sig, ps) -> str:
+        """Effective straggler tolerance of one fused dispatch: the
+        bucket's negotiated policy, gated to the hierarchical path —
+        a flat mesh has no DCN stage whose tail could be bounded, and
+        the replicated no-communication path has no round to wait on."""
+        pol = first_sig.tail_policy
+        if pol == "strict":
+            return "strict"
+        if not first_sig.stacked and not collectives.spans_processes(ps):
+            return "strict"   # replicated: computed locally, no round
+        if not self._hierarchical_enabled() or ps.hier_shape() is None:
+            return "strict"   # no DCN stage
+        return pol
+
     # -- dispatch -----------------------------------------------------------
     def _dispatch_bucket(self, entries, sigs, owner, base, bucket, results):
         first = sigs[bucket[0]]
@@ -892,7 +927,10 @@ class CollectiveEngine:
                 prescale_factor=e0.prescale, postscale_factor=e0.postscale,
                 stacked=first.stacked,
                 wire_format=self._bucket_wire_format(first, e0.process_set),
-                wire_block=getattr(self.cfg, "compression_block_size", 0))
+                wire_block=getattr(self.cfg, "compression_block_size", 0),
+                tail_policy=self._bucket_tail_policy(first, e0.process_set),
+                tail_name=first.name,
+                tail_bucket_names=tuple(sigs[si].name for si in bucket))
             for si, o in zip(bucket, outs):
                 results[si] = o
         else:
@@ -931,6 +969,13 @@ class CollectiveEngine:
         }
         if self._controller is not None:
             out["negotiation"] = self._controller.stats()
+        if self.stall is not None and not self.stall.disabled:
+            # per-host straggler EWMA (docs/observability.md): which
+            # peer is chronically late, in seconds of arrival lag
+            out["stall"] = {
+                "straggler_scores": self.stall.straggler_scores(),
+                "warnings_issued": self.stall.warnings_issued,
+            }
         if self.autotuner is not None:
             out["autotune"] = {
                 "fusion_threshold_bytes": self._fusion_threshold(),

@@ -83,6 +83,14 @@ class EntrySig:
     # the eager engine and the non-overlapped in-jit path, where the
     # whole plan dispatches at once and existing plans must not change).
     layer: int = -1
+    # negotiated straggler tolerance for the DCN stage of a hierarchical
+    # reduce (OptiReduce; "strict" = wait for every host).  A fused
+    # bucket runs ONE deadline gate and one participation mask, so
+    # mixed-policy entries must never share a bucket; like wire_format
+    # the field rides the negotiation token (field 11) and, being part
+    # of the (astuple) ResponseCache key, invalidates cached plans on a
+    # policy change.
+    tail_policy: str = "strict"
 
     @property
     def numel(self) -> int:
@@ -101,7 +109,7 @@ class EntrySig:
                 self.process_set_id, self.stacked,
                 1.0 if self.prescale is None else self.prescale,
                 1.0 if self.postscale is None else self.postscale,
-                self.wire_format, self.layer)
+                self.wire_format, self.layer, self.tail_policy)
 
 
 def plan_fusion(entries: Sequence[EntrySig],

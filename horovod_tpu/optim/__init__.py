@@ -4,7 +4,8 @@ horovod/tensorflow/__init__.py DistributedOptimizer/DistributedGradientTape).
 
 from .distributed import (  # noqa: F401
     DistributedOptimizer, DistributedGradientTransform, fused_reduce_tree,
-    fused_reduce_scatter_tree, all_gather_sharded_tree, shard_tree_like,
+    fused_reduce_scatter_tree, fused_tail_reduce_tree,
+    all_gather_sharded_tree, shard_tree_like,
     state_partition_specs, broadcast_parameters, broadcast_optimizer_state,
 )
 from .precision import (  # noqa: F401

@@ -232,7 +232,8 @@ def _resolve_threshold(threshold_bytes: Optional[int]) -> int:
 
 
 def _plan_buckets(leaves, names, op, prescale_factor, postscale_factor,
-                  threshold_bytes, wire_format: str = "none"):
+                  threshold_bytes, wire_format: str = "none",
+                  tail_policy: str = "strict"):
     """One planner for both worlds: leaves become EntrySigs (name = the
     sorted pytree path, the controller's total order) and the eager
     engine's ``plan_fusion`` decides the buckets.  Within one dtype the
@@ -246,9 +247,93 @@ def _plan_buckets(leaves, names, op, prescale_factor, postscale_factor,
                      stacked=False, prescale=prescale_factor,
                      postscale=postscale_factor,
                      wire_format=(wire_format if quantizable(leaves[i].dtype)
-                                  else "none"))
+                                  else "none"),
+                     tail_policy=tail_policy)
             for i in range(len(leaves))]
     return plan_fusion(sigs, threshold_bytes), sigs
+
+
+def fused_tail_reduce_tree(grads, cross_axis: str, local_axis: str,
+                           op: str = ReduceOp.AVERAGE,
+                           threshold_bytes: Optional[int] = None,
+                           tail_policy: str = "strict",
+                           present=None, tail_state=None,
+                           max_staleness: int = 0, wire_format=None):
+    """Hierarchical tail-tolerant fused reduce of a gradient pytree over
+    a ``(cross, local)`` mesh factoring (ISSUE 11 / ROADMAP item 2,
+    OptiReduce arXiv:2310.06993).
+
+    Buckets come from the SAME ``plan_fusion`` planner as every other
+    reduce path (``tail_policy`` rides each :class:`EntrySig`, so the
+    plan is the one peers negotiate) and each bucket runs
+    :func:`~..ops.collectives.hierarchical_allreduce_p` under its
+    ``hvd_bucket<i>`` scope: psum_scatter over ``local_axis`` (ICI),
+    the tail-tolerant DCN stage over ``cross_axis``
+    (:func:`~..ops.collectives.tail_allreduce_p` for non-strict
+    policies), all-gather over ``local_axis``.
+
+    ``present`` is the round's participation mask (fp32
+    ``[axis_size(cross_axis)]``; None = all present).  Under ``stale``
+    the per-bucket state threads through ``tail_state`` — a tuple of
+    ``(prev, staleness)`` per bucket, None to start from zeros — and
+    the return value is ``(reduced_tree, new_tail_state)``; other
+    policies return ``(reduced_tree, None)``.
+    """
+    from ..compat import axis_size
+    from ..ops.collectives import hierarchical_allreduce_p
+    from ..ops.fusion import pad_to_multiple
+    threshold_bytes = _resolve_threshold(threshold_bytes)
+    leaves, names, order = _tree_leaves_sorted(grads)
+    if not leaves:
+        return grads, None
+    treedef = jax.tree_util.tree_structure(grads)
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"fused_tail_reduce_tree supports op=Sum/Average, got {op!r}")
+    buckets, _sigs = _plan_buckets(leaves, names, op, 1.0, 1.0,
+                                   threshold_bytes,
+                                   tail_policy=tail_policy)
+    G = axis_size(cross_axis)
+    L = axis_size(local_axis)
+    if present is None:
+        present = jnp.ones((G,), jnp.float32)
+    stale = tail_policy == "stale"
+    if stale and tail_state is not None and len(tail_state) != len(buckets):
+        raise ValueError(
+            f"tail_state carries {len(tail_state)} bucket states for a "
+            f"{len(buckets)}-bucket plan — thread the state returned by "
+            f"the previous step (same tree, same threshold)")
+    out = [None] * len(leaves)
+    new_state = [] if stale else None
+    for bucket_id, bucket in enumerate(buckets):
+        with jax.named_scope(f"hvd_bucket{bucket_id}"):
+            parts = [leaves[i].reshape(-1) for i in bucket]
+            buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+            state_i = None
+            if stale:
+                if tail_state is not None:
+                    state_i = tail_state[bucket_id]
+                else:
+                    chunk_len = pad_to_multiple(buf.shape[0], L) // L
+                    state_i = (jnp.zeros((G, chunk_len), buf.dtype),
+                               jnp.zeros((G,), jnp.int32))
+            red = hierarchical_allreduce_p(
+                buf, cross_axis, local_axis, op=op,
+                wire_format=wire_format, tail_policy=tail_policy,
+                tail_present=present, tail_state=state_i,
+                tail_max_staleness=max_staleness)
+            if stale:
+                red, st = red
+                new_state.append(st)
+            off = 0
+            for i in bucket:
+                sz = leaves[i].size
+                out[i] = jax.lax.slice_in_dim(red, off, off + sz).reshape(
+                    leaves[i].shape)
+                off += sz
+    reduced = jax.tree_util.tree_unflatten(
+        treedef, _restore_order(out, order))
+    return reduced, (tuple(new_state) if stale else None)
 
 
 # ---------------------------------------------------------------------------

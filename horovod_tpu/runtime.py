@@ -442,11 +442,23 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
         _STATE.timeline = Timeline(
             cfg.timeline_path, mark_cycles=cfg.timeline_mark_cycles,
             use_native=cfg.use_native_core)
+        # straggler-score -> elastic-blacklist bridge (OptiReduce tail
+        # prescription): a host whose EWMA lateness crosses
+        # HOROVOD_TAIL_BLACKLIST_SCORE is reported to the elastic
+        # driver as a SOFT failure — it feeds the blacklist before the
+        # host dies outright.  Best effort and a no-op outside the
+        # elastic driver (no endpoint exported).
+        def _report_straggler(process, score):
+            from .elastic import worker as _ew
+            _ew.report_straggler(process, score)
+
         _STATE.stall_inspector = StallInspector(
             check_time=cfg.stall_check_time,
             shutdown_time=cfg.stall_shutdown_time,
             disabled=cfg.stall_check_disable,
-            use_native=cfg.use_native_core)
+            use_native=cfg.use_native_core,
+            blacklist_score=cfg.tail_blacklist_score,
+            on_straggler=_report_straggler)
 
         if cfg.autotune:
             from .autotune import ParameterManager

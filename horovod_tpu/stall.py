@@ -27,14 +27,42 @@ logger = logging.getLogger("horovod_tpu")
 _m_warnings = _metrics.counter(
     "hvd_stall_warnings_total",
     "Stall-inspector warning batches issued")
+_m_straggler = _metrics.gauge(
+    "hvd_straggler_score",
+    "Per-host straggler score: EWMA of observed collective-arrival "
+    "lateness (seconds); feeds the elastic blacklist as a soft failure "
+    "past HOROVOD_TAIL_BLACKLIST_SCORE", labels=("process",))
+
+#: EWMA weight of one observed arrival lateness.  High enough that a
+#: chronically slow host crosses a seconds-scale blacklist bar within a
+#: handful of rounds, low enough that one hiccup decays away.
+EWMA_ALPHA = 0.25
 
 
 class StallInspector:
     def __init__(self, check_time: float = 60.0, shutdown_time: float = 0.0,
-                 disabled: bool = False, use_native: bool = True):
+                 disabled: bool = False, use_native: bool = True,
+                 blacklist_score: float = 0.0, on_straggler=None):
         self.check_time = check_time
         self.shutdown_time = shutdown_time
         self.disabled = disabled or check_time <= 0
+        # straggler scoring (OptiReduce, ROADMAP item 2): per-host EWMA
+        # of observed arrival lateness.  Two feeds converge here: the
+        # eager DCN tail rounds (per cross-group injected/observed
+        # lateness, including 0.0 for on-time rounds — the decay) and
+        # the negotiation controller (a process first reported missing
+        # and later arriving was late by the missing->arrival gap).
+        # ``on_straggler(process, score)`` fires edge-triggered when a
+        # score crosses ``blacklist_score`` (> 0), re-arming once the
+        # score decays below half the bar — the hook the elastic plane
+        # uses to blacklist a chronically slow host BEFORE it dies.
+        self.blacklist_score = float(blacklist_score)
+        self.on_straggler = on_straggler
+        self._scores: Dict[int, float] = {}
+        self._flagged: set = set()
+        # (name, process) -> when the controller first reported the
+        # process missing for that tensor (lateness = clear time - this)
+        self._missing_since: Dict[tuple, float] = {}
         # guards _pending/_warned/_missing/warnings_issued: record_enqueue
         # runs on the submitting user thread while check() iterates the
         # same dicts on the engine thread — unguarded, a submission racing
@@ -69,23 +97,92 @@ class StallInspector:
             with self._lock:
                 self._pending.setdefault(name, t)
 
-    def record_missing(self, name: str, processes):
+    def record_missing(self, name: str, processes, now: float = None):
         """Record which processes have not announced ``name`` (from the
-        cross-process controller's negotiation round)."""
+        cross-process controller's negotiation round).
+
+        Arrival timestamps ride along: the first round that reports a
+        process missing stamps ``_missing_since[(name, process)]``, and
+        the round that no longer reports it (or ``record_complete``)
+        turns the gap into an observed LATENESS fed to the straggler
+        EWMA — absence alone says a host is behind, the timestamps say
+        by how much."""
         if self.disabled:
             return
+        now = time.monotonic() if now is None else now
+        cleared = []
         with self._lock:
-            self._missing[name] = sorted(set(int(p) for p in processes))
+            procs = sorted(set(int(p) for p in processes))
+            self._missing[name] = procs
+            live = set(procs)
+            for p in procs:
+                self._missing_since.setdefault((name, p), now)
+            for key in [k for k in self._missing_since
+                        if k[0] == name and k[1] not in live]:
+                cleared.append((key[1], now - self._missing_since.pop(key)))
+        for p, lateness in cleared:
+            self.note_lateness(p, lateness, now=now)
 
     def missing_processes(self, name: str):
         with self._lock:
             return list(self._missing.get(name, []))
 
-    def record_complete(self, name: str):
+    def missing_since(self, name: str, process: int):
+        """When ``process`` was first reported missing for ``name``
+        (None if it is not currently missing) — the arrival-timestamp
+        bookkeeping behind lateness observation."""
+        with self._lock:
+            return self._missing_since.get((name, int(process)))
+
+    def note_lateness(self, process: int, lateness_s: float,
+                      now: float = None):
+        """Feed one observed arrival lateness (seconds; 0.0 = on time)
+        into ``process``'s straggler EWMA.  Fires ``on_straggler``
+        edge-triggered past ``blacklist_score``."""
         if self.disabled:
             return
+        p = int(process)
+        fire = None
+        with self._lock:
+            score = self._scores.get(p, 0.0)
+            score += EWMA_ALPHA * (max(float(lateness_s), 0.0) - score)
+            self._scores[p] = score
+            if self.blacklist_score > 0:
+                if score >= self.blacklist_score and p not in self._flagged:
+                    self._flagged.add(p)
+                    fire = score
+                elif (score < self.blacklist_score / 2.0
+                      and p in self._flagged):
+                    self._flagged.discard(p)   # re-arm after decay
+        if _metrics.ACTIVE:
+            _m_straggler.set(score, process=str(p))
+        if fire is not None and self.on_straggler is not None:
+            # outside the lock: the hook may RPC the elastic driver
+            try:
+                self.on_straggler(p, fire)
+            except Exception:  # noqa: BLE001 - observability must not
+                # fail the dispatch path
+                logger.warning("straggler report hook failed",
+                               exc_info=True)
+
+    def straggler_scores(self) -> Dict[int, float]:
+        """Per-process straggler score snapshot (exposed through
+        ``engine.stats()['stall']``)."""
+        with self._lock:
+            return dict(self._scores)
+
+    def record_complete(self, name: str, now: float = None):
+        if self.disabled:
+            return
+        now = time.monotonic() if now is None else now
+        cleared = []
         with self._lock:
             self._missing.pop(name, None)
+            # a process still stamped missing when the tensor completes
+            # arrived last: its lateness is the full missing->complete
+            # gap (the arrival-timestamp satellite of the tail PR)
+            for key in [k for k in self._missing_since if k[0] == name]:
+                cleared.append((key[1], now - self._missing_since.pop(key)))
             # _warned is cleared on BOTH paths: the native tracker keeps
             # its own warned set, but _warn() mirrors warned names into
             # this dict (so warnings_issued bookkeeping is path-
@@ -98,6 +195,8 @@ class StallInspector:
             self._warned.pop(name, None)
             if self._native is None:
                 self._pending.pop(name, None)
+        for p, lateness in cleared:
+            self.note_lateness(p, lateness, now=now)
         if self._native is not None:
             self._native.record_complete(name)
 

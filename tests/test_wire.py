@@ -49,6 +49,36 @@ def test_ring_formulas():
         sizes) == 3 * 400 // 4
 
 
+def test_pmin_pmax_cost_like_psum():
+    """The tail-reduce's pmin membership-agreement round (and any
+    pmax): a combining allreduce moves the same ring bytes whatever the
+    combiner — these used to fall into the conservative unknown-prim
+    fallback and overstate the agreement round ~2x."""
+    sizes = {"w": 4}
+    want = 2 * 3 * (2 * 4) // 4
+    assert ring_transmit_bytes(
+        _rec("pmin", ["float32[2]"], ["float32[2]"]), sizes) == want
+    assert ring_transmit_bytes(
+        _rec("pmax", ["float32[2]"], ["float32[2]"]), sizes) == want
+
+
+def test_strict_accounting_raises_on_unknown_prims():
+    """bench_tail's byte-conservation gate runs strict: a schedule
+    growing a collective the ring model doesn't price must fail loudly,
+    not be silently approximated."""
+    sizes = {"w": 4}
+    rec = _rec("ppermute", ["float32[64]"], ["float32[64]"])
+    # default: conservative in_bytes fallback (unchanged behavior)
+    assert ring_transmit_bytes(rec, sizes) == 256
+    with pytest.raises(ValueError, match="ring-cost model"):
+        ring_transmit_bytes(rec, sizes, strict=True)
+
+
+def test_prim_counts_alias():
+    from horovod_tpu.analysis.wire import prim_counts
+    assert prim_counts is schedule_prim_counts
+
+
 def test_axis_filter_and_unknown_axes():
     sizes = {"dcn": 2, "ici": 4}
     r = _rec("psum", ["float32[64]"], ["float32[64]"], axes=("ici",))

@@ -35,11 +35,15 @@
 #      with bit-identical replicas) — and tools/bench_overlap.py
 #      --smoke — overlapped-dispatch invariants (per-layer buckets
 #      inside the backward scan, boundary/overlapped weights
-#      bit-identical incl. sharded x int8)
+#      bit-identical incl. sharded x int8) — and tools/bench_tail.py
+#      --smoke — tail-tolerant-collective invariants (chaos-seeded
+#      p99 bound, strict/bounded one-program bit-exactness,
+#      convergence gate, byte conservation)
 #  11. hvdsched: re-trace the builtin step entries to jaxprs on CPU and
 #      diff their collective schedules against tests/schedules/
 #      (HVD211 drift; incl. the sharded_distopt_step reduce_scatter →
-#      all_gather plan) + the cross-mesh-size consistency check
+#      all_gather plan and the tail_distopt_step rewritten DCN stage) +
+#      the cross-mesh-size consistency check
 #      (HVD210); any fusion-plan change is an explicit snapshot update
 #      in review (docs/analysis.md "Schedule snapshots")
 set -euo pipefail
@@ -161,6 +165,24 @@ def _ov_step(g):
     return gr
 jax.make_jaxpr(_ov_step, axis_env=[("smk", 2)])(jnp.zeros((8,)))
 
+# tail-tolerant collectives (ISSUE 11): one chaos-seeded bounded DCN
+# round through the eager deadline gate — the straggler misses the
+# deadline, is excluded from the mask, and both the round counter and
+# its straggler score must land on /metrics
+import horovod_tpu.chaos as hvchaos
+from horovod_tpu.ops import collectives as hvcoll
+from horovod_tpu.stall import StallInspector
+insp = StallInspector(check_time=1e9, use_native=False)
+hvchaos.install(hvchaos.FaultSchedule.parse(
+    "collective.dcn group=1 nth=1 action=delay:0.3", seed=5))
+try:
+    present = hvcoll.tail_round("ci_smoke", "bounded", 2, 0.05,
+                                stall=insp)
+finally:
+    hvchaos.uninstall()
+assert list(present) == [1.0, 0.0], present
+assert insp.straggler_scores()[1] > 0, insp.straggler_scores()
+
 fams = aggregate.parse_prometheus(aggregate.scrape("127.0.0.1", srv.port))
 def _family_count(fam, **want):
     return sum(v for _, lbl, v in fams[fam]["samples"]
@@ -173,6 +195,10 @@ watch_rounds = _family_count("hvd_negotiation_rounds_total", kind="watch")
 assert watch_rounds >= 2, fams["hvd_negotiation_rounds_total"]["samples"]
 reuse_hits = _family_count("hvd_rpc_conn_reuse_total", result="hit")
 assert reuse_hits >= 1, fams["hvd_rpc_conn_reuse_total"]["samples"]
+tail_rounds = _family_count("hvd_tail_rounds_total", policy="bounded")
+assert tail_rounds >= 1, fams["hvd_tail_rounds_total"]["samples"]
+straggler = _family_count("hvd_straggler_score", process="1")
+assert straggler > 0, fams["hvd_straggler_score"]["samples"]
 srv.close()
 
 hvd.shutdown()
@@ -252,6 +278,17 @@ tail -1 /tmp/ci_bench_comp.log
 python tools/bench_overlap.py --smoke > /tmp/ci_bench_overlap.log 2>&1 \
   || { tail -30 /tmp/ci_bench_overlap.log; exit 1; }
 tail -1 /tmp/ci_bench_overlap.log
+# tail-tolerant collectives: under the fixed collective.dcn 800ms delay
+# seed, bounded-policy round p99 must stay <= deadline + eps while
+# strict p99 tracks the injected delay; strict/bounded one-program A/B
+# bit-identical across plain/sharded/int8 with no deadline firing; the
+# bounded/stale toy-training rel-loss delta inside the documented gate;
+# ring bytes conserved up to the pmin agreement round (strict
+# accounting — unmodeled prims fail loudly).  (docs/performance.md
+# "Tail-tolerant collectives")
+python tools/bench_tail.py --smoke > /tmp/ci_bench_tail.log 2>&1 \
+  || { tail -30 /tmp/ci_bench_tail.log; exit 1; }
+tail -1 /tmp/ci_bench_tail.log
 
 echo "== 11/11 hvdsched: collective-schedule snapshots + consistency =="
 # re-trace every builtin step entry to a jaxpr on CPU, diff against the
