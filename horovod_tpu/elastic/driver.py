@@ -215,6 +215,12 @@ class ElasticDriver:
             # (histograms bucket-wise, gauges per-worker min/max/sum) so
             # one scrape answers "which worker is the straggler"
             "metrics/job": self._metrics_job_route,
+            # job-wide distributed trace: every worker's span buffer
+            # pulled over the keep-alive pool, clocks aligned via RPC
+            # midpoint offsets, one Chrome-trace JSON with one pid per
+            # host (docs/observability.md "Distributed trace";
+            # tools/hvdtrace analyzes the critical path over it)
+            "trace/job": self._trace_job_route,
         })
 
     def _metrics_job_route(self):
@@ -222,6 +228,15 @@ class ElasticDriver:
             endpoints = {str(wid): ep for wid, ep in self._notif.items()}
         body = _metrics.aggregate.scrape_and_merge(endpoints)
         return (200, "text/plain; version=0.0.4; charset=utf-8", body)
+
+    def _trace_job_route(self):
+        from .. import tracing as _tracing
+        with self._lock:
+            endpoints = {str(wid): ep for wid, ep in self._notif.items()}
+        trace = _tracing.merge.scrape_job_trace(
+            endpoints, probes=_tracing.probes())
+        return (200, "application/json",
+                json.dumps(trace, separators=(",", ":")))
 
     # --- lifecycle events --------------------------------------------------
 

@@ -220,8 +220,13 @@ class WorkerNotificationManager:
     """In-worker listener the driver pushes host updates to."""
 
     def __init__(self):
+        from .. import tracing as _tracing
         self._listeners = []
-        self._server = JsonRpcServer({"hosts_updated": self._on_update})
+        # trace_pull: the driver's GET /trace/job scrapes this worker's
+        # span buffer (and its clock-offset probes) over the same
+        # keep-alive RPC pool every other control-plane call rides
+        self._server = JsonRpcServer({"hosts_updated": self._on_update,
+                                      "trace_pull": _tracing.pull_handler})
         self._registered = False
 
     def init(self):

@@ -223,7 +223,9 @@ def healthz() -> dict:
 
 def get_routes() -> Dict[str, "callable"]:
     """Default GET routes every JsonRpcServer serves: ``/metrics``
-    (Prometheus text format) and ``/healthz`` (JSON liveness).  Each
+    (Prometheus text format), ``/healthz`` (JSON liveness), and
+    ``/trace`` (this process's span buffer as Chrome-trace JSON — the
+    single-host slice of the driver's merged ``/trace/job``).  Each
     route returns ``(status, content_type, body)``."""
     def _metrics_route():
         return (200, "text/plain; version=0.0.4; charset=utf-8",
@@ -232,7 +234,14 @@ def get_routes() -> Dict[str, "callable"]:
     def _healthz_route():
         return (200, "application/json", json.dumps(healthz()))
 
-    return {"metrics": _metrics_route, "healthz": _healthz_route}
+    def _trace_route():
+        from .. import tracing  # lazy: tracing pulls no metrics state
+        return (200, "application/json",
+                json.dumps(tracing.local_trace(),
+                           separators=(",", ":")))
+
+    return {"metrics": _metrics_route, "healthz": _healthz_route,
+            "trace": _trace_route}
 
 
 def init_from_env(environ=os.environ):

@@ -41,6 +41,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import chaos as _chaos
 from .. import metrics as _metrics
+from .. import tracing as _tracing
 from ..compression import (WireFormat, dequantize_blocks, quantize_blocks,
                            resolve_wire_format)
 from ..runtime import ReduceOp
@@ -794,6 +795,7 @@ def tail_round(name: str, tail_policy: str, n_groups: int,
     """One eager DCN tail round: plan (``plan_tail_round``), wait the
     planned wall-clock time, count the round
     (``hvd_tail_rounds_total{policy}``), and return the mask."""
+    t0 = _tracing.now() if _tracing.ACTIVE else 0.0
     present, wait_s, lateness = plan_tail_round(
         name, tail_policy, n_groups, deadline_s,
         max_staleness=max_staleness, staleness=staleness, stall=stall)
@@ -801,6 +803,15 @@ def tail_round(name: str, tail_policy: str, n_groups: int,
         _m_tail_rounds.inc(policy=tail_policy)
     if wait_s > 0:
         time.sleep(wait_s)
+    if _tracing.ACTIVE:
+        # the DCN phase span the critical-path analyzer pivots on:
+        # which cross-groups were excluded by the deadline, and how
+        # late each one ran (docs/observability.md "Distributed trace")
+        _tracing.span(
+            "dcn", name, t0, _tracing.now(), policy=tail_policy,
+            deadline_s=float(deadline_s), wait_s=round(float(wait_s), 6),
+            excluded=[g for g in range(n_groups) if present[g] == 0.0],
+            lateness=[round(float(v), 6) for v in lateness])
     return present
 
 
@@ -894,10 +905,11 @@ def allreduce_arrays(arrays: List, ps, op: str = ReduceOp.AVERAGE,
             deadline_s, max_stal, stall = _tail_params()
             fn = _hier_allreduce_fn(*key, tail_policy, max_stal)
             if tail_policy == "strict":
-                if _chaos.ACTIVE or _metrics.ACTIVE:
+                if _chaos.ACTIVE or _metrics.ACTIVE or _tracing.ACTIVE:
                     # strict rounds still observe injected DCN arrival
                     # delays (they wait them out — the straggler
-                    # baseline) and count toward the round metric
+                    # baseline), count toward the round metric, and
+                    # record their dcn span for the job-wide trace
                     tail_round(tail_name, "strict", hier[0], deadline_s,
                                stall=stall)
                 return list(fn(pre, post, *arrays))

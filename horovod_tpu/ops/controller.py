@@ -64,6 +64,7 @@ import jax
 
 from .. import chaos as _chaos
 from .. import metrics as _metrics
+from .. import tracing as _tracing
 from ..exceptions import HorovodInternalError, StallError
 
 logger = logging.getLogger("horovod_tpu")
@@ -221,6 +222,12 @@ class NegotiationResult:
     # allgather row counts — the reference controller's tensor-size
     # gathering): {process: {key: value}}
     aux: Dict[int, dict] = dataclasses.field(default_factory=dict)
+    # the round's sequence number within its member group and the group
+    # key: seq advances in lockstep on every member, so it is the
+    # cross-worker correlation id the distributed tracer tags spans
+    # with (tracing/, docs/observability.md "Distributed trace")
+    seq: int = -1
+    group: str = ""
 
 
 def entry_token(entry) -> str:
@@ -448,24 +455,39 @@ class Controller:
         rides hash-only fast rounds too, so it may change while the
         cycle signature stays cached.
         """
-        if not _metrics.ACTIVE:
+        if not _metrics.ACTIVE and not _tracing.ACTIVE:
             return self._negotiate_impl(tokens, procs, params, aux)
         t0 = time.monotonic()
+        span_t0 = _tracing.now() if _tracing.ACTIVE else 0.0
         kind = "error"
+        res = None
         try:
             res = self._negotiate_impl(tokens, procs, params, aux)
             kind = ("joined" if res.all_joined
                     else "fast" if res.fast else "full")
             return res
         finally:
-            _m_neg_rounds.inc(kind=kind)
-            _m_neg_dur.observe(time.monotonic() - t0, kind=kind)
-            # transport marker, alongside the outcome kind: rounds whose
-            # peer gather rode the long-poll watch (docs/metrics.md)
-            with self._lock:
-                used = self._watch_used
-            if used:
-                _m_neg_rounds.inc(kind="watch")
+            if _metrics.ACTIVE:
+                _m_neg_rounds.inc(kind=kind)
+                _m_neg_dur.observe(time.monotonic() - t0, kind=kind)
+                # transport marker, alongside the outcome kind: rounds
+                # whose peer gather rode the long-poll watch
+                # (docs/metrics.md)
+                with self._lock:
+                    used = self._watch_used
+                if used:
+                    _m_neg_rounds.inc(kind="watch")
+            if _tracing.ACTIVE:
+                # the round id is THE cross-worker correlation key: seq
+                # advances in lockstep on every group member, so the
+                # driver-side merge can line this span up against the
+                # peers' without any shared clock
+                seq = res.seq if res is not None else -1
+                _tracing.span(
+                    "negotiate", f"round{seq}", span_t0, _tracing.now(),
+                    round=seq, kind=kind,
+                    group=res.group if res is not None else "",
+                    tokens=len(tokens))
 
     def _negotiate_impl(self, tokens: List[str], procs: Tuple[int, ...],
                         params: Optional[dict] = None,
@@ -536,7 +558,8 @@ class Controller:
             if not active:
                 # every process has joined: resolve join() everywhere
                 last = max((vals[q].get("js", 0), q) for q in joined_ps)[1]
-                return NegotiationResult(all_joined=True, last_joiner=last)
+                return NegotiationResult(all_joined=True, last_joiner=last,
+                                         seq=seq, group=gk)
 
             hashes = {vals[q]["h"] for q in active}
             if len(hashes) == 1 and not joined_ps:
@@ -552,7 +575,8 @@ class Controller:
                         self.full_rounds += 1
                 return NegotiationResult(counts=Counter(tokens), fast=fast,
                                          params=agreed_params,
-                                         aux=aux_by_proc)
+                                         aux=aux_by_proc,
+                                         seq=seq, group=gk)
 
             # mismatch (or join in progress): full request lists needed.
             with self._lock:
@@ -578,6 +602,8 @@ class Controller:
             result = self._decide(gk, full, active, joined_ps, vals, me)
             result.params = agreed_params
             result.aux = aux_by_proc
+            result.seq = seq
+            result.group = gk
             return result
 
     # -- decision function (identical on every member) -----------------------

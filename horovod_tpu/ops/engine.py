@@ -31,6 +31,7 @@ import jax
 
 from .. import chaos as _chaos
 from .. import metrics as _metrics
+from .. import tracing as _tracing
 from ..exceptions import HorovodInternalError
 from ..runtime import ReduceOp
 from . import collectives
@@ -433,6 +434,16 @@ class CollectiveEngine:
             if _metrics.ACTIVE:
                 _m_cycles.inc()
                 _m_cycle_dur.observe(time.monotonic() - t_cycle)
+            if _tracing.ACTIVE:
+                # envelope span (not on the round critical path): one
+                # per drained batch, the lane a merged view groups the
+                # phase spans under.  t_cycle is time.monotonic (the
+                # metrics clock); translate the elapsed age onto the
+                # buffer clock like the submit span
+                t1 = _tracing.now()
+                _tracing.span("cycle", f"cycle{self._cycle_count}",
+                              t1 - (time.monotonic() - t_cycle), t1,
+                              entries=len(entries))
             with self._cv:
                 # cycle completion wakes join()'s event-driven drain
                 self._cycle_active = False
@@ -536,7 +547,16 @@ class CollectiveEngine:
                         if all(v is not None for v in sizes):
                             pr[i] = (procs, [int(v) for v in sizes])
                     e.peer_rows = pr or None
-            last_res = res
+            # the GLOBAL group's round (when this cycle has one) is the
+            # one the job-wide trace correlates on — subset groups'
+            # per-group sequence numbers are independent counters, so a
+            # subset round must never override the global round id in
+            # the cycle's tracing context.  The explicit all_procs arm
+            # is the guarantee; sorted() order gives none (a subset
+            # that is a prefix of the global tuple sorts before it,
+            # others after)
+            if procs == all_procs or last_res.seq < 0:
+                last_res = res
             counts = dict(res.counts)
             for e, t in zip(grp, tokens):
                 if counts.get(t, 0) > 0:
@@ -650,6 +670,24 @@ class CollectiveEngine:
 
     def _run_cycle(self, entries: List[TensorTableEntry]):
         self._cycle_count += 1
+        t_drain = t_drain_mono = 0.0
+        if _tracing.ACTIVE:
+            t_drain = _tracing.now()
+            t_drain_mono = time.monotonic()
+            # default correlation id: WITHOUT a controller every worker
+            # drains in lockstep, so the cycle count correlates across
+            # workers (group "" marks the fallback).  WITH a controller
+            # the cycle count drifts per worker (paced empty-agreement
+            # cycles, uneven submission — the drift is why negotiation
+            # exists), so a cycle that never negotiates (all entries
+            # local-only) must stay OFF the round path (round=-1), not
+            # alias some other worker's unrelated cycle; the negotiated
+            # round overrides below.
+            ctl_on = (self._controller is not None
+                      and self._controller.enabled)
+            _tracing.set_context(
+                round=-1 if ctl_on else self._cycle_count,
+                cycle=self._cycle_count, group="")
         if self.timeline:
             self.timeline.cycle_mark(self._cycle_count)
         if self._controller is not None and self._controller.enabled:
@@ -663,6 +701,14 @@ class CollectiveEngine:
             with jax.profiler.TraceAnnotation(
                     f"hvd.NEGOTIATE[{len(entries)}]"):
                 entries, _res = self._negotiate(entries)
+            if _tracing.ACTIVE and _res.seq >= 0:
+                # the agreed (group, round) tags every later span of
+                # this cycle (fuse/dispatch/dcn) — the cross-worker
+                # correlation key.  Multi-group cycles prefer the
+                # GLOBAL group's round (see _negotiate); round ids are
+                # per-group counters, so the group key rides along to
+                # keep subset-set rounds from aliasing global ones
+                _tracing.set_context(round=_res.seq, group=_res.group)
             if not entries:
                 if self.stall:
                     self.stall.check()
@@ -675,6 +721,15 @@ class CollectiveEngine:
                     if self._submit_gen == gen0 and not self._stop:
                         self._cv.wait(timeout=self._pace_s)
                 return
+        if _tracing.ACTIVE and entries:
+            # submit phase: earliest agreed entry's enqueue -> drain
+            # (the queue wait the round paid before any negotiation).
+            # enqueue_time is time.monotonic (stall inspector domain);
+            # translate the age into the buffer-clock domain so both
+            # endpoints live on the clock the merger aligns
+            age = t_drain_mono - min(e.enqueue_time for e in entries)
+            _tracing.span("submit", f"cycle{self._cycle_count}",
+                          t_drain - age, t_drain, entries=len(entries))
         self._execute(entries)
 
     def _execute(self, entries: List[TensorTableEntry]):
@@ -712,14 +767,19 @@ class CollectiveEngine:
             # them would score tuner candidates against stale plans
             self._cache.clear()
             self._last_threshold = threshold
+        t_fuse = _tracing.now() if _tracing.ACTIVE else 0.0
         plan = self._cache.get(sigs) if use_cache else None
+        cached_plan = plan is not None
         if _metrics.ACTIVE and use_cache:
-            _m_plan_cache.inc(result="hit" if plan is not None
-                              else "miss")
+            _m_plan_cache.inc(result="hit" if cached_plan else "miss")
         if plan is None:
             plan = self._plan_fn(sigs, threshold)
             if use_cache:
                 self._cache.put(sigs, plan)
+        if _tracing.ACTIVE:
+            _tracing.span("fuse", f"plan[{len(sigs)}]", t_fuse,
+                          _tracing.now(), buckets=len(plan),
+                          cached=cached_plan)
 
         # autotune scoring clock: from cycle start (includes the batching
         # window being tuned) when the background loop set it
@@ -862,8 +922,17 @@ class CollectiveEngine:
     def _dispatch_bucket(self, entries, sigs, owner, base, bucket, results):
         first = sigs[bucket[0]]
         op_type = first.op_type
+        ps = entries[owner[bucket[0]]].process_set
+        # effective negotiated bucket properties, resolved ONCE: the
+        # dispatch itself, the metrics wire accounting, the timeline
+        # event args, and the tracing span all describe the same bucket
+        if op_type == "allreduce":
+            eff = self._bucket_wire_format(first, ps)
+            tail = self._bucket_tail_policy(first, ps)
+        else:
+            eff, tail = "none", "strict"
+        nbytes = sum(sigs[si].nbytes for si in bucket)
         if _metrics.ACTIVE:
-            nbytes = sum(sigs[si].nbytes for si in bucket)
             _m_dispatch_tensors.observe(len(bucket), op=op_type)
             _m_dispatch_bytes.observe(nbytes, op=op_type)
             if op_type == "allreduce" and self._last_threshold > 0:
@@ -876,10 +945,6 @@ class CollectiveEngine:
             # cross-group chunk (1/group of the payload) is quantized —
             # the ICI stages stay in the full-width family, so the int8
             # series never overstates what crossed the wire compressed.
-            eff = "none"
-            ps = entries[owner[bucket[0]]].process_set
-            if op_type == "allreduce":
-                eff = self._bucket_wire_format(first, ps)
             if eff == "none":
                 _m_wire_bytes.inc(nbytes, format=str(first.dtype))
             else:
@@ -902,18 +967,31 @@ class CollectiveEngine:
         # profiler range per fused dispatch (reference: nvtx_op_range.cc —
         # the NVTX analog; lands inside any active jax.profiler trace so
         # framework spans merge with the XLA device trace, SURVEY §5.1)
+        t_disp = _tracing.now() if _tracing.ACTIVE else 0.0
         with jax.profiler.TraceAnnotation(
                 f"hvd.{op_type}[{len(bucket)}]"):
             self._dispatch_bucket_inner(entries, sigs, owner, base, bucket,
-                                        results, op_type)
+                                        results, op_type, eff, tail)
+        if _tracing.ACTIVE:
+            _tracing.span("dispatch", first.name, t_disp, _tracing.now(),
+                          op=op_type, tensors=len(bucket), bytes=nbytes,
+                          wire_format=eff, tail_policy=tail)
 
     def _dispatch_bucket_inner(self, entries, sigs, owner, base, bucket,
-                               results, op_type):
+                               results, op_type, wire_format, tail_policy):
         first = sigs[bucket[0]]
         if self.timeline:
             names = [sigs[si].name for si in bucket]
             self.timeline.activity_start(names, "MEMCPY_IN_FUSION_BUFFER")
-            self.timeline.activity_transition(names, f"XLA_{op_type.upper()}")
+            # the negotiated bucket properties ride the XLA event's args
+            # (PR 8–11 vocabulary): which wire format the dispatch
+            # applied, its straggler tolerance, and the dispatch phase
+            # (engine dispatches are always the step-boundary phase —
+            # overlapped in-backward dispatches never pass through here)
+            self.timeline.activity_transition(
+                names, f"XLA_{op_type.upper()}",
+                args={"wire_format": wire_format,
+                      "tail_policy": tail_policy, "phase": "boundary"})
 
         def arr(si):
             e = entries[owner[si]]
@@ -926,9 +1004,9 @@ class CollectiveEngine:
                 arrays, e0.process_set, op=first.reduce_op,
                 prescale_factor=e0.prescale, postscale_factor=e0.postscale,
                 stacked=first.stacked,
-                wire_format=self._bucket_wire_format(first, e0.process_set),
+                wire_format=wire_format,
                 wire_block=getattr(self.cfg, "compression_block_size", 0),
-                tail_policy=self._bucket_tail_policy(first, e0.process_set),
+                tail_policy=tail_policy,
                 tail_name=first.name,
                 tail_bucket_names=tuple(sigs[si].name for si in bucket))
             for si, o in zip(bucket, outs):

@@ -69,6 +69,7 @@ import numpy as np
 from jax import lax
 
 from .. import metrics as _metrics
+from .. import tracing as _tracing
 from ..compat import axis_size as _axis_size
 from ..compat import pcast_varying, psum_scatter
 from ..runtime import ReduceOp
@@ -402,6 +403,7 @@ def reduce_full(tree, plan: OverlapPlan, force_root: bool = False):
     """Full-width reduction of ``tree`` under the layer-aware plan, in
     explicit dispatch order — value-identical to the taps' in-backprop
     dispatch (same buckets, same staging, same scale order)."""
+    t_stage = _tracing.now() if _tracing.ACTIVE else 0.0
     leaves, layout = build_layout(tree, plan, shards=1,
                                   force_root=force_root)
     if not leaves:
@@ -427,6 +429,14 @@ def reduce_full(tree, plan: OverlapPlan, force_root: bool = False):
     if _metrics.ACTIVE:
         _m_buckets.inc(len(layout.buckets),
                        phase="bwd" if active() else "boundary")
+    if _tracing.ACTIVE:
+        # TRACE-TIME span (round=-1: never on a runtime round's
+        # critical path — the dispatch itself runs inside the compiled
+        # program): records when and how the overlap plan staged its
+        # buckets, the in-jit analog of the engine's dispatch spans
+        _tracing.span("overlap", "reduce_full", t_stage, _tracing.now(),
+                      round=-1, phase="bwd" if active() else "boundary",
+                      buckets=len(layout.buckets))
     return _assemble(pieces, layout)
 
 
@@ -438,6 +448,7 @@ def scatter_tiles(tree, plan: OverlapPlan, force_root: bool = False,
     quantized sum-scatter staging) instead of ``psum``.  Pass a
     prebuilt ``layout`` to skip re-planning (it must come from this
     plan over a same-shaped tree)."""
+    t_stage = _tracing.now() if _tracing.ACTIVE else 0.0
     if layout is None:
         leaves, layout = build_layout(tree, plan,
                                       shards=_axis_size(plan.axis_name),
@@ -467,6 +478,12 @@ def scatter_tiles(tree, plan: OverlapPlan, force_root: bool = False,
     if _metrics.ACTIVE:
         _m_buckets.inc(len(layout.buckets),
                        phase="bwd" if active() else "boundary")
+    if _tracing.ACTIVE:
+        # trace-time overlap staging span (see reduce_full)
+        _tracing.span("overlap", "scatter_tiles", t_stage,
+                      _tracing.now(), round=-1,
+                      phase="bwd" if active() else "boundary",
+                      buckets=len(layout.buckets))
     return tuple(tiles), layout
 
 

@@ -481,6 +481,16 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
             ns = f"e{max(elastic_worker._last_epoch, 0)}"
         else:
             ns = f"g{_INIT_GENERATION}"
+        # distributed-tracing identity/context (tracing/): spans carry
+        # this worker's process rank, host, and elastic epoch so the
+        # driver's /trace/job merge can assign one pid per host and
+        # correlate rounds across incarnations
+        from . import tracing as _tracing
+        _tracing.init_from_env()
+        _tracing.set_identity(
+            process=jax.process_index(),
+            host=os.environ.get("HOROVOD_HOSTNAME") or None,
+            epoch=int(ns[1:]))
         from .ops.controller import Controller
         from .ops.engine import CollectiveEngine
         _STATE.engine = CollectiveEngine(

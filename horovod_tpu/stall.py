@@ -32,6 +32,13 @@ _m_straggler = _metrics.gauge(
     "Per-host straggler score: EWMA of observed collective-arrival "
     "lateness (seconds); feeds the elastic blacklist as a soft failure "
     "past HOROVOD_TAIL_BLACKLIST_SCORE", labels=("process",))
+_m_lateness = _metrics.histogram(
+    "hvd_tail_lateness_seconds",
+    "Observed per-host DCN arrival lateness (every observation the "
+    "straggler EWMA ingests, incl. 0.0 on-time rounds): the EWMA "
+    "gauge alone cannot distinguish a chronic 100 ms host from a rare "
+    "2 s one — the distribution can.  Fixed log2 edges merge "
+    "bucket-wise in /metrics/job", labels=("process",), lo=-10, hi=7)
 
 #: EWMA weight of one observed arrival lateness.  High enough that a
 #: chronically slow host crosses a seconds-scale blacklist bar within a
@@ -156,6 +163,8 @@ class StallInspector:
                     self._flagged.discard(p)   # re-arm after decay
         if _metrics.ACTIVE:
             _m_straggler.set(score, process=str(p))
+            _m_lateness.observe(max(float(lateness_s), 0.0),
+                                process=str(p))
         if fire is not None and self.on_straggler is not None:
             # outside the lock: the hook may RPC the elastic driver
             try:

@@ -24,6 +24,14 @@ import threading
 import time
 from typing import List, Optional
 
+#: Distinct tensor lanes per incarnation (file).  A long-lived engine
+#: with call-site auto names sees a bounded set; unbounded user-named
+#: streams (e.g. per-step names) used to grow ``_tensor_tids`` forever —
+#: past the cap, new names share one "overflow" lane (tid 0, same
+#: convention as the metric registry's overflow series) instead of
+#: growing per-process memory without bound.
+MAX_TENSOR_TIDS = 4096
+
 
 class Timeline:
     def __init__(self, path: Optional[str], mark_cycles: bool = False,
@@ -39,6 +47,7 @@ class Timeline:
         self._t0 = time.monotonic()
         self._tensor_tids = {}
         self._next_tid = 1
+        self._overflow_named = False
         self._lock = threading.Lock()
         if path:
             self.reopen(path, mark_cycles)
@@ -72,6 +81,16 @@ class Timeline:
             self._file = open(path, "w")
             self._file.write("[\n")
         self._first = True
+        # per-incarnation tid table: the thread_name metadata events
+        # live in the PREVIOUS file, so carrying the map across a
+        # reopen (elastic re-form) would emit events on lanes the new
+        # file never names — and the map would grow across every
+        # incarnation of a long-lived job.  Reset; names re-register
+        # (and re-emit their metadata) on first use in the new file.
+        with self._lock:
+            self._tensor_tids = {}
+            self._next_tid = 1
+            self._overflow_named = False
         self._thread = threading.Thread(
             target=self._writer_loop, name="hvd-timeline", daemon=True)
         self._thread.start()
@@ -100,6 +119,15 @@ class Timeline:
         with self._lock:
             tid = self._tensor_tids.get(name)
             if tid is None:
+                if len(self._tensor_tids) >= MAX_TENSOR_TIDS:
+                    # bounded per incarnation: overflow names share the
+                    # cycle-marker lane, named once
+                    if not self._overflow_named:
+                        self._overflow_named = True
+                        self._emit({"name": "thread_name", "ph": "M",
+                                    "pid": 0, "tid": 0,
+                                    "args": {"name": "overflow"}})
+                    return 0
                 tid = self._next_tid
                 self._next_tid += 1
                 self._tensor_tids[name] = tid
@@ -129,17 +157,26 @@ class Timeline:
         self._emit({"name": "QUEUED", "ph": "B", "pid": 0, "tid": tid,
                     "ts": ts})
 
-    def activity_start(self, names: List[str], activity: str):
+    def activity_start(self, names: List[str], activity: str,
+                       args: Optional[dict] = None):
+        """``args`` (JSON-serializable) ride the opening "B" event — the
+        engine annotates ``XLA_<OP>`` events with the bucket's
+        negotiated ``wire_format`` / ``tail_policy`` / dispatch phase
+        so per-worker traces show what the negotiation agreed."""
         if not self.enabled:
             return
         for name in names:
             tid = self._tid(name)
             self._emit({"name": "QUEUED", "ph": "E", "pid": 0, "tid": tid,
                         "ts": self._ts_us()})
-            self._emit({"name": activity, "ph": "B", "pid": 0, "tid": tid,
-                        "ts": self._ts_us()})
+            ev = {"name": activity, "ph": "B", "pid": 0, "tid": tid,
+                  "ts": self._ts_us()}
+            if args:
+                ev["args"] = args
+            self._emit(ev)
 
-    def activity_transition(self, names: List[str], activity: str):
+    def activity_transition(self, names: List[str], activity: str,
+                            args: Optional[dict] = None):
         if not self.enabled:
             return
         for name in names:
@@ -147,8 +184,11 @@ class Timeline:
             ts = self._ts_us()
             self._emit({"name": "", "ph": "E", "pid": 0, "tid": tid,
                         "ts": ts})
-            self._emit({"name": activity, "ph": "B", "pid": 0, "tid": tid,
-                        "ts": ts})
+            ev = {"name": activity, "ph": "B", "pid": 0, "tid": tid,
+                  "ts": ts}
+            if args:
+                ev["args"] = args
+            self._emit(ev)
 
     def activity_end(self, names: List[str]):
         if not self.enabled:

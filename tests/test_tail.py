@@ -580,3 +580,31 @@ def test_bounded_schedule_keeps_psum_adds_agreement():
     counts = prim_counts(trace_schedule(step, (spec,), axis_env=env))
     assert counts == {"reduce_scatter": 1, "pmin": 2, "psum": 1,
                       "all_gather": 1}
+
+
+def test_lateness_histogram_family_observes_per_process():
+    """ISSUE 12 satellite: every lateness observation the EWMA ingests
+    also lands in hvd_tail_lateness_seconds{process} — the EWMA alone
+    cannot distinguish a chronic 100 ms host from a rare 2 s one; the
+    fixed-edge histogram merges bucket-wise in /metrics/job."""
+    from horovod_tpu import metrics as _metrics
+    from horovod_tpu.stall import _m_lateness
+    if not _metrics.ACTIVE:
+        pytest.skip("metrics disabled")
+    si = StallInspector(check_time=1e9, use_native=False)
+    before = _m_lateness.child(process="91")
+    n0 = before.count if before is not None else 0
+    s0 = before.sum if before is not None else 0.0
+    si.note_lateness(91, 0.1)
+    si.note_lateness(91, 2.0)
+    si.note_lateness(91, 0.0)   # on-time rounds observe too (the decay)
+    child = _m_lateness.child(process="91")
+    assert child.count == n0 + 3
+    assert child.sum == pytest.approx(s0 + 2.1)
+    # fixed log2 edges, so per-worker series merge bucket-wise: the
+    # 2.0 s observation sits in a strictly higher bucket than 0.1 s
+    import bisect
+    assert (bisect.bisect_left(_m_lateness.edges, 2.0)
+            > bisect.bisect_left(_m_lateness.edges, 0.1))
+    text = _metrics.render_prometheus()
+    assert 'hvd_tail_lateness_seconds_count{process="91"}' in text

@@ -69,3 +69,71 @@ def test_timeline_events_after_close_are_dropped_silently(tmp_path):
     tl.end("x")
     ev = _read_events(p)
     assert any(e.get("name") == "NEGOTIATE_BROADCAST" for e in ev)
+
+
+def test_timeline_reopen_resets_tensor_tids(tmp_path):
+    """ISSUE 12 satellite: the tid table is per-incarnation.  Carrying
+    it across a reopen (elastic re-form) would emit events on lanes the
+    new file never names — and grow the map across every incarnation of
+    a long-lived job."""
+    p1, p2 = tmp_path / "t1.json", tmp_path / "t2.json"
+    tl = Timeline(str(p1), use_native=False)
+    tl.negotiate_start("x", "allreduce")
+    tl.negotiate_start("y", "allreduce")
+    assert tl._tensor_tids == {"x": 1, "y": 2}
+    tl.close()
+    tl.reopen(str(p2))
+    assert tl._tensor_tids == {}   # fresh incarnation, fresh lanes
+    tl.negotiate_start("y", "allreduce")   # re-registers from tid 1
+    tl.close()
+    ev2 = _read_events(p2)
+    metas = [e for e in ev2 if e.get("ph") == "M"]
+    assert [(e["tid"], e["args"]["name"]) for e in metas] == [(1, "y")]
+    spans = [e for e in ev2 if e.get("name", "").startswith("NEGOTIATE_")]
+    assert spans and all(e["tid"] == 1 for e in spans)
+
+
+def test_timeline_tid_table_bounded_with_overflow_lane(tmp_path,
+                                                       monkeypatch):
+    import horovod_tpu.timeline as tl_mod
+    monkeypatch.setattr(tl_mod, "MAX_TENSOR_TIDS", 3)
+    p = tmp_path / "t.json"
+    tl = Timeline(str(p), use_native=False)
+    for i in range(6):
+        tl.negotiate_start(f"t{i}", "allreduce")
+    assert len(tl._tensor_tids) == 3   # bounded, never grows past cap
+    assert tl._tid("t5") == 0          # overflow names share lane 0
+    assert tl._tid("t0") == 1          # registered names keep theirs
+    tl.close()
+    ev = _read_events(p)
+    metas = [(e["tid"], e["args"]["name"]) for e in ev
+             if e.get("ph") == "M"]
+    # one overflow lane name, emitted exactly once
+    assert metas.count((0, "overflow")) == 1
+    assert len(metas) == 4   # 3 registered + 1 overflow
+
+
+def test_timeline_activity_events_carry_bucket_args(tmp_path):
+    """ISSUE 12 satellite: XLA_<OP>/dispatch events learn the PR 8-11
+    vocabulary — the negotiated wire format, tail policy, and dispatch
+    phase ride the event args."""
+    p = tmp_path / "t.json"
+    tl = Timeline(str(p), use_native=False)
+    tl.negotiate_start("g", "allreduce")
+    tl.negotiate_end("g")
+    tl.activity_start(["g"], "MEMCPY_IN_FUSION_BUFFER")
+    tl.activity_transition(["g"], "XLA_ALLREDUCE",
+                           args={"wire_format": "int8",
+                                 "tail_policy": "bounded",
+                                 "phase": "boundary"})
+    tl.activity_end(["g"])
+    tl.close()
+    ev = _read_events(p)
+    (xla,) = [e for e in ev if e.get("name") == "XLA_ALLREDUCE"]
+    assert xla["args"] == {"wire_format": "int8",
+                           "tail_policy": "bounded",
+                           "phase": "boundary"}
+    # args are optional: the MEMCPY open event has none
+    (mem,) = [e for e in ev
+              if e.get("name") == "MEMCPY_IN_FUSION_BUFFER"]
+    assert "args" not in mem

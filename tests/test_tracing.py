@@ -1,0 +1,701 @@
+"""Job-wide distributed tracing (ISSUE 12): span buffers, RPC
+clock-offset estimation, the merged Chrome trace, and critical-path
+attribution.
+
+The end-to-end pin is the acceptance shape of the issue: under the
+pinned ``collective.dcn group=1 every=3 action=delay:<d>`` chaos seed,
+a simulated 4-host job's merged ``/trace/job`` output is schema-valid
+Perfetto JSON, spans from hosts with injected clock skew align within
+the recorded offset-error bound, and ``tools/hvdtrace`` names the
+injected straggler as the top critical-path contributor with a gating
+fraction consistent with the injected delay — cross-checked against
+the stall inspector's straggler EWMA.
+"""
+
+import json
+import time
+
+import pytest
+
+import horovod_tpu.chaos as chaos
+import horovod_tpu.tracing as tracing
+from horovod_tpu.ops.collectives import plan_tail_round, tail_round
+from horovod_tpu.runner.rpc import JsonRpcServer
+from horovod_tpu.stall import StallInspector
+from horovod_tpu.tracing import critical, merge
+from horovod_tpu.tracing.span import SpanBuffer
+
+
+# ---------------------------------------------------------------------------
+# SpanBuffer
+# ---------------------------------------------------------------------------
+
+def test_buffer_ring_bound_and_drop_count():
+    buf = SpanBuffer(capacity=4, host="h", process=0)
+    for i in range(7):
+        buf.add("dispatch", f"s{i}", float(i), i + 0.5)
+    snap = buf.snapshot()
+    assert len(snap["spans"]) == 4
+    assert snap["dropped"] == 3
+    assert [s["name"] for s in snap["spans"]] == ["s3", "s4", "s5", "s6"]
+
+
+def test_buffer_context_and_identity_tags():
+    buf = SpanBuffer(capacity=8, host="h9", process=3)
+    buf.set_identity(epoch=5)
+    buf.set_context(round=17, cycle=4)
+    buf.add("negotiate", "round17", 1.0, 2.0, kind="fast")
+    buf.add("overlap", "stage", 1.0, 1.1, round=-1)  # explicit override
+    s1, s2 = buf.snapshot()["spans"]
+    assert (s1["round"], s1["epoch"], s1["cycle"]) == (17, 5, 4)
+    assert s1["args"] == {"kind": "fast"}
+    assert s2["round"] == -1
+    snap = buf.snapshot()
+    assert snap["host"] == "h9" and snap["process"] == 3
+
+
+def test_buffer_set_capacity_keeps_newest():
+    buf = SpanBuffer(capacity=8)
+    for i in range(6):
+        buf.add("cycle", f"c{i}", float(i), i + 1.0)
+    buf.set_capacity(2)
+    assert [s["name"] for s in buf.snapshot()["spans"]] == ["c4", "c5"]
+    buf.set_capacity(16)
+    buf.add("cycle", "c6", 9.0, 10.0)
+    assert len(buf) == 3
+
+
+def test_pull_handler_probe_vs_full():
+    buf = SpanBuffer(capacity=8, host="hp", process=2,
+                     clock=lambda: 123.25)
+    buf.add("dcn", "grad", 1.0, 2.0, policy="bounded")
+    handle = buf.pull_handler()
+    probe = handle({"probe": True})
+    assert probe == {"now": 123.25, "host": "hp", "process": 2}
+    full = handle({})
+    assert full["now"] == 123.25 and len(full["spans"]) == 1
+
+
+def test_init_from_env_flag_and_capacity(monkeypatch):
+    env = {"HOROVOD_TRACE": "0", "HOROVOD_TRACE_BUFFER": "7"}
+    old_cap = tracing.buffer().capacity
+    try:
+        tracing.init_from_env(env)
+        assert not tracing.ACTIVE
+        assert tracing.buffer().capacity == 7
+    finally:
+        tracing.init_from_env({"HOROVOD_TRACE_BUFFER": str(old_cap)})
+        assert tracing.ACTIVE
+
+
+# ---------------------------------------------------------------------------
+# clock-offset estimation (midpoint method, RTT-bounded error)
+# ---------------------------------------------------------------------------
+
+def _skewed_server(skew_s: float, pre_sleep: float = 0.0,
+                   post_sleep: float = 0.0):
+    """A trace_pull endpoint whose clock runs ``skew_s`` ahead of this
+    process, with optional asymmetric handler delays (``pre_sleep``
+    before the clock sample = slow request leg, ``post_sleep`` after =
+    slow response leg)."""
+    buf = SpanBuffer(host=f"skew{skew_s}",
+                     clock=lambda: time.monotonic() + skew_s)
+
+    def handler(payload):
+        if pre_sleep:
+            time.sleep(pre_sleep)
+        reply = buf.pull_handler()(payload)
+        if post_sleep:
+            time.sleep(post_sleep)
+        return reply
+
+    srv = JsonRpcServer({"trace_pull": handler}, secret=None)
+    return buf, srv
+
+
+@pytest.mark.parametrize("skew", [4.5, -2.25])
+def test_offset_estimation_recovers_skew(skew):
+    _buf, srv = _skewed_server(skew)
+    try:
+        offset, err = merge.estimate_offset("127.0.0.1", srv.port,
+                                            probes=3, secret=None)
+    finally:
+        srv.close()
+    # the true offset IS the injected skew (both clocks are monotonic
+    # + constant); the midpoint estimate must land within its own
+    # recorded error bound
+    assert abs(offset - skew) <= err + 1e-9
+    assert err < 0.5   # loopback probes: a tight bound, not a guess
+
+
+@pytest.mark.parametrize("pre,post", [(0.05, 0.0), (0.0, 0.05)])
+def test_offset_error_bound_holds_under_asymmetric_rtt(pre, post):
+    """Midpoint estimation is biased by asymmetric legs — but the bias
+    can never exceed RTT/2, which is exactly the recorded bound."""
+    _buf, srv = _skewed_server(3.0, pre_sleep=pre, post_sleep=post)
+    try:
+        offset, err = merge.estimate_offset("127.0.0.1", srv.port,
+                                            probes=2, secret=None)
+    finally:
+        srv.close()
+    assert err >= (pre + post) / 2  # the sleep is inside the bracket
+    assert abs(offset - 3.0) <= err + 1e-9
+
+
+# ---------------------------------------------------------------------------
+# merged Chrome trace
+# ---------------------------------------------------------------------------
+
+def _snap(host, process, spans, now=100.0):
+    return {"host": host, "process": process, "epoch": 0, "dropped": 0,
+            "capacity": 64, "now": now,
+            "spans": [dict(s, seq=i + 1) for i, s in enumerate(spans)]}
+
+
+def _span(cat, name, t0, t1, round=0, epoch=0, **args):
+    return {"cat": cat, "name": name, "t0": t0, "t1": t1,
+            "round": round, "epoch": epoch, "cycle": round, "args": args}
+
+
+def test_chrome_trace_one_pid_per_host_and_alignment():
+    # worker 0 on hostA with zero offset; workers 1+2 share hostB whose
+    # clock runs +10s (both spans happened at the same true time)
+    wa = _snap("hostA", 0, [_span("dispatch", "g", 50.0, 50.01)])
+    wb = _snap("hostB", 1, [_span("dispatch", "g", 60.0, 60.01)])
+    wc = _snap("hostB", 2, [_span("dcn", "g", 60.01, 60.02)])
+    trace = merge.chrome_trace({"0": (wa, 0.0, 0.001),
+                                "1": (wb, 10.0, 0.002),
+                                "2": (wc, 10.0, 0.002)})
+    json.dumps(trace)   # schema-valid JSON, round-trippable
+    evs = trace["traceEvents"]
+    pids = {e["args"]["name"]: e["pid"] for e in evs
+            if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert set(pids) == {"hostA", "hostB"}
+    spans = [e for e in evs if e.get("ph") == "X"]
+    by_host = {e["args"]["host"]: e for e in spans
+               if e["cat"] == "dispatch"}
+    # same true time -> same merged ts within the recorded error bounds
+    assert abs(by_host["hostA"]["ts"] - by_host["hostB"]["ts"]) <= (
+        0.001 + 0.002) * 1e6
+    # pid follows the host, not the worker
+    assert by_host["hostB"]["pid"] == pids["hostB"]
+    assert all(e["args"]["clock_err_us"] > 0 for e in spans)
+    # distinct (process, cat) lanes got distinct tids on one pid
+    tids_b = {e["tid"] for e in spans if e["args"]["host"] == "hostB"}
+    assert len(tids_b) == 2
+
+
+def test_scrape_job_trace_tolerates_unreachable_worker():
+    buf = SpanBuffer(host="live", process=0)
+    buf.add("negotiate", "round0", 1.0, 1.5, round=0)
+    srv = JsonRpcServer({"trace_pull": buf.pull_handler()}, secret=None)
+    import socket
+    with socket.socket() as s:   # a port nothing listens on
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    try:
+        trace = merge.scrape_job_trace(
+            {"0": ("127.0.0.1", srv.port),
+             "1": ("127.0.0.1", dead_port)},
+            timeout=0.5, probes=1, secret=None)
+    finally:
+        srv.close()
+    assert trace["otherData"]["hosts"] == ["live"]
+    assert "1" in trace["otherData"]["unreachable"]
+    assert any(e.get("cat") == "negotiate"
+               for e in trace["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# critical-path attribution
+# ---------------------------------------------------------------------------
+
+def _mk_trace(spans_by_worker):
+    workers = {}
+    for i, (host, spans) in enumerate(spans_by_worker.items()):
+        workers[str(i)] = (_snap(host, i, spans), 0.0, 0.0005)
+    return merge.chrome_trace(workers)
+
+
+def test_critical_path_attributes_gating_host_and_phase():
+    # two rounds; hostB's dispatch gates round 0 by 0.1s, hostA's dcn
+    # gates round 1 by 0.2s
+    spans = {
+        "hostA": [
+            _span("submit", "c0", 0.0, 0.01, round=0),
+            _span("dispatch", "g", 0.01, 0.02, round=0),
+            _span("dcn", "g", 0.02, 0.03, round=0),
+            _span("submit", "c1", 1.0, 1.01, round=1),
+            _span("dispatch", "g", 1.01, 1.02, round=1),
+            _span("dcn", "g", 1.02, 1.23, round=1),
+        ],
+        "hostB": [
+            _span("submit", "c0", 0.0, 0.01, round=0),
+            _span("dispatch", "g", 0.01, 0.12, round=0),
+            _span("dcn", "g", 0.12, 0.125, round=0),
+            _span("submit", "c1", 1.0, 1.01, round=1),
+            _span("dispatch", "g", 1.01, 1.02, round=1),
+            _span("dcn", "g", 1.02, 1.03, round=1),
+        ],
+    }
+    report = critical.analyze(_mk_trace(spans))
+    assert report["rounds"] == 2
+    hosts = report["hosts"]
+    # round 0: B gates dispatch (0.11s beyond submit mark); round 1: A
+    # gates dcn (0.21s); fractions sum to ~1 over attributed time
+    assert hosts["hostB"]["phases"]["dispatch"] == pytest.approx(
+        0.11, abs=1e-6)
+    assert hosts["hostA"]["phases"]["dcn"] == pytest.approx(
+        0.21, abs=1e-6)
+    assert sum(h["fraction"] for h in hosts.values()) == pytest.approx(
+        1.0, abs=1e-6)
+    assert report["top"][0] == "hostA"
+    assert report["max_clock_err_s"] == pytest.approx(0.0005)
+
+
+def test_critical_path_ignores_traceless_and_negative_rounds():
+    spans = {"hostA": [
+        _span("overlap", "stage", 0.0, 0.5, round=-1),
+        _span("cycle", "cycle1", 0.0, 0.5, round=3),   # envelope cat
+    ]}
+    report = critical.analyze(_mk_trace(spans))
+    assert report["rounds"] == 0 and report["top"] is None
+    assert "no round spans" in critical.render_table(report)
+
+
+def test_rounds_grouped_per_epoch():
+    spans = {"hostA": [
+        _span("dispatch", "g", 0.0, 0.1, round=1, epoch=0),
+        _span("dispatch", "g", 5.0, 5.1, round=1, epoch=1),
+    ]}
+    report = critical.analyze(_mk_trace(spans))
+    assert report["rounds"] == 2   # same round id, different epochs
+
+
+# ---------------------------------------------------------------------------
+# instrumentation: the real tail_round records the dcn span
+# ---------------------------------------------------------------------------
+
+def test_tail_round_records_dcn_span_with_exclusions():
+    buf = SpanBuffer(host="unit", process=0)
+    buf.set_context(round=7)
+    old = tracing.swap_buffer(buf)
+    insp = StallInspector(check_time=1e9, use_native=False)
+    chaos.install(chaos.FaultSchedule.parse(
+        "collective.dcn group=1 nth=1 action=delay:0.2", seed=3))
+    try:
+        present = tail_round("unit_bucket", "bounded", 2, 0.05,
+                             stall=insp)
+    finally:
+        chaos.uninstall()
+        tracing.swap_buffer(old)
+    assert list(present) == [1.0, 0.0]
+    (span,) = buf.snapshot()["spans"]
+    assert span["cat"] == "dcn" and span["round"] == 7
+    assert span["args"]["policy"] == "bounded"
+    assert span["args"]["excluded"] == [1]
+    assert span["args"]["deadline_s"] == pytest.approx(0.05)
+    assert span["args"]["lateness"][1] == pytest.approx(0.2)
+    # the round waited out the deadline, not the straggler
+    assert 0.04 <= span["t1"] - span["t0"] <= 0.15
+
+
+# ---------------------------------------------------------------------------
+# chaos-seeded end-to-end: 4 hosts, pinned seed, merged trace, verdict
+# ---------------------------------------------------------------------------
+
+def simulate_chaos_job(delay_s, rounds=9, n_hosts=4,
+                       skews=(0.0, 7.0, -3.5, 11.25),
+                       seed_text=None):
+    """Replay a 4-host job under the pinned ``collective.dcn`` seed.
+
+    The per-round arrival pattern comes from the REAL chaos site
+    through ``plan_tail_round`` (strict policy: every host waits the
+    straggler out — the regime where the injected host gates the
+    round); each host's span stream is then laid out on its own
+    skewed clock exactly as the engine instrumentation would emit it:
+    the delayed group's dispatch ends late, everyone's DCN round ends
+    when the slowest contribution lands.  Returns
+    ``(buffers, inspector, injected_total_s, base_round_s)``.
+    """
+    seed_text = seed_text or (
+        f"collective.dcn group=1 every=3 action=delay:{delay_s}")
+    insp = StallInspector(check_time=1e9, use_native=False)
+    sched = chaos.FaultSchedule.parse(seed_text, seed=11)
+    chaos.install(sched)
+    pattern = []
+    try:
+        for _r in range(rounds):
+            _present, wait_s, lateness = plan_tail_round(
+                "e2e", "strict", n_hosts, 0.25, stall=insp)
+            pattern.append((list(lateness), wait_s))
+    finally:
+        chaos.uninstall()
+    assert sched.fired_at("collective.dcn"), "chaos seed was inert"
+
+    t_base = time.monotonic()
+    gap = 0.05
+    buffers = []
+    for h in range(n_hosts):
+        sk = skews[h % len(skews)]
+        buf = SpanBuffer(host=f"host{h}", process=h,
+                         clock=(lambda s=sk: time.monotonic() + s))
+        buf.set_identity(epoch=0)
+        for r, (lateness, wait_s) in enumerate(pattern):
+            tb = t_base + r * gap
+            buf.set_context(round=r, cycle=r)
+            disp_end = tb + 0.004 + lateness[h]
+            dcn_end = tb + 0.004 + wait_s + 0.001
+            buf.add("submit", f"cycle{r + 1}", tb + sk, tb + 0.001 + sk,
+                    entries=1)
+            buf.add("negotiate", f"round{r}", tb + 0.001 + sk,
+                    tb + 0.002 + sk, kind="full", tokens=1)
+            buf.add("fuse", "plan[1]", tb + 0.002 + sk,
+                    tb + 0.0025 + sk, buckets=1, cached=r > 0)
+            buf.add("dispatch", "grad", tb + 0.0025 + sk, disp_end + sk,
+                    op="allreduce", tensors=1, bytes=4096,
+                    wire_format="none", tail_policy="strict")
+            buf.add("dcn", "grad", disp_end + sk, dcn_end + sk,
+                    policy="strict", deadline_s=0.25,
+                    wait_s=round(wait_s, 6), excluded=[],
+                    lateness=[round(v, 6) for v in lateness])
+        buffers.append(buf)
+    injected = sum(max(lat) for lat, _w in pattern)
+    return buffers, insp, injected, gap
+
+
+def _serve_and_scrape(buffers, probes=2):
+    servers = [JsonRpcServer({"trace_pull": b.pull_handler()},
+                             secret=None) for b in buffers]
+    try:
+        endpoints = {str(i): ("127.0.0.1", s.port)
+                     for i, s in enumerate(servers)}
+        return merge.scrape_job_trace(endpoints, probes=probes,
+                                      secret=None)
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_e2e_chaos_seed_merged_trace_and_critical_path_verdict():
+    delay = 0.12
+    buffers, insp, injected, _gap = simulate_chaos_job(delay, rounds=9)
+    trace = _serve_and_scrape(buffers)
+    json.loads(json.dumps(trace))   # schema-valid Perfetto JSON
+
+    # one pid per host, all four present
+    pids = {e["args"]["name"] for e in trace["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert pids == {f"host{h}" for h in range(4)}
+
+    # cross-host alignment within the recorded error bounds: the
+    # per-round submit spans happened at identical true times on every
+    # host despite ±11s clock skew
+    spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    clock = trace["otherData"]["clock"]
+    by_round = {}
+    for e in spans:
+        if e["cat"] == "submit":
+            by_round.setdefault(e["args"]["round"], []).append(e)
+    assert len(by_round) == 9
+    for _r, evs in by_round.items():
+        assert len(evs) == 4
+        for a in evs:
+            for b in evs:
+                bound = (clock[str(a["args"]["process"])]["err_s"]
+                         + clock[str(b["args"]["process"])]["err_s"])
+                assert abs(a["ts"] - b["ts"]) <= bound * 1e6 + 1.0, (
+                    a, b, bound)
+
+    # the injected straggler (group=1 -> host1) is the critical-path
+    # verdict, with gating time consistent (±20%) with the injected
+    # delay total — the evidence form of bench_tail's p99 delta
+    report = critical.analyze(trace)
+    assert report["rounds"] == 9
+    assert report["top"][0] == "host1", report["top"]
+    gating = report["hosts"]["host1"]["gating_s"]
+    assert abs(gating - injected) <= 0.2 * injected, (gating, injected)
+    assert report["hosts"]["host1"]["fraction"] > 0.5
+    # ... and it cross-checks the stall inspector's straggler EWMA:
+    # the same rounds fed the same verdict through the other pipeline
+    scores = insp.straggler_scores()
+    assert max(scores, key=scores.get) == 1
+    assert scores[1] > 0.0
+
+
+def test_e2e_trace_job_get_route_shape():
+    """The driver-shaped GET /trace/job route (same wiring as
+    ElasticDriver's get_route) serves the merged JSON over HTTP."""
+    buffers, _insp, _inj, _gap = simulate_chaos_job(0.05, rounds=3,
+                                                    n_hosts=2,
+                                                    skews=(0.0, 2.0))
+    workers = [JsonRpcServer({"trace_pull": b.pull_handler()},
+                             secret=None) for b in buffers]
+    endpoints = {str(i): ("127.0.0.1", s.port)
+                 for i, s in enumerate(workers)}
+
+    def route():
+        trace = merge.scrape_job_trace(endpoints, probes=1, secret=None)
+        return (200, "application/json", json.dumps(trace))
+
+    driver = JsonRpcServer({}, secret=None,
+                           get_routes={"trace/job": route})
+    try:
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{driver.port}/trace/job",
+                timeout=10.0) as resp:
+            trace = json.loads(resp.read().decode())
+    finally:
+        driver.close()
+        for s in workers:
+            s.close()
+    assert len(trace["otherData"]["hosts"]) == 2
+    assert critical.analyze(trace)["rounds"] == 3
+
+
+# ---------------------------------------------------------------------------
+# hvdtrace CLI + recorded fixture
+# ---------------------------------------------------------------------------
+
+def test_hvdtrace_cli_table_and_json(tmp_path, capsys):
+    buffers, _insp, _inj, _gap = simulate_chaos_job(0.08, rounds=6)
+    trace = _serve_and_scrape(buffers, probes=1)
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(trace))
+    from horovod_tpu.tracing.__main__ import main
+    assert main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "critical-path host: host1" in out
+    assert main(["--json", str(path)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["top"][0] == "host1"
+
+
+def test_recorded_fixture_smoke():
+    """CI stage 10 runs ``tools/hvdtrace --smoke`` over this committed
+    fixture; keep the in-repo copy analyzable and its recorded chaos
+    metadata honest."""
+    import os
+    from horovod_tpu.tracing.__main__ import SMOKE_FIXTURE, main
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, SMOKE_FIXTURE)
+    assert os.path.exists(path), f"fixture missing: {path}"
+    with open(path) as f:
+        trace = json.load(f)
+    chaos_meta = trace["otherData"]["chaos"]
+    assert "every=3" in chaos_meta["seed"]
+    assert "delay:0.8" in chaos_meta["seed"]
+    assert chaos_meta["injected_host"] == "host1"
+    report = critical.analyze(trace)
+    assert report["top"][0] == "host1"
+    assert main(["--smoke"]) == 0
+
+
+def test_local_trace_route_serves_buffer():
+    buf = SpanBuffer(host="solo", process=0)
+    buf.add("cycle", "cycle1", 0.0, 0.1, round=1)
+    old = tracing.swap_buffer(buf)
+    try:
+        srv = JsonRpcServer({}, secret=None)
+        from horovod_tpu.metrics import aggregate
+        raw = aggregate.scrape("127.0.0.1", srv.port, route="trace")
+        srv.close()
+    finally:
+        tracing.swap_buffer(old)
+    trace = json.loads(raw)
+    assert trace["otherData"]["hosts"] == ["solo"]
+    assert any(e.get("cat") == "cycle" for e in trace["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# live engine integration: a real cycle records the span pipeline
+# ---------------------------------------------------------------------------
+
+def test_engine_cycle_records_phase_spans(hvd):
+    import numpy as np
+    buf = SpanBuffer(host="live-engine", process=0)
+    buf.set_identity(epoch=0)
+    old = tracing.swap_buffer(buf)
+    try:
+        out = hvd.allreduce(np.ones((4,), np.float32), op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.full((4,), float(hvd.size())))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            cats = {s["cat"] for s in buf.snapshot()["spans"]}
+            if {"submit", "fuse", "dispatch", "cycle"} <= cats:
+                break
+            time.sleep(0.02)
+    finally:
+        tracing.swap_buffer(old)
+    spans = buf.snapshot()["spans"]
+    cats = {s["cat"] for s in spans}
+    assert {"submit", "fuse", "dispatch", "cycle"} <= cats, cats
+    # every phase span of the cycle shares ONE round id (single-process:
+    # the cycle count stands in for the controller round), and the
+    # dispatch span carries the negotiated bucket vocabulary
+    one_cycle = [s for s in spans if s["cat"] in ("submit", "fuse",
+                                                  "dispatch")]
+    assert len({s["round"] for s in one_cycle}) == 1
+    (disp,) = [s for s in one_cycle if s["cat"] == "dispatch"]
+    assert disp["args"]["op"] == "allreduce"
+    assert disp["args"]["wire_format"] == "none"
+    assert disp["args"]["tail_policy"] == "strict"
+    assert disp["args"]["bytes"] == 16
+
+
+def test_elastic_driver_trace_job_route_end_to_end():
+    """The REAL ElasticDriver serves GET /trace/job: registered worker
+    notification endpoints are scraped (HMAC-signed trace_pull over the
+    keep-alive pool) and merged into one trace."""
+    import urllib.request
+
+    from _helpers import free_port
+    from horovod_tpu.elastic.discovery import HostDiscovery
+    from horovod_tpu.elastic.driver import ElasticDriver
+
+    class StubDiscovery(HostDiscovery):
+        def find_available_hosts_and_slots(self):
+            return {}
+
+    driver = ElasticDriver(StubDiscovery(), ["true"], min_np=1,
+                           port=free_port())
+    buffers, _insp, _inj, _gap = simulate_chaos_job(
+        0.05, rounds=3, n_hosts=2, skews=(0.0, 4.0))
+    # workers' servers verify the job secret the driver minted — the
+    # same signed path a live job's trace_pull rides
+    workers = [JsonRpcServer({"trace_pull": b.pull_handler()})
+               for b in buffers]
+    try:
+        with driver._lock:
+            for i, s in enumerate(workers):
+                driver._notif[i] = ("127.0.0.1", s.port)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{driver.port}/trace/job",
+                timeout=30.0) as resp:
+            trace = json.loads(resp.read().decode())
+    finally:
+        driver._server.close()
+        if driver._kv_server is not None:
+            driver._kv_server.close()
+        for s in workers:
+            s.close()
+    assert sorted(trace["otherData"]["hosts"]) == ["host0", "host1"]
+    assert not trace["otherData"].get("unreachable")
+    report = critical.analyze(trace)
+    assert report["rounds"] == 3
+
+
+def test_rounds_disambiguated_by_negotiation_group():
+    """Round ids are per-GROUP sequence counters: a subset process
+    set's round 1 must never merge with the global group's round 1
+    (code-review finding on the multi-group correlation key)."""
+    spans = {"hostA": [
+        _span("dispatch", "g", 0.0, 0.1, round=1),
+        _span("dispatch", "s", 5.0, 5.1, round=1),
+    ]}
+    spans["hostA"][0]["group"] = "g_global"
+    spans["hostA"][1]["group"] = "g_subset"
+    report = critical.analyze(_mk_trace(spans))
+    assert report["rounds"] == 2   # same seq, different groups
+
+
+def test_buffer_bad_capacity_degrades_to_default():
+    """A malformed HOROVOD_TRACE_BUFFER (0/negative) must never crash
+    `import horovod_tpu` (module-level buffer construction) — it
+    degrades to the default capacity."""
+    from horovod_tpu.tracing.span import DEFAULT_CAPACITY
+    assert SpanBuffer(capacity=-1).capacity == DEFAULT_CAPACITY
+    assert SpanBuffer(capacity=0).capacity == DEFAULT_CAPACITY
+    buf = SpanBuffer(capacity=4)
+    buf.set_capacity(-5)
+    assert buf.capacity == DEFAULT_CAPACITY
+    old_cap = tracing.buffer().capacity
+    try:
+        tracing.init_from_env({"HOROVOD_TRACE_BUFFER": "-3"})
+        assert tracing.buffer().capacity == DEFAULT_CAPACITY
+    finally:
+        tracing.init_from_env({"HOROVOD_TRACE_BUFFER": str(old_cap)})
+
+
+def test_controller_enabled_local_only_cycle_stays_off_round_path(hvd):
+    """Code-review pin: with a controller ENABLED, per-worker cycle
+    counts drift (paced empty-agreement cycles), so a cycle that never
+    negotiates (local-only entries) must tag its spans round=-1 —
+    never the cycle count, which would alias unrelated cycles across
+    workers in the merged trace."""
+    import types
+
+    import numpy as np
+
+    from horovod_tpu.ops.engine import CollectiveEngine, TensorTableEntry
+
+    class _Ctl:
+        enabled = True
+        joined = False
+
+    cfg = hvd.runtime._state().config
+    eng = CollectiveEngine(cfg, mesh=None, controller=_Ctl())
+    one_proc = types.SimpleNamespace(
+        mesh=types.SimpleNamespace(devices=np.array(
+            [types.SimpleNamespace(process_index=0)])),
+        process_set_id=0, axis="w", size=lambda: 1)
+    buf = SpanBuffer(host="offpath", process=0)
+    old = tracing.swap_buffer(buf)
+    try:
+        entry = TensorTableEntry("b", "barrier",
+                                 [np.zeros((1,), np.float32)], one_proc)
+        eng.submit(entry)
+        eng.run_cycle_once()
+        entry.handle.synchronize()
+    finally:
+        tracing.swap_buffer(old)
+    spans = buf.snapshot()["spans"]
+    disp = [s for s in spans if s["cat"] in ("submit", "dispatch",
+                                             "fuse")]
+    assert disp, spans
+    assert all(s["round"] == -1 for s in disp), disp
+
+
+def test_negotiated_round_and_group_tag_cycle_spans(hvd):
+    """The negotiated (group, round) from the controller result is the
+    context every later span of the cycle carries."""
+    import types
+
+    import numpy as np
+
+    from horovod_tpu.ops.controller import NegotiationResult
+    from horovod_tpu.ops.engine import CollectiveEngine, TensorTableEntry
+
+    class _Ctl:
+        enabled = True
+        joined = False
+
+        def negotiate(self, tokens, procs, params=None, aux=None):
+            from collections import Counter
+            return NegotiationResult(counts=Counter(tokens), seq=5,
+                                     group="gX")
+
+    cfg = hvd.runtime._state().config
+    eng = CollectiveEngine(cfg, mesh=None, controller=_Ctl())
+    two_proc = types.SimpleNamespace(
+        mesh=types.SimpleNamespace(devices=np.array(
+            [types.SimpleNamespace(process_index=0),
+             types.SimpleNamespace(process_index=1)])),
+        process_set_id=0, axis="w", size=lambda: 2)
+    buf = SpanBuffer(host="negpath", process=0)
+    old = tracing.swap_buffer(buf)
+    try:
+        entry = TensorTableEntry("b", "barrier",
+                                 [np.zeros((1,), np.float32)], two_proc)
+        eng.submit(entry)
+        eng.run_cycle_once()
+        entry.handle.synchronize()
+    finally:
+        tracing.swap_buffer(old)
+    disp = [s for s in buf.snapshot()["spans"]
+            if s["cat"] in ("submit", "dispatch", "fuse")]
+    assert disp
+    assert all(s["round"] == 5 and s["group"] == "gX" for s in disp), \
+        disp

@@ -38,7 +38,10 @@
 #      bit-identical incl. sharded x int8) — and tools/bench_tail.py
 #      --smoke — tail-tolerant-collective invariants (chaos-seeded
 #      p99 bound, strict/bounded one-program bit-exactness,
-#      convergence gate, byte conservation)
+#      convergence gate, byte conservation) — and tools/hvdtrace
+#      --smoke — merged-trace critical-path attribution over the
+#      recorded chaos-seeded 4-host fixture (the injected straggler
+#      must be the verdict)
 #  11. hvdsched: re-trace the builtin step entries to jaxprs on CPU and
 #      diff their collective schedules against tests/schedules/
 #      (HVD211 drift; incl. the sharded_distopt_step reduce_scatter →
@@ -183,6 +186,47 @@ finally:
 assert list(present) == [1.0, 0.0], present
 assert insp.straggler_scores()[1] > 0, insp.straggler_scores()
 
+# job-wide distributed trace (ISSUE 12): the negotiation rounds above
+# recorded spans into the installed tracer; serve them plus a second
+# simulated host's buffer and scrape GET /trace/job (the driver-shaped
+# merged route) — the result must be valid Chrome-trace JSON with one
+# pid per host (>=2 distinct) and >=1 negotiation-round span per worker
+import horovod_tpu.tracing as htrace
+assert htrace.ACTIVE
+neg_local = [s for s in htrace.buffer().snapshot()["spans"]
+             if s["cat"] == "negotiate" and s["round"] >= 0]
+assert len(neg_local) >= 2, neg_local
+trbufB = htrace.SpanBuffer(host="cismoke-hostB", process=1)
+trbufB.set_context(round=0, epoch=0)
+_tB = trbufB.now()
+trbufB.add("negotiate", "round0", _tB - 0.01, _tB, kind="full")
+wsrvA = JsonRpcServer({"trace_pull": htrace.pull_handler}, secret=None)
+wsrvB = JsonRpcServer({"trace_pull": trbufB.pull_handler()}, secret=None)
+tr_endpoints = {"0": ("127.0.0.1", wsrvA.port),
+                "1": ("127.0.0.1", wsrvB.port)}
+def _trace_job_route():
+    tr = htrace.merge.scrape_job_trace(tr_endpoints, probes=2,
+                                       secret=None)
+    return (200, "application/json", json.dumps(tr))
+tsrv = JsonRpcServer({}, secret=None,
+                     get_routes={"trace/job": _trace_job_route})
+trace = json.loads(aggregate.scrape("127.0.0.1", tsrv.port,
+                                    route="trace/job"))
+host_pids = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+assert len(host_pids) >= 2, host_pids
+tr_rounds = {}
+for e in trace["traceEvents"]:
+    if (e.get("ph") == "X" and e.get("cat") == "negotiate"
+            and e["args"].get("round", -1) >= 0):
+        tr_rounds[e["args"]["process"]] = \
+            tr_rounds.get(e["args"]["process"], 0) + 1
+assert tr_rounds.get(0, 0) >= 1 and tr_rounds.get(1, 0) >= 1, tr_rounds
+from horovod_tpu.tracing import critical as htrace_critical
+htrace_critical.analyze(trace)   # analyzable, not just parseable
+for _s in (wsrvA, wsrvB, tsrv):
+    _s.close()
+
 fams = aggregate.parse_prometheus(aggregate.scrape("127.0.0.1", srv.port))
 def _family_count(fam, **want):
     return sum(v for _, lbl, v in fams[fam]["samples"]
@@ -202,9 +246,10 @@ assert straggler > 0, fams["hvd_straggler_score"]["samples"]
 srv.close()
 
 hvd.shutdown()
-print(f"dist smoke OK (incl. /metrics + /healthz scrape, "
+print(f"dist smoke OK (incl. /metrics + /healthz + /trace/job scrape, "
       f"{int(watch_rounds)} watch rounds, {int(reuse_hits)} keep-alive "
-      f"hits, {int(overlap_buckets)} overlap buckets), imported from",
+      f"hits, {int(overlap_buckets)} overlap buckets, "
+      f"{len(host_pids)} trace host pids), imported from",
       os.path.dirname(hvd.__file__))
 PYEOF
   )
@@ -289,6 +334,13 @@ tail -1 /tmp/ci_bench_overlap.log
 python tools/bench_tail.py --smoke > /tmp/ci_bench_tail.log 2>&1 \
   || { tail -30 /tmp/ci_bench_tail.log; exit 1; }
 tail -1 /tmp/ci_bench_tail.log
+# merged-trace critical path: replay the recorded chaos-seeded 4-host
+# fixture (collective.dcn group=1 every=3 delay:0.8) through
+# tools/hvdtrace — the injected straggler host must come out as the top
+# critical-path contributor (docs/observability.md "Distributed trace")
+bash tools/hvdtrace --smoke > /tmp/ci_hvdtrace.log 2>&1 \
+  || { tail -30 /tmp/ci_hvdtrace.log; exit 1; }
+tail -1 /tmp/ci_hvdtrace.log
 
 echo "== 11/11 hvdsched: collective-schedule snapshots + consistency =="
 # re-trace every builtin step entry to a jaxpr on CPU, diff against the
