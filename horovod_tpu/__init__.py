@@ -69,6 +69,9 @@ from . import elastic  # noqa: F401
 # deterministic fault injection (docs/env.md "Chaos engineering"); pure
 # stdlib, already loaded by the RPC layer's injection points
 from . import chaos  # noqa: F401
+# training-health telemetry (docs/observability.md "Training health"):
+# hvd.health.note_loss / on_unhealthy are the user hooks
+from . import health  # noqa: F401
 
 
 def __getattr__(name):

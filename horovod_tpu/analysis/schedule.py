@@ -490,6 +490,36 @@ def _entry_overlapped_distopt_step():
     return step, (spec, x)
 
 
+def _entry_health_distopt_step():
+    """The health-tapped step (HOROVOD_HEALTH_TAPS; ISSUE 13): the
+    per-bucket numerics taps are LOCAL reductions (no collectives of
+    their own), but the divergence sentinel adds one ``all_gather`` of
+    the per-bucket param/opt-state checksum vector under its cadence
+    ``cond`` — that gather, and nothing else, is the schedule delta vs
+    the plain ``distopt_step`` entry.  health pinned ON with
+    ``health_check_every=1`` (env-independent: an explicit ``health=``
+    wins over HOROVOD_HEALTH_TAPS, and the first step's count=1 takes
+    the sentinel branch), everything else pinned off."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from ..optim.distributed import DistributedOptimizer
+
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=_AXIS,
+                              threshold_bytes=_THRESHOLD,
+                              sharded_update=False, wire_format="none",
+                              health=True, health_check_every=1)
+    spec = _grads_spec()
+    params = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), spec)
+    state = tx.init(params)
+
+    def step(grads, params):
+        updates, _ = tx.update(grads, state, params)
+        return updates
+    return step, (spec, spec)
+
+
 #: fixed local (ICI) axis of the hierarchical tail entry: the
 #: consistency check varies the CROSS (DCN) axis — the one the tail
 #: policy rewrites — through ``_AXIS``.
@@ -541,6 +571,7 @@ BUILTIN_ENTRIES = {
     "quantized_distopt_step": _entry_quantized_distopt_step,
     "overlapped_distopt_step": _entry_overlapped_distopt_step,
     "tail_distopt_step": _entry_tail_distopt_step,
+    "health_distopt_step": _entry_health_distopt_step,
 }
 
 #: Mesh sizes the consistency check traces every entry at (HVD210).

@@ -49,6 +49,10 @@ Injection sites and the actions each caller honors are cataloged in
 * ``dup``/``stale``/``flap``/``drop-reply`` — returned to the injection
   point, which interprets them (duplicate send, stale KV read, empty
   discovery, server runs the handler then swallows the reply)
+* ``nan[:R]``/``scale[:R[,F]]`` — returned to the ``collective.corrupt``
+  site (``health/taps.py``): rank R's contribution to the matched
+  fusion bucket becomes NaN / is scaled by F — the deterministic
+  value-corruption the training-health evaluator is tested against
 """
 
 from __future__ import annotations

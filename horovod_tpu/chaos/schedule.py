@@ -48,9 +48,11 @@ _FIRING_KEYS = ("nth", "every", "times", "after", "prob")
 #: Every action kind fire() executes or an injection point interprets.
 #: Parse-time validation against this set keeps the fail-loud contract:
 #: a typo'd action must raise at install, not silently inject nothing.
+#: ``nan``/``scale`` belong to the ``collective.corrupt`` site (value
+#: corruption of a chosen bucket on a chosen rank — health/taps.py).
 KNOWN_ACTIONS = frozenset((
     "delay", "drop", "reset", "http500", "error", "crash",
-    "dup", "stale", "flap", "drop-reply",
+    "dup", "stale", "flap", "drop-reply", "nan", "scale",
 ))
 
 

@@ -154,6 +154,27 @@ class Config:
     # score (seconds) crosses this feeds the elastic blacklist as a SOFT
     # failure before it dies outright (0 disables)
     tail_blacklist_score: float = 0.0
+    # training-health telemetry master switch (docs/observability.md
+    # "Training health"): the evaluator, the eager engine's dispatch
+    # numerics taps, the health_pull RPC, and tap-compiled callbacks'
+    # host deliveries.  0 = one false branch at every site.
+    health: bool = True
+    # in-jit numerics taps + divergence sentinel default for
+    # DistributedGradientTransform(health=None).  A SCHEDULE property
+    # like sharded_update (the sentinel adds an allgather to the
+    # compiled step — pinned as the health_distopt_step hvdsched
+    # entry), so it is an explicit opt-in; `health` above vetoes.
+    health_taps: bool = False
+    # divergence-sentinel cadence: param/opt-state checksums are
+    # allgathered and compared across the axis every N-th step
+    health_check_every: int = 32
+    # verdict thresholds: grad-norm explosion fires past
+    # grad_factor x the bucket's own EWMA baseline; loss spike past
+    # loss_factor x the loss EWMA; residual drift past
+    # residual_factor x the gradient EWMA (all after a short warmup)
+    health_grad_factor: float = 10.0
+    health_loss_factor: float = 4.0
+    health_residual_factor: float = 4.0
 
     @staticmethod
     def from_env() -> "Config":
@@ -259,4 +280,28 @@ class Config:
             raise ValueError(
                 f"HOROVOD_TAIL_BLACKLIST_SCORE must be >= 0, got "
                 f"{c.tail_blacklist_score}")
+        c.health = _env_bool("HOROVOD_HEALTH", c.health)
+        c.health_taps = _env_bool("HOROVOD_HEALTH_TAPS", c.health_taps)
+        c.health_check_every = _env_int(
+            "HOROVOD_HEALTH_CHECK_EVERY", c.health_check_every)
+        if c.health_check_every < 1:
+            raise ValueError(
+                f"HOROVOD_HEALTH_CHECK_EVERY must be >= 1, got "
+                f"{c.health_check_every}")
+        c.health_grad_factor = _env_float(
+            "HOROVOD_HEALTH_GRAD_FACTOR", c.health_grad_factor)
+        c.health_loss_factor = _env_float(
+            "HOROVOD_HEALTH_LOSS_FACTOR", c.health_loss_factor)
+        c.health_residual_factor = _env_float(
+            "HOROVOD_HEALTH_RESIDUAL_FACTOR", c.health_residual_factor)
+        for _name, _v in (("HOROVOD_HEALTH_GRAD_FACTOR",
+                           c.health_grad_factor),
+                          ("HOROVOD_HEALTH_LOSS_FACTOR",
+                           c.health_loss_factor),
+                          ("HOROVOD_HEALTH_RESIDUAL_FACTOR",
+                           c.health_residual_factor)):
+            if _v <= 1.0:
+                raise ValueError(
+                    f"{_name} must be > 1 (a bar at or below the "
+                    f"baseline fires on every step), got {_v}")
         return c

@@ -221,6 +221,11 @@ class ElasticDriver:
             # host (docs/observability.md "Distributed trace";
             # tools/hvdtrace analyzes the critical path over it)
             "trace/job": self._trace_job_route,
+            # job health verdict: every worker's health_pull snapshot
+            # merged into ONE verdict with (worker, bucket, step)
+            # attribution (docs/observability.md "Training health";
+            # tools/hvddoctor prints the table)
+            "health/job": self._health_job_route,
         })
 
     def _metrics_job_route(self):
@@ -237,6 +242,14 @@ class ElasticDriver:
             endpoints, probes=_tracing.probes())
         return (200, "application/json",
                 json.dumps(trace, separators=(",", ":")))
+
+    def _health_job_route(self):
+        from .. import health as _health
+        with self._lock:
+            endpoints = {str(wid): ep for wid, ep in self._notif.items()}
+        job = _health.scrape_job_health(endpoints)
+        return (200, "application/json",
+                json.dumps(job, separators=(",", ":")))
 
     # --- lifecycle events --------------------------------------------------
 

@@ -220,13 +220,17 @@ class WorkerNotificationManager:
     """In-worker listener the driver pushes host updates to."""
 
     def __init__(self):
+        from .. import health as _health
         from .. import tracing as _tracing
         self._listeners = []
         # trace_pull: the driver's GET /trace/job scrapes this worker's
         # span buffer (and its clock-offset probes) over the same
-        # keep-alive RPC pool every other control-plane call rides
+        # keep-alive RPC pool every other control-plane call rides.
+        # health_pull: the same shape for the training-health verdicts
+        # (GET /health/job merges them into one job verdict)
         self._server = JsonRpcServer({"hosts_updated": self._on_update,
-                                      "trace_pull": _tracing.pull_handler})
+                                      "trace_pull": _tracing.pull_handler,
+                                      "health_pull": _health.pull_handler})
         self._registered = False
 
     def init(self):

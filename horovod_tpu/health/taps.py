@@ -1,0 +1,346 @@
+"""In-jit numerics taps: per-bucket gradient stats, the cross-replica
+divergence sentinel, and the ``collective.corrupt`` chaos site.
+
+The fused reduce paths (``optim/distributed.py``) already materialize
+one flat buffer per fusion bucket; the taps are a few extra reductions
+over exactly those buffers — l2 norm, max-abs, nonfinite count, and
+(when a quantized wire is active) the error-feedback residual norm —
+delivered to the host :class:`~.evaluate.HealthEvaluator` through
+``jax.debug.callback``.  Stats are taken on the **local, pre-reduction**
+buffer: after the psum every replica sees the same NaN, before it only
+the contributing worker does — which is what makes ``(worker, bucket)``
+attribution possible at all.
+
+The **divergence sentinel** checksums the param (or update) buckets and
+the optimizer state — one float sum plus one bit-pattern xor per bucket
+— and allgathers the checksum vector across the worker axis every
+``HOROVOD_HEALTH_CHECK_EVERY`` steps (a ``lax.cond`` on the step
+counter, so the off-cadence steps pay one predicate).  Replicas whose
+row disagrees are convicted by the evaluator with bucket attribution —
+the desync class that today only bench-time bit-exactness gates can
+see.
+
+``collective.corrupt`` (chaos site, docs/env.md grammar): deterministic
+NaN / scale-garbage injection into a chosen bucket on a chosen rank —
+``collective.corrupt bucket=1 nth=1 action=nan:2`` NaNs rank 2's
+contribution to bucket 1.  In-jit rules are evaluated at TRACE time and
+baked into the compiled step (every process traces the same program —
+the corruption is a ``where(axis_index == rank, ...)``, so SPMD
+consistency holds); predicates therefore count traces, not steps.  The
+injection is independent of the health plane: a corruption seed proves
+the evaluator catches what it injects, and ``fired``/the
+``hvd_chaos_injections_total`` counter prove the seed wasn't inert.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import chaos as _chaos
+
+
+# ---------------------------------------------------------------------------
+# per-buffer reductions
+# ---------------------------------------------------------------------------
+
+def bucket_stats(buf) -> Tuple:
+    """(l2, max_abs, nonfinite) of one flat bucket buffer — the three
+    reductions the numerics tap pays per bucket.  fp32 accumulation so
+    bf16 buckets don't overflow their own norm."""
+    f = buf.astype(jnp.float32)
+    finite = jnp.isfinite(f)
+    safe = jnp.where(finite, f, 0.0)
+    l2 = jnp.sqrt(jnp.sum(jnp.square(safe)))
+    max_abs = jnp.max(jnp.abs(safe)) if buf.size else jnp.float32(0.0)
+    nonfinite = jnp.sum(~finite).astype(jnp.int32)
+    return l2, max_abs, nonfinite
+
+
+def checksum_flat(buf) -> Tuple:
+    """(float sum, bit-pattern xor) of a flat buffer.
+
+    The sum is the cheap magnitude fingerprint; the xor is the exact
+    one — computed over the fp32-widened bit patterns (f32 identity,
+    bf16 exact widening), so ANY single-bit divergence between replicas
+    flips it.  Returns (f32 scalar, uint32 scalar).
+    """
+    f = buf.reshape(-1).astype(jnp.float32)
+    s = jnp.sum(f)
+    bits = jax.lax.bitcast_convert_type(f, jnp.uint32)
+    x = jax.lax.reduce(bits, np.uint32(0), jax.lax.bitwise_xor, (0,))
+    return s, x
+
+
+# ---------------------------------------------------------------------------
+# collective.corrupt: deterministic NaN / scale-garbage injection
+# ---------------------------------------------------------------------------
+
+def _corrupt_target(act) -> Tuple[int, float]:
+    """(rank, factor) of a fired corrupt action.  ``nan:R`` → rank R's
+    lanes become NaN; ``scale:R[,F]`` → rank R's lanes × F (default
+    1e6 — large enough that the explosion verdict fires against any
+    warm baseline).  Malformed args default to rank 0."""
+    arg = act.arg or ""
+    if act.kind == "nan":
+        try:
+            return int(arg or 0), float("nan")
+        except ValueError:
+            return 0, float("nan")
+    rank_s, _, fac_s = arg.partition(",")
+    try:
+        rank = int(rank_s or 0)
+    except ValueError:
+        rank = 0
+    try:
+        factor = float(fac_s) if fac_s else 1e6
+    except ValueError:
+        factor = 1e6
+    return rank, factor
+
+
+def chaos_corrupt(buf, axis_name: Optional[str], bucket: int, name: str):
+    """In-jit injection point: consult the ``collective.corrupt`` site
+    for this bucket at trace time and, when a rule fires, bake the
+    corruption of the chosen rank's contribution into the traced
+    program.  Callers guard on ``chaos.ACTIVE`` (one false branch)."""
+    act = _chaos.fire("collective.corrupt", bucket=bucket, name=name,
+                      _defer=("nan", "scale"))
+    if act is None or act.kind not in ("nan", "scale"):
+        return buf
+    if not jnp.issubdtype(buf.dtype, jnp.floating):
+        return buf   # integer lanes cannot carry NaN/garbage scales
+    rank, factor = _corrupt_target(act)
+    bad = buf * jnp.asarray(factor, buf.dtype)
+    if axis_name is None:
+        return bad
+    idx = jax.lax.axis_index(axis_name)
+    return jnp.where(idx == rank, bad, buf)
+
+
+def chaos_corrupt_eager(arrays: List, stacked: bool, bucket: int,
+                        name: str) -> List:
+    """Eager-engine injection point (one consult per fused bucket).
+    Stacked arrays (dim 0 = workers) corrupt row ``rank``; per-process
+    replicated/multi-process arrays corrupt this whole process's
+    contribution iff its ``jax.process_index()`` is the target."""
+    act = _chaos.fire("collective.corrupt", bucket=bucket, name=name,
+                      _defer=("nan", "scale"))
+    if act is None or act.kind not in ("nan", "scale"):
+        return arrays
+    rank, factor = _corrupt_target(act)
+    out = []
+    for a in arrays:
+        # numpy, not jnp: the engine's dtype-exact contract (64-bit
+        # tensors under a scoped x64 lift) must survive corruption —
+        # jnp.asarray outside that scope would silently downcast
+        x = np.asarray(a)
+        if not np.issubdtype(x.dtype, np.floating):
+            out.append(a)
+            continue
+        if stacked and x.ndim >= 1 and 0 <= rank < x.shape[0]:
+            x = x.copy()
+            x[rank] = x[rank] * x.dtype.type(factor)
+            out.append(x)
+        elif not stacked and jax.process_index() == rank:
+            out.append(x * x.dtype.type(factor))
+        else:
+            out.append(a)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# host deliveries (jax.debug.callback targets)
+# ---------------------------------------------------------------------------
+
+def _deliver_stats(names, step, replica, l2s, maxes, nonf, res):
+    from . import ACTIVE, evaluator
+    if not ACTIVE:
+        return   # HOROVOD_HEALTH=0 at runtime silences tap-compiled steps
+    ev = evaluator()
+    step_i, rep_i = int(step), int(replica)
+    l2s, maxes = np.asarray(l2s), np.asarray(maxes)
+    nonf, res = np.asarray(nonf), np.asarray(res)
+    for b, name in enumerate(names):
+        ev.ingest_bucket(step_i, rep_i, b, name, float(l2s[b]),
+                         float(maxes[b]), int(nonf[b]))
+        # -1.0 is the "no residual for this bucket" sentinel; a NaN
+        # norm is NOT absent — it is the terminal drift state and must
+        # reach the evaluator (NaN >= 0.0 is False, so an is-absent
+        # test, not a >= mask, decides delivery)
+        if not res[b] == -1.0:
+            ev.ingest_residual(step_i, rep_i, b, float(res[b]),
+                               name=name)
+
+
+def _deliver_staleness(name, cap, bucket, step, counters):
+    from . import ACTIVE, evaluator
+    if not ACTIVE:
+        return
+    evaluator().ingest_staleness(int(step), name,
+                                 np.asarray(counters).tolist(), cap,
+                                 bucket=bucket)
+
+
+def _deliver_checksums(names, step, replica, gathered):
+    from . import ACTIVE, evaluator
+    if not ACTIVE:
+        return
+    g = np.asarray(gathered)          # [axis, 2, n_buckets]
+    sums = g[:, 0, :]
+    xors = np.ascontiguousarray(g[:, 1, :]).view(np.uint32)
+    evaluator().ingest_checksums(int(step), int(replica), list(names),
+                                 sums.tolist(), xors.tolist())
+
+
+# ---------------------------------------------------------------------------
+# the per-update tap context the distributed transform threads through
+# ---------------------------------------------------------------------------
+
+class HealthTaps:
+    """Collects one update's per-bucket observations at trace time and
+    emits them as ONE ``jax.debug.callback`` (plus one per stale tail
+    bucket, plus the sentinel's conditional allgather+callback) — the
+    host sync cost is per step, not per bucket.
+
+    ``step`` is the traced step counter (``_DistState.count``);
+    ``check_every`` is the sentinel cadence (static, from
+    ``HOROVOD_HEALTH_CHECK_EVERY``).  ``cadence_step`` is the counter
+    the cadence divides (default ``step``): with gradient accumulation
+    the caller passes the BOUNDARY ordinal (``count // k``) — gating
+    on the raw micro-step counter would alias the cadence against k
+    (e.g. k=32, every=32 → every boundary)."""
+
+    def __init__(self, axis_name: Optional[str], step,
+                 check_every: int = 32, cadence_step=None):
+        self.axis_name = axis_name
+        self.step = step
+        self.cadence_step = step if cadence_step is None else cadence_step
+        self.check_every = max(int(check_every), 1)
+        self._names: List[str] = []
+        self._l2: List = []
+        self._max: List = []
+        self._nonf: List = []
+        self._res: List = []
+
+    def _replica(self):
+        if self.axis_name is None:
+            return jnp.int32(0)
+        return jax.lax.axis_index(self.axis_name)
+
+    # -- observation hooks (called inside the fused bucket loops) ------------
+
+    def observe_bucket(self, bucket_id: int, name: str, buf):
+        """Stats over one bucket's LOCAL flat gradient buffer (called
+        with the pre-reduction buffer — attribution needs the
+        contributor, not the smeared result)."""
+        l2, max_abs, nonfinite = bucket_stats(buf)
+        # buckets arrive in plan order; pad any gap (defensive — the
+        # planners emit contiguous ids).  Each padded slot is named by
+        # its OWN index: naming it after the target bucket would
+        # deliver the pad's zero stats under the real bucket's name
+        # and pollute its EWMA baseline
+        while len(self._names) <= bucket_id:
+            self._names.append(str(len(self._names)))
+            self._l2.append(jnp.float32(0.0))
+            self._max.append(jnp.float32(0.0))
+            self._nonf.append(jnp.int32(0))
+            self._res.append(jnp.float32(-1.0))
+        self._names[bucket_id] = str(name)
+        self._l2[bucket_id] = l2
+        self._max[bucket_id] = max_abs
+        self._nonf[bucket_id] = nonfinite
+
+    def observe_residual(self, bucket_id: int, buf):
+        """l2 norm of a quantized bucket's NEW error-feedback residual
+        (flat, this worker's carried quantization error)."""
+        if buf is None or bucket_id >= len(self._names):
+            return
+        f = buf.reshape(-1).astype(jnp.float32)
+        self._res[bucket_id] = jnp.sqrt(jnp.sum(jnp.square(f)))
+
+    def observe_staleness(self, bucket_id: int, name: str, counters,
+                          cap: int):
+        """Per-cross-group substitution counters of a stale tail bucket
+        (int32 [n_groups]) — delivered immediately (per-bucket, rare).
+        ``bucket_id`` keeps two stale buckets' saturation conditions
+        from firing/clearing each other's edge state."""
+        import functools
+        jax.debug.callback(
+            functools.partial(_deliver_staleness, str(name), int(cap),
+                              int(bucket_id)),
+            self.step, counters)
+
+    # -- emission ------------------------------------------------------------
+
+    def emit(self):
+        """Deliver the collected bucket stats (one callback)."""
+        if not self._names:
+            return
+        import functools
+        jax.debug.callback(
+            functools.partial(_deliver_stats, tuple(self._names)),
+            self.step, self._replica(), jnp.stack(self._l2),
+            jnp.stack(self._max), jnp.stack(self._nonf),
+            jnp.stack(self._res))
+
+    def sentinel(self, flats_fn, opt_state=None):
+        """The cross-replica divergence sentinel: per-bucket checksums
+        of ``flats_fn()`` (a thunk returning ``(bucket_id, name,
+        flat_buf)`` triples) plus one aggregate opt-state checksum,
+        allgathered over the axis every ``check_every``-th step and
+        compared on the host.
+
+        ``flats_fn`` is a THUNK, invoked inside the cadence branch:
+        closure-captured arrays would become cond operands evaluated
+        on every step, so building the flats and checksums in-branch
+        is what makes the off-cadence cost one predicate (the
+        documented cost model), not a full-model reduction.
+
+        No-op without a mapped axis (a single replica cannot desync
+        from itself)."""
+        if self.axis_name is None:
+            return
+        import functools
+        step, axis = self.step, self.axis_name
+        replica = self._replica()
+
+        def fire(_):
+            bucket_bufs = flats_fn()
+            if not bucket_bufs:
+                return jnp.int32(0)
+            names = []
+            sums, xors = [], []
+            for _bid, name, buf in bucket_bufs:
+                s, x = checksum_flat(buf)
+                names.append(str(name))
+                sums.append(s)
+                xors.append(x)
+            if opt_state is not None:
+                leaves = [l for l in
+                          jax.tree_util.tree_leaves(opt_state)
+                          if hasattr(l, "dtype")
+                          and getattr(l, "size", 0)]
+                if leaves:
+                    flat = jnp.concatenate(
+                        [l.reshape(-1).astype(jnp.float32)
+                         for l in leaves])
+                    s, x = checksum_flat(flat)
+                    names.append("opt_state")
+                    sums.append(s)
+                    xors.append(x)
+            payload = jnp.stack([
+                jnp.stack(sums),
+                jax.lax.bitcast_convert_type(jnp.stack(xors),
+                                             jnp.float32)])
+            gathered = jax.lax.all_gather(payload, axis)
+            jax.debug.callback(
+                functools.partial(_deliver_checksums, tuple(names)),
+                step, replica, gathered)
+            return jnp.int32(0)
+
+        jax.lax.cond(self.cadence_step % self.check_every == 0, fire,
+                     lambda _: jnp.int32(0), jnp.int32(0))

@@ -223,10 +223,13 @@ def healthz() -> dict:
 
 def get_routes() -> Dict[str, "callable"]:
     """Default GET routes every JsonRpcServer serves: ``/metrics``
-    (Prometheus text format), ``/healthz`` (JSON liveness), and
-    ``/trace`` (this process's span buffer as Chrome-trace JSON — the
-    single-host slice of the driver's merged ``/trace/job``).  Each
-    route returns ``(status, content_type, body)``."""
+    (Prometheus text format), ``/healthz`` (JSON liveness), ``/trace``
+    (this process's span buffer as Chrome-trace JSON — the single-host
+    slice of the driver's merged ``/trace/job``), and ``/health``
+    (this process's training-health snapshot — the single-worker slice
+    of the driver's merged ``/health/job``; NOT ``/healthz``, which is
+    process liveness).  Each route returns
+    ``(status, content_type, body)``."""
     def _metrics_route():
         return (200, "text/plain; version=0.0.4; charset=utf-8",
                 render_prometheus())
@@ -240,8 +243,12 @@ def get_routes() -> Dict[str, "callable"]:
                 json.dumps(tracing.local_trace(),
                            separators=(",", ":")))
 
+    def _health_route():
+        from .. import health  # lazy: health pulls no metrics state
+        return (200, "application/json", health.routes_json())
+
     return {"metrics": _metrics_route, "healthz": _healthz_route,
-            "trace": _trace_route}
+            "trace": _trace_route, "health": _health_route}
 
 
 def init_from_env(environ=os.environ):

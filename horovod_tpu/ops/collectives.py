@@ -799,6 +799,13 @@ def tail_round(name: str, tail_policy: str, n_groups: int,
     present, wait_s, lateness = plan_tail_round(
         name, tail_policy, n_groups, deadline_s,
         max_staleness=max_staleness, staleness=staleness, stall=stall)
+    if tail_policy == "stale" and staleness is not None:
+        # training-health feed: substitution counters AT the cap mean
+        # that group's staleness budget is spent (one false branch
+        # when HOROVOD_HEALTH=0)
+        from .. import health as _health
+        if _health.ACTIVE:
+            _health.note_staleness(name, staleness, max_staleness)
     if _metrics.ACTIVE:
         _m_tail_rounds.inc(policy=tail_policy)
     if wait_s > 0:

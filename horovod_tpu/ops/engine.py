@@ -30,6 +30,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import jax
 
 from .. import chaos as _chaos
+from .. import health as _health
 from .. import metrics as _metrics
 from .. import tracing as _tracing
 from ..exceptions import HorovodInternalError
@@ -788,10 +789,10 @@ class CollectiveEngine:
             else time.monotonic()), None
         results: dict = {}
         failed: Optional[BaseException] = None
-        for bucket in plan:
+        for bucket_id, bucket in enumerate(plan):
             try:
                 self._dispatch_bucket(entries, sigs, owner, base, bucket,
-                                      results)
+                                      results, bucket_id)
             except Exception as exc:  # noqa: BLE001 - surface per-entry
                 logger.exception("collective dispatch failed")
                 failed = exc
@@ -919,7 +920,8 @@ class CollectiveEngine:
         return pol
 
     # -- dispatch -----------------------------------------------------------
-    def _dispatch_bucket(self, entries, sigs, owner, base, bucket, results):
+    def _dispatch_bucket(self, entries, sigs, owner, base, bucket, results,
+                         bucket_id: int = 0):
         first = sigs[bucket[0]]
         op_type = first.op_type
         ps = entries[owner[bucket[0]]].process_set
@@ -971,14 +973,16 @@ class CollectiveEngine:
         with jax.profiler.TraceAnnotation(
                 f"hvd.{op_type}[{len(bucket)}]"):
             self._dispatch_bucket_inner(entries, sigs, owner, base, bucket,
-                                        results, op_type, eff, tail)
+                                        results, op_type, eff, tail,
+                                        bucket_id)
         if _tracing.ACTIVE:
             _tracing.span("dispatch", first.name, t_disp, _tracing.now(),
                           op=op_type, tensors=len(bucket), bytes=nbytes,
                           wire_format=eff, tail_policy=tail)
 
     def _dispatch_bucket_inner(self, entries, sigs, owner, base, bucket,
-                               results, op_type, wire_format, tail_policy):
+                               results, op_type, wire_format, tail_policy,
+                               bucket_id: int = 0):
         first = sigs[bucket[0]]
         if self.timeline:
             names = [sigs[si].name for si in bucket]
@@ -1000,6 +1004,27 @@ class CollectiveEngine:
         if op_type == "allreduce":
             arrays = [arr(si) for si in bucket]
             e0 = entries[owner[bucket[0]]]
+            if _chaos.ACTIVE:
+                # collective.corrupt: deterministic NaN/scale garbage
+                # into this fused bucket (stacked dim 0 = worker rows;
+                # replicated/multi-process corrupts this process's
+                # contribution iff it is the target rank)
+                from ..health.taps import chaos_corrupt_eager
+                arrays = chaos_corrupt_eager(arrays, first.stacked,
+                                             bucket_id, first.name)
+            if _health.ACTIVE and (
+                    (self._cycle_count - 1) % _health.SAMPLE_EVERY == 0):
+                # numerics tap over the LOCAL contribution (one false
+                # branch when HOROVOD_HEALTH=0).  SAMPLED at the
+                # HOROVOD_HEALTH_CHECK_EVERY cadence (first cycle
+                # always observed): the eager tap pays a device→host
+                # copy of the payload, which must not become a per-
+                # dispatch tax on every default-config job.  The cycle
+                # count is the eager path's step analog.
+                _health.engine_observe(self._cycle_count, bucket_id,
+                                       first.name, arrays,
+                                       jax.process_index(),
+                                       stacked=first.stacked)
             outs = collectives.allreduce_arrays(
                 arrays, e0.process_set, op=first.reduce_op,
                 prescale_factor=e0.prescale, postscale_factor=e0.postscale,
@@ -1054,6 +1079,11 @@ class CollectiveEngine:
                 "straggler_scores": self.stall.straggler_scores(),
                 "warnings_issued": self.stall.warnings_issued,
             }
+        if _health.ACTIVE:
+            # training-health verdict summary (docs/observability.md
+            # "Training health"): the full snapshot is GET /health /
+            # the health_pull RPC; stats() carries the compact verdict
+            out["health"] = _health.evaluator().summary()
         if self.autotuner is not None:
             out["autotune"] = {
                 "fusion_threshold_bytes": self._fusion_threshold(),

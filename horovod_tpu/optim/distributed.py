@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from .. import chaos as _chaos
 from .. import runtime
 from ..compression import Compression, resolve_wire_format
 from ..runtime import ReduceOp
@@ -78,7 +79,7 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
                       compression=Compression.none,
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0,
-                      wire_format=None, residual=None):
+                      wire_format=None, residual=None, health=None):
     """Reduce a gradient pytree across ``axis_name`` with bucket fusion.
 
     The in-jit analog of the reference's fusion buffer: leaves are bucketed
@@ -99,6 +100,15 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
     ``residual`` is the grads-shaped error-feedback tree (this worker's
     carried quantization error, fp32; None = zeros); when a wire format
     is active the return value becomes ``(reduced_tree, new_residual)``.
+
+    ``health`` is an optional :class:`~..health.taps.HealthTaps`
+    context: each bucket's LOCAL (pre-reduction) flat buffer feeds the
+    numerics tap (l2 / max-abs / nonfinite — attribution needs the
+    contributor, not the smeared post-psum result), and the new
+    error-feedback residual feeds the drift check.  Independently, the
+    ``collective.corrupt`` chaos site (guarded on ``chaos.ACTIVE``) may
+    bake a chosen rank's NaN/scale corruption into a chosen bucket —
+    the deterministic fault every health verdict is tested against.
     """
     threshold_bytes = _resolve_threshold(threshold_bytes)
     fmt = resolve_wire_format(wire_format)
@@ -159,6 +169,12 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
         with jax.named_scope(f"hvd_bucket{bucket_id}"):
             parts = [leaves[i].reshape(-1) for i in bucket]
             buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+            if _chaos.ACTIVE:
+                from ..health.taps import chaos_corrupt
+                buf = chaos_corrupt(buf, axis_name, bucket_id,
+                                    _names[bucket[0]])
+            if health is not None:
+                health.observe_bucket(bucket_id, _names[bucket[0]], buf)
             if prescale_factor != 1.0:
                 buf = buf * jnp.asarray(prescale_factor, buf.dtype)
             if fmt is not None and _sigs[bucket[0]].wire_format != "none":
@@ -169,6 +185,8 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
                 red, nres = quantized_allreduce_p(
                     buf, axis_name, fmt, op=op, residual=rbuf,
                     error_feedback=True)
+                if health is not None:
+                    health.observe_residual(bucket_id, nres)
             else:
                 wire, ctx = compression.compress(buf)
                 red = jax.lax.psum(wire, axis_name)
@@ -258,7 +276,8 @@ def fused_tail_reduce_tree(grads, cross_axis: str, local_axis: str,
                            threshold_bytes: Optional[int] = None,
                            tail_policy: str = "strict",
                            present=None, tail_state=None,
-                           max_staleness: int = 0, wire_format=None):
+                           max_staleness: int = 0, wire_format=None,
+                           health=None):
     """Hierarchical tail-tolerant fused reduce of a gradient pytree over
     a ``(cross, local)`` mesh factoring (ISSUE 11 / ROADMAP item 2,
     OptiReduce arXiv:2310.06993).
@@ -309,6 +328,15 @@ def fused_tail_reduce_tree(grads, cross_axis: str, local_axis: str,
         with jax.named_scope(f"hvd_bucket{bucket_id}"):
             parts = [leaves[i].reshape(-1) for i in bucket]
             buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+            if _chaos.ACTIVE:
+                from ..health.taps import chaos_corrupt
+                # the tail reduce's worker identity is the flattened
+                # (cross, local) device order — corrupt targets rank on
+                # the cross axis (the DCN hop the tail policy rewrites)
+                buf = chaos_corrupt(buf, cross_axis, bucket_id,
+                                    names[bucket[0]])
+            if health is not None:
+                health.observe_bucket(bucket_id, names[bucket[0]], buf)
             state_i = None
             if stale:
                 if tail_state is not None:
@@ -325,6 +353,13 @@ def fused_tail_reduce_tree(grads, cross_axis: str, local_axis: str,
             if stale:
                 red, st = red
                 new_state.append(st)
+                if health is not None:
+                    # st[1]: int32 [n_groups] substitution counters —
+                    # a counter AT the cap means that group's staleness
+                    # budget is spent (the saturation verdict)
+                    health.observe_staleness(bucket_id,
+                                             names[bucket[0]], st[1],
+                                             max_staleness)
             off = 0
             for i in bucket:
                 sz = leaves[i].size
@@ -361,13 +396,13 @@ def _sharded_layout(tree, axis_size: int, op, prescale_factor,
     path (one cross-process ordering contract), plus per-bucket padding
     to a multiple of ``axis_size`` (times ``align``: the quantized wire
     needs block-aligned shards so per-block scales route with their
-    blocks).  Returns ``(sorted_leaves, layout)`` so callers reuse the
-    single path walk."""
+    blocks).  Returns ``(sorted_leaves, sorted_names, layout)`` so
+    callers reuse the single path walk."""
     from ..ops.fusion import plan_bucket_layouts
     leaves, names, order = _tree_leaves_sorted(tree)
     buckets, sigs = _plan_buckets(leaves, names, op, prescale_factor,
                                   postscale_factor, threshold_bytes)
-    return leaves, ShardedLayout(
+    return leaves, names, ShardedLayout(
         treedef=jax.tree_util.tree_structure(tree), order=tuple(order),
         shapes=tuple(tuple(l.shape) for l in leaves),
         buckets=tuple(plan_bucket_layouts(sigs, buckets, axis_size,
@@ -411,7 +446,8 @@ def fused_reduce_scatter_tree(grads, axis_name: str,
                               compression=Compression.none,
                               prescale_factor: float = 1.0,
                               postscale_factor: float = 1.0,
-                              wire_format=None, residual=None):
+                              wire_format=None, residual=None,
+                              health=None):
     """Reduce-scatter a gradient pytree: each worker keeps 1/N per bucket.
 
     The sharded-update half of ``fused_reduce_tree``: the SAME
@@ -453,9 +489,12 @@ def fused_reduce_scatter_tree(grads, axis_name: str,
             shapes=(), buckets=()))
         return empty if fmt is None else empty + (residual,)
     n = _axis_size(axis_name)
-    leaves, layout = _sharded_layout(grads, n, op, prescale_factor,
-                                     postscale_factor, threshold_bytes,
-                                     align=fmt.block_size if fmt else 1)
+    # names ride the single path walk: a chaos rule matching name=
+    # must not be silently inert under sharded_update, and verdicts
+    # carry the same tensor names as the other fused paths
+    leaves, names, layout = _sharded_layout(
+        grads, n, op, prescale_factor, postscale_factor,
+        threshold_bytes, align=fmt.block_size if fmt else 1)
     res_leaves = _residual_leaves(residual, leaves) if fmt is not None \
         else None
     new_res = [None] * len(leaves) if fmt is not None else None
@@ -463,6 +502,12 @@ def fused_reduce_scatter_tree(grads, axis_name: str,
     for bucket_id, bl in enumerate(layout.buckets):
         with jax.named_scope(f"hvd_bucket{bucket_id}"):
             buf = _bucket_flat(leaves, bl)
+            nm = names[bl.indices[0]]
+            if _chaos.ACTIVE:
+                from ..health.taps import chaos_corrupt
+                buf = chaos_corrupt(buf, axis_name, bucket_id, nm)
+            if health is not None:
+                health.observe_bucket(bucket_id, nm, buf)
             if prescale_factor != 1.0:
                 buf = buf * jnp.asarray(prescale_factor, buf.dtype)
             if fmt is not None:
@@ -471,6 +516,8 @@ def fused_reduce_scatter_tree(grads, axis_name: str,
                 tile, nres = quantized_sum_scatter_p(
                     buf.astype(jnp.float32) + rbuf, axis_name, fmt,
                     error_feedback=True)
+                if health is not None:
+                    health.observe_residual(bucket_id, nres)
                 tile = tile.astype(buf.dtype)
                 off = 0
                 for i in bl.indices:
@@ -533,6 +580,53 @@ def _overlap_default() -> bool:
     return _env_bool("HOROVOD_OVERLAP", False)
 
 
+def _health_taps_default() -> bool:
+    """Env/config default for ``health`` (HOROVOD_HEALTH_TAPS, vetoed
+    by the HOROVOD_HEALTH master switch): the in-jit numerics taps +
+    divergence sentinel are a schedule property like sharded_update,
+    so they are an opt-in — an explicit ``health=True`` on the
+    transform wins over the env either way (the pinned
+    ``health_distopt_step`` schedule entry must not flip with it)."""
+    cfg = runtime._state().config
+    if cfg is not None:
+        return cfg.health and cfg.health_taps
+    from .. import health as _h
+    return _h.taps_default()
+
+
+def _health_check_every_default() -> int:
+    """Env/config default for the divergence-sentinel cadence
+    (HOROVOD_HEALTH_CHECK_EVERY, steps)."""
+    cfg = runtime._state().config
+    if cfg is not None:
+        return cfg.health_check_every
+    from .. import health as _h
+    return _h.check_every()
+
+
+def _sentinel_bucket_flats(target, plan_like, op, prescale_factor,
+                           postscale_factor, threshold_bytes):
+    """``(bucket_id, name, flat_buf)`` per fusion bucket of ``target``,
+    bucketed by the plan of ``plan_like`` (the GRADIENT tree): the
+    sentinel's checksum attribution must line up with the numerics
+    taps' bucket ids, and planning from the target itself would split
+    differently under mixed precision (fp32 params vs bf16 grads —
+    byte thresholds see 2x the sizes).  Both trees share one
+    structure, so the path-sorted leaf indices coincide."""
+    t_leaves, _t_names, _order = _tree_leaves_sorted(target)
+    p_leaves, p_names, _p_order = _tree_leaves_sorted(plan_like)
+    buckets, _sigs = _plan_buckets(p_leaves, p_names, op,
+                                   prescale_factor, postscale_factor,
+                                   threshold_bytes)
+    out = []
+    for bucket_id, bucket in enumerate(buckets):
+        parts = [t_leaves[i].reshape(-1) for i in bucket]
+        out.append((bucket_id, p_names[bucket[0]],
+                    jnp.concatenate(parts) if len(parts) > 1
+                    else parts[0]))
+    return out
+
+
 def _wire_format_default():
     """Env/config default for ``wire_format`` (HOROVOD_COMPRESSION +
     HOROVOD_COMPRESSION_BLOCK_SIZE): the quantized wire the operator
@@ -577,7 +671,9 @@ def DistributedGradientTransform(
         wire_format: Optional[str] = None,
         wire_block_size: Optional[int] = None,
         overlap: Optional[bool] = None,
-        overlap_layers: str = "layers"
+        overlap_layers: str = "layers",
+        health: Optional[bool] = None,
+        health_check_every: Optional[int] = None
         ) -> optax.GradientTransformation:
     """optax transformation that cross-worker-reduces gradients.
 
@@ -634,6 +730,24 @@ def DistributedGradientTransform(
     stays untouched at ``None``).  With ``backward_passes_per_step > 1``
     the taps gate on the accumulation boundary — pass
     ``count=state.count`` to ``overlapped_backprop``.
+
+    ``health=True`` (default from ``HOROVOD_HEALTH_TAPS``, vetoed by
+    ``HOROVOD_HEALTH=0``; in-jit only) arms the **training-health
+    numerics taps** (docs/observability.md "Training health"): each
+    fused bucket's local pre-reduction buffer feeds per-bucket l2 /
+    max-abs / nonfinite stats (plus the error-feedback residual norm
+    under a wire format, and staleness counters under a stale tail
+    policy) to the host :class:`~..health.evaluate.HealthEvaluator`
+    via ``jax.debug.callback``, and every
+    ``health_check_every``-th step (``HOROVOD_HEALTH_CHECK_EVERY``) a
+    **divergence sentinel** allgathers per-bucket param/update +
+    opt-state checksums across the axis so a silently desynced replica
+    is convicted with (worker, bucket, step) attribution.  An explicit
+    ``health=`` wins over the env (the pinned ``health_distopt_step``
+    hvdsched entry relies on this).  Under ``sharded_update`` the
+    opt-state checksum is skipped — the state is 1/N per worker by
+    design.  Not supported with ``overlap`` (the in-backward dispatched
+    buckets never materialize a boundary buffer to tap).
     """
     if inner is None:
         inner = optax.identity()
@@ -700,12 +814,37 @@ def DistributedGradientTransform(
             prescale=prescale_factor, postscale=postscale_factor,
             sharded=sharded, fmt=fmt, k=k, layers_key=overlap_layers)
 
-    def reduce_grads(grads):
+    if health and axis_name is None:
+        raise ValueError(
+            "health=True requires axis_name: the numerics taps live in "
+            "the in-jit fused buffers and the divergence sentinel needs "
+            "a mapped axis to gather checksums over (the eager engine "
+            "has its own dispatch taps, on by default under "
+            "HOROVOD_HEALTH)")
+    if health and _ov_plan is not None:
+        raise ValueError(
+            "health=True is not supported with overlap=True: the "
+            "overlapped buckets dispatch inside the backward scan and "
+            "never materialize a boundary buffer to tap — use the "
+            "trace/metrics plane for overlapped steps, or disable one")
+    hl_enabled = (bool(health) if health is not None
+                  else (axis_name is not None and _ov_plan is None
+                        and _health_taps_default()))
+    hl_every = 1
+    if hl_enabled:
+        hl_every = (int(health_check_every)
+                    if health_check_every is not None
+                    else _health_check_every_default())
+        if hl_every < 1:
+            raise ValueError(
+                f"health_check_every must be >= 1, got {hl_every}")
+
+    def reduce_grads(grads, health=None):
         if axis_name is not None:
             return fused_reduce_tree(
                 grads, axis_name, op=op, threshold_bytes=threshold_bytes,
                 compression=compression, prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor)
+                postscale_factor=postscale_factor, health=health)
         from .. import api
         leaves, names, order = _tree_leaves_sorted(grads)
         wires, ctxs = [], []
@@ -731,9 +870,11 @@ def DistributedGradientTransform(
     # is then params-based only (no false positives either way).
     _init_fingerprints = set()
 
-    def _step(grads, inner_state, params, residual):
+    def _step(grads, inner_state, params, residual, taps=None):
         """One reduced optimizer step → (full-size updates, new inner,
-        new error-feedback residual)."""
+        new error-feedback residual).  ``taps`` is the per-update
+        health context (numerics taps inside the fused reduce, then
+        the divergence sentinel + one batched host delivery here)."""
         if sharded:
             if fmt is not None:
                 shards, layout, new_res = fused_reduce_scatter_tree(
@@ -741,14 +882,14 @@ def DistributedGradientTransform(
                     threshold_bytes=threshold_bytes,
                     prescale_factor=prescale_factor,
                     postscale_factor=postscale_factor,
-                    wire_format=fmt, residual=residual)
+                    wire_format=fmt, residual=residual, health=taps)
             else:
                 shards, layout = fused_reduce_scatter_tree(
                     grads, axis_name, op=op,
                     threshold_bytes=threshold_bytes,
                     compression=compression,
                     prescale_factor=prescale_factor,
-                    postscale_factor=postscale_factor)
+                    postscale_factor=postscale_factor, health=taps)
                 new_res = residual
             # init_fn planned the state layout from PARAMS; the gradient
             # layout above must be the same plan, or the 1/N state tiles
@@ -756,7 +897,7 @@ def DistributedGradientTransform(
             # instead of a deep optax mismatch
             p_shards = None
             if params is not None:
-                p_leaves, p_layout = _sharded_layout(
+                p_leaves, _p_names, p_layout = _sharded_layout(
                     params, _axis_size(axis_name), op, prescale_factor,
                     postscale_factor, _resolve_threshold(threshold_bytes),
                     align=fmt.block_size if fmt else 1)
@@ -779,17 +920,38 @@ def DistributedGradientTransform(
             upd_shards, new_inner = inner.update(
                 shards, inner_state, p_shards)
             updates = all_gather_sharded_tree(upd_shards, layout, axis_name)
+            if taps is not None:
+                # sharded mode: the inner state is 1/N per worker BY
+                # DESIGN — only the replicated params/updates can be
+                # checksummed for desync.  Thunk: the flats build only
+                # inside the cadence branch (off-cadence steps pay one
+                # predicate, never the flatten+checksum reductions)
+                taps.sentinel(lambda: _sentinel_bucket_flats(
+                    params if params is not None else updates, grads,
+                    op, prescale_factor, postscale_factor,
+                    _resolve_threshold(threshold_bytes)))
+                taps.emit()
             return updates, new_inner, new_res
         if fmt is not None:
             reduced, new_res = fused_reduce_tree(
                 grads, axis_name, op=op, threshold_bytes=threshold_bytes,
                 prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor,
-                wire_format=fmt, residual=residual)
+                wire_format=fmt, residual=residual, health=taps)
         else:
-            reduced = reduce_grads(grads)
+            reduced = reduce_grads(grads, health=taps)
             new_res = residual
         updates, new_inner = inner.update(reduced, inner_state, params)
+        if taps is not None:
+            # thunk: flats/checksums build only inside the cadence
+            # branch (see HealthTaps.sentinel — closure-captured
+            # arrays would be evaluated on every step)
+            taps.sentinel(lambda: _sentinel_bucket_flats(
+                params if params is not None else updates, grads, op,
+                prescale_factor, postscale_factor,
+                _resolve_threshold(threshold_bytes)),
+                opt_state=new_inner)
+            taps.emit()
         return updates, new_inner, new_res
 
     def _ov_step(grads, inner_state, params, fired, extra_acc=None,
@@ -898,7 +1060,7 @@ def DistributedGradientTransform(
                 _init_fingerprints.add(layout.fingerprint())
                 inner_state = inner.init(p_tiles)
             else:
-                _leaves, layout = _sharded_layout(
+                _leaves, _lnames, layout = _sharded_layout(
                     params, n, op, prescale_factor, postscale_factor,
                     _resolve_threshold(threshold_bytes),
                     align=fmt.block_size if fmt else 1)
@@ -953,6 +1115,17 @@ def DistributedGradientTransform(
                                        state.residual)
         residual = getattr(state, "residual", None)
         if k == 1:
+            if hl_enabled:
+                # the sentinel cadence needs a step counter: with taps
+                # armed, count advances every update (k == 1 has no
+                # boundary arithmetic to disturb)
+                from ..health.taps import HealthTaps
+                count = state.count + 1
+                taps = HealthTaps(axis_name, count, hl_every)
+                updates, new_inner, new_res = _step(
+                    grads, state.inner, params, residual, taps=taps)
+                return updates, _DistState(new_inner, state.acc, count,
+                                           new_res)
             updates, new_inner, new_res = _step(grads, state.inner,
                                                 params, residual)
             return updates, _DistState(new_inner, state.acc, state.count,
@@ -976,8 +1149,21 @@ def DistributedGradientTransform(
         def do_step(args):
             acc, inner_state, residual = args
             mean_acc = jax.tree_util.tree_map(lambda a: a / k, acc)
+            taps = None
+            if hl_enabled:
+                # taps under the boundary cond: intermediate micro-
+                # steps observe nothing (their gradients only
+                # accumulate locally).  The sentinel cadence divides
+                # the BOUNDARY ordinal (count // k), not the raw
+                # micro-step counter — gating on count would alias
+                # the cadence against k (k=32 at the default
+                # check_every=32 would gather at EVERY boundary)
+                from ..health.taps import HealthTaps
+                taps = HealthTaps(axis_name, count, hl_every,
+                                  cadence_step=count // k)
             updates, new_inner, new_res = _step(mean_acc, inner_state,
-                                                params, residual)
+                                                params, residual,
+                                                taps=taps)
             return (updates, _as_varying(_fresh_zeros(acc)), new_inner,
                     new_res)
 
@@ -1053,7 +1239,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          wire_format: Optional[str] = None,
                          wire_block_size: Optional[int] = None,
                          overlap: Optional[bool] = None,
-                         overlap_layers: str = "layers"
+                         overlap_layers: str = "layers",
+                         health: Optional[bool] = None,
+                         health_check_every: Optional[int] = None
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with distributed gradient reduction.
 
@@ -1077,7 +1265,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         postscale_factor=postscale, threshold_bytes=threshold_bytes,
         process_set=process_set, sharded_update=sharded_update,
         wire_format=wire_format, wire_block_size=wire_block_size,
-        overlap=overlap, overlap_layers=overlap_layers)
+        overlap=overlap, overlap_layers=overlap_layers,
+        health=health, health_check_every=health_check_every)
 
 
 def broadcast_parameters(params, root_rank: int = 0, process_set=None):

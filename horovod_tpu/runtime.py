@@ -491,6 +491,15 @@ def init(comm=None, process_sets: Optional[Sequence[ProcessSet]] = None):
             process=jax.process_index(),
             host=os.environ.get("HOROVOD_HOSTNAME") or None,
             epoch=int(ns[1:]))
+        # training-health evaluator identity (health/): verdicts carry
+        # this worker's rank/host so the driver's /health/job merge
+        # attributes them; history survives elastic re-inits (a
+        # post-mortem scrape wants the pre-reform verdicts)
+        from . import health as _health
+        _health.init_from_env()
+        _health.set_identity(
+            process=jax.process_index(),
+            host=os.environ.get("HOROVOD_HOSTNAME") or None)
         from .ops.controller import Controller
         from .ops.engine import CollectiveEngine
         _STATE.engine = CollectiveEngine(

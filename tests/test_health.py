@@ -1,0 +1,905 @@
+"""Training-health telemetry (ISSUE 13, horovod_tpu/health/): in-jit
+numerics taps, the cross-replica divergence sentinel, the evaluator's
+edge-triggered verdicts, the collective.corrupt chaos site, and the
+health_pull / GET /health/job exposition plane.
+
+The acceptance pins run on a REAL mapped CPU mesh (``jax.pmap`` over 4
+virtual devices — the same XLA collective lowering as ICI): a pinned
+``collective.corrupt`` seed must be flagged with exact (worker, bucket)
+attribution, must surface through a driver-shaped ``GET /health/job``
+scrape and ``tools/hvddoctor``, and a clean run must stay verdict-free;
+``health=False`` leaves the compiled step free of taps (one trace-time
+false branch) and every pre-existing hvdsched snapshot byte-identical.
+"""
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu.chaos as chaos
+import horovod_tpu.health as health
+import horovod_tpu.metrics as hvd_metrics
+from horovod_tpu.health import taps as htaps
+from horovod_tpu.health.evaluate import _WARMUP, HealthEvaluator
+from horovod_tpu.optim.distributed import DistributedOptimizer
+from horovod_tpu.runner.rpc import JsonRpcServer
+
+AXIS = "hw"
+N = 4
+
+# two fusion buckets at this threshold: 'a' (140 B) alone in bucket 0,
+# 'b' (12 B) in bucket 1 — the corrupt seeds target bucket 1
+PARAMS = {"a": np.linspace(-1.0, 1.0, 35).reshape(7, 5).astype(np.float32),
+          "b": np.arange(3, dtype=np.float32)}
+THRESHOLD = 64
+
+
+def _grads(n=N, scale=1.0):
+    return {
+        "a": np.stack([scale * np.sin(np.arange(35, dtype=np.float32) + r)
+                       .reshape(7, 5) for r in range(n)]),
+        "b": np.stack([scale * np.full((3,), float(r + 1), np.float32)
+                       for r in range(n)]),
+    }
+
+
+def _make_step(n=N, check_every=2, health_on=True, k=1,
+               sharded=False, params_in_axes=None):
+    """(pmap'd step fn, init state, transform) on an n-device mesh."""
+    devs = jax.devices()[:n]
+    tx = DistributedOptimizer(optax.sgd(1e-2), axis_name=AXIS,
+                              threshold_bytes=THRESHOLD,
+                              backward_passes_per_step=k,
+                              sharded_update=sharded,
+                              health=health_on,
+                              health_check_every=check_every)
+    st = jax.pmap(lambda p, _: tx.init(p), axis_name=AXIS,
+                  in_axes=(params_in_axes, 0),
+                  devices=devs)(PARAMS if params_in_axes is None
+                                else _stack_params(n), np.zeros(n))
+
+    def step(p, s, g):
+        u, ns = tx.update(g, s, p)
+        return optax.apply_updates(p, u), ns
+
+    f = jax.pmap(step, axis_name=AXIS,
+                 in_axes=(params_in_axes, 0, 0), devices=devs)
+    return f, st, tx
+
+
+def _stack_params(n=N, odd=3):
+    """Per-device params with device ``odd`` silently diverged — the
+    desync the sentinel exists to catch (a MINORITY divergence, so the
+    evaluator can convict a specific replica; an all-different stack
+    would be a no-majority split reported without a culprit)."""
+    return jax.tree_util.tree_map(
+        lambda p: np.stack([p + (0.01 if r == odd else 0.0)
+                            for r in range(n)]), PARAMS)
+
+
+def _run(f, st, steps=3, params=None, grads=None,
+         params_stacked=False):
+    p = (PARAMS if params is None else params)
+    g = _grads() if grads is None else grads
+    for _ in range(steps):
+        pstack, st = f(p, st, g)
+        jax.block_until_ready(pstack)
+        if not params_stacked:
+            p = jax.tree_util.tree_map(lambda x: x[0], pstack)
+        else:
+            p = pstack
+    return p, st
+
+
+@pytest.fixture
+def ev():
+    """A fresh, swapped-in evaluator; always restored."""
+    fresh = HealthEvaluator()
+    old = health.swap_evaluator(fresh)
+    yield fresh
+    health.swap_evaluator(old)
+
+
+# ---------------------------------------------------------------------------
+# tap primitives
+# ---------------------------------------------------------------------------
+
+def test_bucket_stats_values():
+    buf = jnp.asarray([3.0, -4.0, np.nan, np.inf, 0.0], jnp.float32)
+    l2, max_abs, nonfinite = jax.jit(htaps.bucket_stats)(buf)
+    # l2/max over the FINITE lanes (the nonfinite count carries the
+    # signal; a NaN'd norm would disarm the explosion baseline)
+    assert float(l2) == pytest.approx(5.0)
+    assert float(max_abs) == pytest.approx(4.0)
+    assert int(nonfinite) == 2
+
+
+def test_checksum_flat_deterministic_and_bit_sensitive():
+    buf = np.linspace(-2, 2, 64).astype(np.float32)
+    s1, x1 = jax.jit(htaps.checksum_flat)(jnp.asarray(buf))
+    s2, x2 = jax.jit(htaps.checksum_flat)(jnp.asarray(buf.copy()))
+    assert float(s1) == float(s2) and int(x1) == int(x2)
+    # flip ONE mantissa bit via the bit pattern: the xor must change
+    flipped = buf.copy()
+    flipped.view(np.uint32)[17] ^= np.uint32(1)
+    _s3, x3 = jax.jit(htaps.checksum_flat)(jnp.asarray(flipped))
+    assert int(x3) != int(x1)
+
+
+def test_corrupt_target_parsing():
+    from horovod_tpu.chaos.schedule import Action
+    r, f = htaps._corrupt_target(Action("nan", "3"))
+    assert r == 3 and math.isnan(f)
+    r, f = htaps._corrupt_target(Action("nan", None))
+    assert r == 0 and math.isnan(f)
+    assert htaps._corrupt_target(Action("scale", "2")) == (2, 1e6)
+    assert htaps._corrupt_target(Action("scale", "1,8.0")) == (1, 8.0)
+    r, f = htaps._corrupt_target(Action("scale", "bogus"))
+    assert (r, f) == (0, 1e6)
+
+
+def test_unknown_corrupt_action_rejected_at_parse():
+    """The fail-loud contract: nan/scale are KNOWN actions now, and a
+    typo'd one still raises at install."""
+    chaos.FaultSchedule.parse("collective.corrupt nth=1 action=nan:2")
+    with pytest.raises(ValueError, match="unknown action"):
+        chaos.FaultSchedule.parse("collective.corrupt nth=1 action=nans")
+
+
+def test_chaos_corrupt_eager_row_targeting_and_dtype_contract():
+    """Stacked arrays corrupt worker ROW R only; integer lanes pass
+    through untouched; and 64-bit floats keep their dtype (the
+    engine's dtype-exact contract — a jnp round trip outside the x64
+    scope would silently downcast)."""
+    sched = chaos.FaultSchedule.parse(
+        "collective.corrupt bucket=0 nth=1 action=nan:1", seed=1)
+    chaos.install(sched)
+    try:
+        arrs = [np.ones((4, 3), np.float64),
+                np.arange(4, dtype=np.int32)]
+        out = htaps.chaos_corrupt_eager(arrs, stacked=True, bucket=0,
+                                        name="t")
+    finally:
+        chaos.uninstall()
+    assert out[0].dtype == np.float64
+    assert np.isnan(out[0][1]).all()
+    assert np.isfinite(out[0][0]).all() and np.isfinite(out[0][2]).all()
+    assert out[1] is arrs[1]
+    # replicated/multi-process shape: corrupt iff THIS process is the
+    # target rank (process 0 in tests)
+    sched2 = chaos.FaultSchedule.parse(
+        "collective.corrupt nth=1 action=scale:0,4.0", seed=1)
+    chaos.install(sched2)
+    try:
+        (o,) = htaps.chaos_corrupt_eager([np.ones((2,), np.float32)],
+                                         stacked=False, bucket=0,
+                                         name="t")
+    finally:
+        chaos.uninstall()
+    np.testing.assert_allclose(o, 4.0)
+
+
+# ---------------------------------------------------------------------------
+# evaluator verdicts (unit)
+# ---------------------------------------------------------------------------
+
+def test_nonfinite_verdict_edge_triggered():
+    e = HealthEvaluator()
+    e.ingest_bucket(1, 2, 1, "b", 0.0, 0.0, 3)
+    e.ingest_bucket(2, 2, 1, "b", 0.0, 0.0, 5)   # still firing: no dup
+    assert [v["kind"] for v in e.verdicts()] == ["nonfinite"]
+    v = e.verdicts()[0]
+    assert (v["worker"], v["bucket"], v["step"]) == (2, 1, 1)
+    assert not e.healthy
+    e.ingest_bucket(3, 2, 1, "b", 1.0, 1.0, 0)   # clears → re-arms
+    assert e.healthy
+    e.ingest_bucket(4, 2, 1, "b", 0.0, 0.0, 1)   # genuine re-stall
+    assert len(e.verdicts()) == 2
+
+
+def test_ewma_baselines_keyed_by_name_not_plan_index():
+    """The eager engine's plan index is per-cycle: cycle 1's bucket 0
+    may be a tiny layernorm, cycle 2's bucket 0 a huge embedding.  An
+    index-keyed baseline would blend them and fire a spurious
+    explosion; name keying keeps each tensor's own baseline (review
+    finding)."""
+    e = HealthEvaluator(grad_factor=10.0)
+    for i in range(_WARMUP + 1):
+        e.ingest_bucket(i, 0, 0, "layernorm", 0.01, 0.01, 0)
+    # same plan index, DIFFERENT tensor, naturally 10000x the norm:
+    # its own cold baseline — no verdict
+    for i in range(_WARMUP + 1):
+        e.ingest_bucket(100 + i, 0, 0, "embedding", 100.0, 10.0, 0)
+    assert e.healthy, e.verdicts()
+
+
+def test_engine_observe_stacked_rows_attributed_per_worker():
+    """Stacked eager arrays carry every worker's contribution as dim-0
+    rows: a NaN in row 2 must convict worker 2, not this process
+    (review finding)."""
+    fresh = HealthEvaluator()
+    old = health.swap_evaluator(fresh)
+    try:
+        x = np.ones((4, 5), np.float32)
+        x[2, 3] = np.nan
+        health.engine_observe(1, 0, "t", [x], process=0, stacked=True)
+    finally:
+        health.swap_evaluator(old)
+    hits = [v for v in fresh.verdicts() if v["kind"] == "nonfinite"]
+    assert hits and hits[0]["worker"] == 2, fresh.verdicts()
+    # clean rows got their own finite observations
+    snap = fresh.snapshot()
+    assert set(snap["buckets"]["t"]["grad_ewma"]) == {"0", "1", "3"}
+
+
+def test_nonfinite_clears_across_shifting_plan_index():
+    """Eager cycles renumber buckets per drain: a condition fired for
+    tensor T under plan index 0 must clear when T arrives finite under
+    plan index 1 — an index-bearing edge key could never re-arm and
+    the verdict stuck forever (review finding)."""
+    e = HealthEvaluator()
+    e.ingest_bucket(1, 0, 0, "emb", 0.0, 0.0, 3)   # NaN, bucket id 0
+    assert not e.healthy
+    e.ingest_bucket(2, 0, 1, "emb", 1.0, 1.0, 0)   # finite, id 1 now
+    assert e.healthy, e.snapshot()["active"]
+    # the verdict still carries the index it was OBSERVED at
+    assert e.verdicts()[0]["bucket"] == 0
+
+
+def test_grad_explosion_vs_ewma_with_warmup():
+    e = HealthEvaluator(grad_factor=10.0)
+    for i in range(_WARMUP):
+        e.ingest_bucket(i, 0, 0, "a", 1.0, 1.0, 0)
+    assert e.healthy                      # cold baseline: never fires
+    e.ingest_bucket(_WARMUP, 0, 0, "a", 50.0, 50.0, 0)
+    kinds = [v["kind"] for v in e.verdicts()]
+    assert kinds == ["grad_explosion"]
+    # re-arms only once the norm decays below half the bar
+    e.ingest_bucket(_WARMUP + 1, 0, 0, "a", 60.0, 60.0, 0)
+    assert len(e.verdicts()) == 1
+    for i in range(10):
+        e.ingest_bucket(_WARMUP + 2 + i, 0, 0, "a", 1.0, 1.0, 0)
+    assert e.healthy
+
+
+def test_loss_spike_and_nonfinite_loss():
+    e = HealthEvaluator(loss_factor=4.0)
+    for i in range(_WARMUP):
+        e.note_loss(2.0, step=i)
+    e.note_loss(100.0, step=_WARMUP)
+    assert [v["kind"] for v in e.verdicts()] == ["loss_spike"]
+    e2 = HealthEvaluator()
+    e2.note_loss(float("nan"), step=0)
+    assert [v["kind"] for v in e2.verdicts()] == ["nonfinite"]
+
+
+def test_residual_drift_verdict():
+    e = HealthEvaluator(residual_factor=4.0)
+    for i in range(_WARMUP + 1):
+        e.ingest_bucket(i, 0, 0, "a", 1.0, 1.0, 0)
+        e.ingest_residual(i, 0, 0, 0.1)   # bounded residual: healthy
+    assert e.healthy
+    e.ingest_residual(9, 0, 0, 40.0)      # 40x the gradient EWMA
+    assert [v["kind"] for v in e.verdicts()] == ["residual_drift"]
+
+
+def test_staleness_saturation_verdict():
+    e = HealthEvaluator()
+    e.ingest_staleness(5, "bkt", [0, 2, 0], cap=4, bucket=1)
+    assert e.healthy
+    e.ingest_staleness(6, "bkt", [0, 4, 0], cap=4, bucket=1)
+    (v,) = e.verdicts()
+    assert v["kind"] == "staleness_saturated"
+    # the saturated CROSS-GROUP is not a worker rank: it rides the
+    # verdict's own `group` field, worker stays -1 (n/a)
+    assert v["group"] == 1 and v["worker"] == -1 and v["bucket"] == 1
+    e.ingest_staleness(7, "bkt", [0, 0, 0], cap=4, bucket=1)  # recovered
+    assert e.healthy
+
+
+def test_staleness_edge_state_is_per_bucket():
+    """Two stale buckets must not fire/clear each other's saturation
+    condition (review finding: a shared (group) key flooded one
+    verdict per round)."""
+    e = HealthEvaluator()
+    e.ingest_staleness(1, "bktA", [4], cap=4, bucket=0)   # A saturated
+    e.ingest_staleness(1, "bktB", [0], cap=4, bucket=1)   # B fine
+    e.ingest_staleness(2, "bktA", [4], cap=4, bucket=0)   # still firing
+    e.ingest_staleness(2, "bktB", [0], cap=4, bucket=1)
+    assert len(e.verdicts()) == 1, e.verdicts()
+
+
+def test_nonfinite_loss_clears_on_finite_loss():
+    """A finite loss re-arms the nonfinite-loss condition (review
+    finding: the key was never popped, so the evaluator stayed
+    unhealthy forever and a second NaN episode went unreported)."""
+    e = HealthEvaluator()
+    e.note_loss(float("nan"), step=1)
+    assert not e.healthy
+    e.note_loss(1.0, step=2)
+    assert e.healthy
+    e.note_loss(float("inf"), step=3)    # a distinct, later episode
+    assert [v["kind"] for v in e.verdicts()] == ["nonfinite",
+                                                 "nonfinite"]
+
+
+def test_checksum_desync_convicts_minority_replica():
+    e = HealthEvaluator()
+    sums = [[1.0], [1.0], [1.5], [1.0]]
+    xors = [[7], [7], [9], [7]]
+    e.ingest_checksums(4, 0, ["b0"], sums, xors)
+    (v,) = e.verdicts()
+    assert v["kind"] == "replica_desync"
+    assert (v["worker"], v["bucket"], v["step"]) == (2, 0, 4)
+    # per-step dedup: every pmap device delivers the same matrix once
+    e.ingest_checksums(4, 1, ["b0"], sums, xors)
+    assert len(e.verdicts()) == 1
+
+
+def test_checksum_dedup_is_content_keyed_not_step_keyed():
+    """An elastic re-init restarts the step counter while the
+    evaluator survives; a second transform shares it too — rounds at
+    an already-seen STEP but new content must still be compared
+    (review finding: a bare-step key dropped post-reform rounds
+    forever, exactly when desync is most likely)."""
+    e = HealthEvaluator()
+    agree = [[7], [7], [7], [7]]
+    e.ingest_checksums(32, 0, ["b0"], [[1.0]] * 4, agree)
+    # same step, same content: the pmap-device duplicate — deduped
+    e.ingest_checksums(32, 1, ["b0"], [[1.0]] * 4, agree)
+    assert e.snapshot()["checks"]["checksum_rounds"] == 1
+    # same step, NEW content (post-reform divergence): compared
+    e.ingest_checksums(32, 0, ["b0"], [[1.0]] * 4,
+                       [[7], [7], [9], [7]])
+    assert e.snapshot()["checks"]["checksum_rounds"] == 2
+    (v,) = e.verdicts()
+    assert v["kind"] == "replica_desync" and v["worker"] == 2
+
+
+def test_checksum_even_split_convicts_no_single_replica():
+    """With NO majority (half the replicas each way) the tie must not
+    be broken by insertion order — either half could be the diverged
+    one, and convicting the lexically-later half would point the
+    operator at healthy hosts (review finding)."""
+    e = HealthEvaluator()
+    e.ingest_checksums(8, 0, ["b0"], [[1.0]] * 4,
+                       [[7], [7], [9], [9]])
+    (v,) = e.verdicts()
+    assert v["kind"] == "replica_desync"
+    assert v["worker"] == -1          # no single culprit
+    assert "no majority" in v["detail"]
+    # clears once the checksums agree again
+    e.ingest_checksums(9, 0, ["b0"], [[1.0]] * 4, [[7]] * 4)
+    assert e.healthy
+
+
+def test_nan_residual_fires_drift_verdict():
+    """NaN > bar is False: a NaN residual norm — the terminal drift
+    state, with possibly-finite raw gradients — needs its explicit
+    arm (review finding: it produced no verdict at all)."""
+    e = HealthEvaluator()
+    e.ingest_residual(3, 0, 1, float("nan"))
+    (v,) = e.verdicts()
+    assert v["kind"] == "residual_drift" and v["bucket"] == 1
+    # ... and the taps' delivery mask forwards NaN (absent == -1.0
+    # exactly, not `>= 0`)
+    got = []
+    e2 = HealthEvaluator()
+    e2.ingest_residual = lambda *a, **k: got.append(a)
+    old = health.swap_evaluator(e2)
+    try:
+        htaps._deliver_stats(("b0", "b1"), 1, 0, [1.0, 1.0],
+                             [1.0, 1.0], [0, 0],
+                             [float("nan"), -1.0])
+    finally:
+        health.swap_evaluator(old)
+    assert len(got) == 1 and math.isnan(got[0][3])
+
+
+def test_evaluator_thresholds_follow_live_config(monkeypatch):
+    """Config-backed thresholds are honored (review finding: the
+    validated Config fields were dead — the evaluator re-parsed the
+    env unvalidated); a direct-env evaluator refuses a <= 1 bar."""
+    import horovod_tpu.runtime as runtime
+    cfg = runtime._state().config
+    if cfg is not None:
+        monkeypatch.setattr(cfg, "health_grad_factor", 7.5)
+        assert health._thresholds()[0] == 7.5
+    else:
+        monkeypatch.setenv("HOROVOD_HEALTH_GRAD_FACTOR", "0.5")
+        assert health._thresholds()[0] == 10.0   # refused, default
+
+
+def test_desync_key_for_removed_replica_clears_after_downsize():
+    """A convicted replica index beyond the new axis size (elastic
+    downsize — the evaluator survives re-init) must clear once the
+    survivors agree, or the verdict sticks forever (review finding)."""
+    e = HealthEvaluator()
+    e.ingest_checksums(4, 0, ["b0"], [[1.0]] * 4,
+                       [[7], [7], [7], [9]])   # replica 3 convicted
+    assert not e.healthy
+    # re-formed 3-way job, everyone agrees
+    e.ingest_checksums(1, 0, ["b0"], [[1.0]] * 3, [[5], [5], [5]])
+    assert e.healthy, e.snapshot()["active"]
+
+
+def test_staleness_key_for_removed_group_clears_after_shrink():
+    e = HealthEvaluator()
+    e.ingest_staleness(1, "bkt", [0, 0, 4], cap=4, bucket=0)
+    assert not e.healthy
+    e.ingest_staleness(2, "bkt", [0, 0], cap=4, bucket=0)  # 2 groups now
+    assert e.healthy, e.snapshot()["active"]
+
+
+def test_checksum_dedup_evicts_oldest_not_random():
+    """Eviction must keep the NEWEST keys (set-order slicing could
+    drop the in-flight round and let sibling pmap devices recount it
+    — review finding)."""
+    e = HealthEvaluator()
+    for i in range(1030):
+        e.ingest_checksums(i, 0, ["b0"], [[float(i)]] * 2,
+                           [[i], [i]])
+    rounds = e.snapshot()["checks"]["checksum_rounds"]
+    # the just-added round stays deduped for its sibling deliveries
+    e.ingest_checksums(1029, 1, ["b0"], [[1029.0]] * 2,
+                       [[1029], [1029]])
+    assert e.snapshot()["checks"]["checksum_rounds"] == rounds
+
+
+def test_merge_job_health_flags_unmonitored_workers():
+    """HOROVOD_HEALTH=0 snapshots are vacuously healthy; the job
+    verdict must degrade, not confidently report healthy (review
+    finding)."""
+    off = _snap(1, "h1")
+    off["enabled"] = False
+    job = health.merge_job_health({"0": dict(_snap(0, "h0"),
+                                             enabled=True),
+                                   "1": off})
+    assert job["verdict"] == "degraded"
+    assert job["unmonitored"] == ["1"]
+    assert "MONITORING OFF" in health.render_job_health(job)
+
+
+def test_sharded_corrupt_site_carries_real_tensor_name(ev):
+    """Under sharded_update the corrupt site (and the taps) must see
+    the same tensor names as the other fused paths — a name= matcher
+    was silently inert there (review finding)."""
+    sched = chaos.FaultSchedule.parse(
+        "collective.corrupt bucket=1 nth=1 action=nan:2", seed=7)
+    chaos.install(sched)
+    try:
+        f, st, _tx = _make_step(check_every=100, sharded=True)
+        _run(f, st, steps=1)
+    finally:
+        chaos.uninstall()
+    fired = sched.fired_at("collective.corrupt")
+    assert fired and fired[0][2]["name"] == "['b']", fired
+    hits = [v for v in ev.verdicts() if v["kind"] == "nonfinite"]
+    assert hits and "['b']" in hits[0]["detail"], ev.verdicts()
+
+
+def test_checksum_nan_sums_with_equal_xors_agree():
+    """NaN != NaN must not fake a desync: the xor is the comparison
+    key, the sum only rides the detail (review-class regression)."""
+    e = HealthEvaluator()
+    nan = float("nan")
+    e.ingest_checksums(2, 0, ["b0"], [[nan], [nan], [nan], [nan]],
+                       [[7], [7], [7], [7]])
+    assert e.healthy, e.verdicts()
+
+
+def test_verdicts_ride_flight_recorder_and_hook():
+    got = []
+    e = HealthEvaluator(on_unhealthy=lambda v: got.append(v))
+    before = len([ev for ev in hvd_metrics.flight_events()
+                  if ev.get("kind") == "health.verdict"])
+    e.ingest_bucket(7, 1, 0, "a", 0.0, 0.0, 2)
+    assert got and got[0]["kind"] == "nonfinite"
+    after = [ev for ev in hvd_metrics.flight_events()
+             if ev.get("kind") == "health.verdict"]
+    assert len(after) == before + 1
+    assert after[-1]["worker"] == 1 and after[-1]["step"] == 7
+
+
+def test_snapshot_and_summary_shape():
+    e = HealthEvaluator()
+    e.process, e.host = 3, "hostX"
+    e.ingest_bucket(1, 3, 0, "a", 2.0, 1.0, 0)
+    snap = e.snapshot()
+    assert snap["process"] == 3 and snap["host"] == "hostX"
+    assert snap["healthy"] and snap["checks"]["stats_ingested"] == 1
+    assert "a" in snap["buckets"]   # keyed by bucket NAME
+    json.dumps(snap)   # RPC-serializable
+    s = e.summary()
+    assert s["healthy"] and s["last_step"] == 1 and s["verdicts"] == 0
+
+
+# ---------------------------------------------------------------------------
+# in-jit taps on a real 4-way mapped mesh
+# ---------------------------------------------------------------------------
+
+def test_clean_run_verdict_free_with_sentinel_cadence(ev):
+    f, st, _tx = _make_step(check_every=2)
+    _run(f, st, steps=4)
+    assert ev.healthy, ev.verdicts()
+    snap = ev.snapshot()
+    assert snap["last_step"] == 4
+    # cadence: steps 2 and 4 ran the sentinel
+    assert snap["checks"]["checksum_rounds"] == 2
+    # per-bucket stats flowed for both buckets (keyed by name)
+    assert snap["checks"]["stats_ingested"] > 0
+    assert {"['a']", "['b']"} <= set(snap["buckets"])
+
+
+def test_corrupt_nan_seed_named_with_rank_and_bucket(ev):
+    """The acceptance pin: a pinned collective.corrupt seed on the
+    4-way CPU mesh is flagged with correct (worker, bucket)
+    attribution, and the injections counter proves the seed was not
+    inert (the collective.dcn pattern)."""
+    def count_injections():
+        snap = hvd_metrics.snapshot()
+        fam = (snap.get("families") or {}).get(
+            "hvd_chaos_injections_total")
+        if not fam:
+            return 0.0
+        return sum(s["value"] for s in fam["series"]
+                   if s["labels"].get("site") == "collective.corrupt")
+
+    before = count_injections()
+    sched = chaos.FaultSchedule.parse(
+        "collective.corrupt bucket=1 nth=1 action=nan:2", seed=7)
+    chaos.install(sched)
+    try:
+        f, st, _tx = _make_step(check_every=2)
+        _run(f, st, steps=2)
+    finally:
+        chaos.uninstall()
+    fired = sched.fired_at("collective.corrupt")
+    assert fired, "corruption seed was inert"
+    assert fired[0][2]["bucket"] == 1
+    assert count_injections() == before + 1
+    hits = [v for v in ev.verdicts() if v["kind"] == "nonfinite"]
+    assert hits, ev.verdicts()
+    assert (hits[0]["worker"], hits[0]["bucket"]) == (2, 1)
+    # ... and other ranks'/buckets' lanes stayed clean
+    assert not [v for v in ev.verdicts()
+                if v["kind"] == "nonfinite"
+                and (v["worker"], v["bucket"]) != (2, 1)]
+
+
+def test_corrupt_scale_seed_triggers_grad_explosion(ev):
+    # warm the per-bucket EWMA baseline on a clean compiled step first
+    f, st, _tx = _make_step(check_every=100)
+    _run(f, st, steps=_WARMUP + 1)
+    assert ev.healthy
+    # a FRESH transform traces a new program under the seed (in-jit
+    # corrupt rules are evaluated at trace time)
+    sched = chaos.FaultSchedule.parse(
+        "collective.corrupt bucket=0 nth=1 action=scale:1,1e6", seed=3)
+    chaos.install(sched)
+    try:
+        f2, st2, _tx2 = _make_step(check_every=100)
+        _run(f2, st2, steps=1)
+    finally:
+        chaos.uninstall()
+    hits = [v for v in ev.verdicts() if v["kind"] == "grad_explosion"]
+    assert hits, ev.verdicts()
+    assert (hits[0]["worker"], hits[0]["bucket"]) == (1, 0)
+
+
+def test_sentinel_convicts_desynced_replica(ev):
+    """One silently diverged replica is exactly the desync the
+    sentinel exists to catch: the allgathered checksums disagree and
+    the MINORITY replica is convicted with bucket attribution."""
+    f, st, _tx = _make_step(check_every=1, params_in_axes=0)
+    _run(f, st, steps=1, params=_stack_params(odd=3),
+         params_stacked=True)
+    desync = [v for v in ev.verdicts() if v["kind"] == "replica_desync"]
+    assert desync, ev.verdicts()
+    assert all(v["bucket"] is not None for v in desync)
+    assert {v["worker"] for v in desync} == {3}
+
+
+def test_k2_taps_fire_on_accumulation_boundary_only(ev):
+    f, st, _tx = _make_step(check_every=1, k=2)
+    _run(f, st, steps=4)
+    snap = ev.snapshot()
+    assert ev.healthy
+    # boundaries at count 2 and 4 → exactly two sentinel rounds even
+    # at check_every=1 (intermediate micro-steps move no gradients and
+    # observe nothing)
+    assert snap["checks"]["checksum_rounds"] == 2
+    assert snap["last_step"] == 4
+
+
+def test_k2_sentinel_cadence_counts_boundaries_not_microsteps(ev):
+    """check_every divides the BOUNDARY ordinal, not the raw count
+    (review finding: count%every aliased against k — k=check_every
+    would have gathered at EVERY boundary)."""
+    f, st, _tx = _make_step(check_every=2, k=2)
+    _run(f, st, steps=4)             # boundary ordinals 1, 2
+    assert ev.snapshot()["checks"]["checksum_rounds"] == 1
+
+
+def test_sentinel_buckets_follow_gradient_plan_under_mixed_precision():
+    """The sentinel checksums the PARAMS but buckets them by the
+    GRADIENT plan: fp32 params over bf16 grads split differently at a
+    byte threshold, and a desync verdict naming a params-planned
+    bucket id would point operators at the wrong bucket (review
+    finding)."""
+    from horovod_tpu.optim.distributed import (_plan_buckets,
+                                               _sentinel_bucket_flats,
+                                               _tree_leaves_sorted)
+    params = {"a": jnp.zeros((16,), jnp.float32),
+              "b": jnp.zeros((16,), jnp.float32)}
+    grads = {"a": jnp.zeros((16,), jnp.bfloat16),
+             "b": jnp.zeros((16,), jnp.bfloat16)}
+    thr = 64   # bf16: both leaves (32 B each) fuse; fp32 (64 B): split
+    flats = _sentinel_bucket_flats(params, grads, "average", 1.0, 1.0,
+                                   thr)
+    g_leaves, g_names, _ = _tree_leaves_sorted(grads)
+    g_buckets, _ = _plan_buckets(g_leaves, g_names, "average", 1.0,
+                                 1.0, thr)
+    assert len(flats) == len(g_buckets)
+    # ... and the flat buffers hold the TARGET's (params) lanes
+    assert all(buf.dtype == jnp.float32 for _bid, _n, buf in flats)
+
+
+def test_sharded_update_composes_without_state_false_positives(ev):
+    """sharded_update keeps 1/N inner state per worker BY DESIGN — the
+    sentinel must checksum only the replicated params/updates, never
+    the sharded state, or every step would read as desync."""
+    f, st, _tx = _make_step(check_every=1, sharded=True)
+    _run(f, st, steps=3)
+    assert ev.healthy, ev.verdicts()
+    assert ev.snapshot()["checks"]["checksum_rounds"] == 3
+
+
+def test_health_off_is_trace_time_false_branch():
+    tx_off = DistributedOptimizer(optax.sgd(1e-2), axis_name=AXIS,
+                                  threshold_bytes=THRESHOLD,
+                                  health=False)
+    tx_on = DistributedOptimizer(optax.sgd(1e-2), axis_name=AXIS,
+                                 threshold_bytes=THRESHOLD, health=True,
+                                 health_check_every=1)
+
+    def mk(tx):
+        def step(g, p):
+            state = tx.init(p)
+            u, _ = tx.update(g, state, p)
+            return u
+        spec = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), PARAMS)
+        return str(jax.make_jaxpr(step, axis_env=[(AXIS, 2)])(spec, spec))
+
+    off, on = mk(tx_off), mk(tx_on)
+    assert "debug_callback" not in off and "all_gather" not in off
+    assert "debug_callback" in on and "all_gather" in on
+
+
+def test_env_default_off_matches_explicit_off(monkeypatch):
+    """health=None without HOROVOD_HEALTH_TAPS resolves to OFF — the
+    existing pinned schedules depend on it (the config-backed default
+    is covered by the pinned snapshots staying byte-identical)."""
+    monkeypatch.delenv("HOROVOD_HEALTH_TAPS", raising=False)
+    assert not health.taps_default()
+    monkeypatch.setenv("HOROVOD_HEALTH_TAPS", "1")
+    assert health.taps_default()
+
+
+def test_builtin_snapshots_unchanged_and_health_entry_pinned():
+    """distopt_step must trace byte-identically to its committed
+    snapshot with the health plane merged (HOROVOD_HEALTH default on),
+    and the taps-on schedule is its own pinned entry."""
+    from horovod_tpu.analysis import schedule as sched_mod
+    assert sched_mod.check_builtin_snapshots(
+        entries=["distopt_step", "health_distopt_step"]) == []
+    h = sched_mod.builtin_schedule("health_distopt_step")
+    prims = [r.prim for r in h.records]
+    assert "all_gather" in prims   # the sentinel's one schedule delta
+    base = sched_mod.builtin_schedule("distopt_step")
+    assert [r.prim for r in base.records] == \
+        [p for p in prims if p != "all_gather"]
+
+
+def test_health_requires_axis_and_rejects_overlap():
+    with pytest.raises(ValueError, match="health=True requires"):
+        DistributedOptimizer(optax.sgd(1e-2), health=True)
+    with pytest.raises(ValueError, match="not supported with overlap"):
+        DistributedOptimizer(optax.sgd(1e-2), axis_name=AXIS,
+                             health=True, overlap=True)
+    with pytest.raises(ValueError, match="health_check_every"):
+        DistributedOptimizer(optax.sgd(1e-2), axis_name=AXIS,
+                             health=True, health_check_every=0)
+
+
+# ---------------------------------------------------------------------------
+# eager engine tap
+# ---------------------------------------------------------------------------
+
+def test_engine_eager_tap_flags_nonfinite(hvd, monkeypatch):
+    # the eager tap SAMPLES at the check-every cadence (the readback
+    # must not tax every dispatch); observe every cycle for this test
+    monkeypatch.setattr(health, "SAMPLE_EVERY", 1)
+    fresh = HealthEvaluator()
+    old = health.swap_evaluator(fresh)
+    try:
+        bad = np.ones((4,), np.float32)
+        bad[1] = np.nan
+        out = hvd.allreduce(bad, op=hvd.Sum, name="health_eager_nan")
+        np.asarray(out)
+    finally:
+        health.swap_evaluator(old)
+    hits = [v for v in fresh.verdicts() if v["kind"] == "nonfinite"]
+    assert hits, fresh.verdicts()
+    assert hits[0]["worker"] == 0   # this process's contribution
+
+
+def test_engine_stats_health_section(hvd):
+    import horovod_tpu.runtime as runtime
+    stats = runtime._state().engine.stats()
+    assert "health" in stats
+    assert set(stats["health"]) >= {"healthy", "verdicts", "kinds",
+                                    "last_step"}
+
+
+# ---------------------------------------------------------------------------
+# exposition: merge, scrape, driver route, CLI
+# ---------------------------------------------------------------------------
+
+def _snap(process, host, verdicts=(), healthy=None):
+    return {"process": process, "host": host,
+            "healthy": not verdicts if healthy is None else healthy,
+            "active": list(verdicts), "verdicts": list(verdicts),
+            "counts": {}, "last_step": 5,
+            "checks": {"stats_ingested": 1, "checksum_rounds": 0,
+                       "loss_observations": 0},
+            "buckets": {}}
+
+
+def test_merge_job_health_verdict_states():
+    bad = dict(kind="nonfinite", worker=2, bucket=1, step=9,
+               detail="x", wall=0.0)
+    job = health.merge_job_health(
+        {"0": _snap(0, "h0"), "1": _snap(1, "h1", verdicts=[bad])})
+    assert job["verdict"] == "unhealthy"
+    assert job["verdicts"][0]["worker_id"] == "1"
+    assert job["counts"] == {"nonfinite": 1}
+    job2 = health.merge_job_health({"0": _snap(0, "h0")},
+                                   unreachable={"1": "boom"})
+    assert job2["verdict"] == "degraded"
+    job3 = health.merge_job_health({"0": _snap(0, "h0")})
+    assert job3["verdict"] == "healthy"
+    assert json.loads(json.dumps(job))["workers"]["1"]["healthy"] is False
+    # RECOVERED worker: historical verdicts ride as evidence but only
+    # ACTIVE conditions hold the job unhealthy (review finding: a
+    # transient spike must not stick the verdict — and the hvddoctor
+    # exit code — at unhealthy forever)
+    recovered = _snap(1, "h1", healthy=True)
+    recovered["verdicts"] = [bad]      # history only, nothing active
+    job4 = health.merge_job_health({"0": _snap(0, "h0"),
+                                    "1": recovered})
+    assert job4["verdict"] == "healthy"
+    assert job4["verdicts"]           # the evidence still rides
+
+
+def test_scrape_job_health_parallel_with_unreachable():
+    from _helpers import free_port
+    ev_a = HealthEvaluator()
+    ev_a.process, ev_a.host = 0, "hostA"
+    bad = dict(kind="grad_explosion", worker=0, bucket=0, step=3,
+               detail="boom", wall=0.0)
+    srv_a = JsonRpcServer({"health_pull": lambda p: ev_a.snapshot()},
+                          secret=None)
+    srv_b = JsonRpcServer(
+        {"health_pull": lambda p: _snap(1, "hostB", verdicts=[bad])},
+        secret=None)
+    dead = free_port()
+    try:
+        job = health.scrape_job_health(
+            {"0": ("127.0.0.1", srv_a.port),
+             "1": ("127.0.0.1", srv_b.port),
+             "2": ("127.0.0.1", dead)},
+            timeout=1.0, secret=None)
+    finally:
+        srv_a.close()
+        srv_b.close()
+    assert job["scraped"] == 2
+    assert "2" in job["unreachable"]
+    assert job["verdict"] == "unhealthy"     # verdicts beat degraded
+    assert job["verdicts"][0]["worker_id"] == "1"
+
+
+def test_local_health_get_route():
+    fresh = HealthEvaluator()
+    fresh.process, fresh.host = 0, "solo"
+    old = health.swap_evaluator(fresh)
+    try:
+        srv = JsonRpcServer({}, secret=None)
+        from horovod_tpu.metrics import aggregate
+        raw = aggregate.scrape("127.0.0.1", srv.port, route="health")
+        srv.close()
+    finally:
+        health.swap_evaluator(old)
+    body = json.loads(raw)
+    assert body["host"] == "solo" and body["enabled"] is True
+
+
+def test_elastic_driver_health_job_route_end_to_end():
+    """The REAL ElasticDriver serves GET /health/job: registered worker
+    notification endpoints are scraped (HMAC-signed health_pull over
+    the keep-alive pool) and merged into one job verdict."""
+    import urllib.request
+
+    from _helpers import free_port
+    from horovod_tpu.elastic.discovery import HostDiscovery
+    from horovod_tpu.elastic.driver import ElasticDriver
+
+    class StubDiscovery(HostDiscovery):
+        def find_available_hosts_and_slots(self):
+            return {}
+
+    driver = ElasticDriver(StubDiscovery(), ["true"], min_np=1,
+                           port=free_port())
+    ev_a = HealthEvaluator()
+    ev_a.process, ev_a.host = 0, "host0"
+    ev_a.ingest_bucket(11, 2, 1, "b", 0.0, 0.0, 4)   # nonfinite verdict
+    ev_b = HealthEvaluator()
+    ev_b.process, ev_b.host = 1, "host1"
+    # workers' servers verify the job secret the driver minted — the
+    # same signed path a live job's health_pull rides
+    workers = [JsonRpcServer({"health_pull": lambda p, e=e: e.snapshot()})
+               for e in (ev_a, ev_b)]
+    try:
+        with driver._lock:
+            for i, s in enumerate(workers):
+                driver._notif[i] = ("127.0.0.1", s.port)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{driver.port}/health/job",
+                timeout=30.0) as resp:
+            job = json.loads(resp.read().decode())
+    finally:
+        driver._server.close()
+        if driver._kv_server is not None:
+            driver._kv_server.close()
+        for s in workers:
+            s.close()
+    assert job["verdict"] == "unhealthy"
+    assert job["scraped"] == 2 and not job["unreachable"]
+    (v,) = job["verdicts"]
+    assert (v["kind"], v["worker"], v["bucket"], v["worker_id"]) == \
+        ("nonfinite", 2, 1, "0")
+
+
+def test_hvddoctor_cli_table_json_and_exit_codes(tmp_path, capsys):
+    from horovod_tpu.health.__main__ import main
+    bad = dict(kind="nonfinite", worker=2, bucket=1, step=9,
+               detail="3 nonfinite lane(s)", wall=0.0)
+    job = health.merge_job_health(
+        {"0": _snap(0, "h0", verdicts=[bad]), "1": _snap(1, "h1")})
+    path = tmp_path / "health.json"
+    path.write_text(json.dumps(job))
+    assert main([str(path)]) == 1          # unhealthy
+    out = capsys.readouterr().out
+    assert "job health: UNHEALTHY" in out
+    assert "nonfinite" in out and "worker" in out
+    assert main(["--json", str(path)]) == 1
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["verdict"] == "unhealthy"
+    ok = health.merge_job_health({"0": _snap(0, "h0")})
+    okp = tmp_path / "ok.json"
+    okp.write_text(json.dumps(ok))
+    assert main([str(okp)]) == 0
+    capsys.readouterr()
+
+
+def test_note_loss_module_api(ev):
+    for i in range(_WARMUP):
+        health.note_loss(1.0, step=i)
+    health.note_loss(50.0, step=_WARMUP)
+    assert [v["kind"] for v in ev.verdicts()] == ["loss_spike"]

@@ -175,6 +175,67 @@ def test_merge_render_escapes_label_values():
         assert labels["rule"] == rule and value == 1.0
 
 
+def test_scrape_and_merge_unreachable_worker_gauge_attribution():
+    """The /metrics/job degrade path with a worker unreachable
+    MID-merge (ISSUE 13 satellite): the merged gauge min/max must be
+    recomputed over — and attributed to — the SURVIVING workers only,
+    and the dead worker becomes a comment line, never a failed scrape
+    or a phantom series."""
+    from _helpers import free_port
+    from horovod_tpu.runner.rpc import JsonRpcServer
+
+    def reg_with_gauge(value):
+        reg = MetricRegistry()
+        reg.gauge("w_depth", "queue depth").set(value)
+        return reg
+
+    def route_for(reg):
+        return lambda: (200, "text/plain; version=0.0.4",
+                        reg.render_prometheus())
+
+    srv_a = JsonRpcServer({}, secret=None,
+                          get_routes={"metrics":
+                                      route_for(reg_with_gauge(10.0))})
+    srv_b = JsonRpcServer({}, secret=None,
+                          get_routes={"metrics":
+                                      route_for(reg_with_gauge(30.0))})
+    dead = free_port()   # worker "2" held the (hypothetical) max; gone
+    try:
+        text = aggregate.scrape_and_merge(
+            {"0": ("127.0.0.1", srv_a.port),
+             "1": ("127.0.0.1", srv_b.port),
+             "2": ("127.0.0.1", dead)}, timeout=1.0)
+    finally:
+        srv_a.close()
+        srv_b.close()
+    assert "aggregated over 2 worker(s)" in text
+    assert any(line.startswith("# worker 2 unreachable")
+               for line in text.splitlines()), text
+    fams = aggregate.parse_prometheus(text)
+    gs = {(lbl.get("agg"), lbl.get("worker")): v
+          for _, lbl, v in fams["w_depth"]["samples"]}
+    # attribution over the survivors only — and the sum excludes the
+    # corpse instead of double-counting stale values
+    assert gs == {("min", "0"): 10.0, ("max", "1"): 30.0,
+                  ("sum", None): 40.0}
+
+
+def test_merge_single_surviving_worker_owns_min_and_max():
+    """Degenerate degrade: every peer unreachable but one — min AND
+    max both attribute to the lone survivor (the attribution must not
+    assume two distinct owners)."""
+    reg = MetricRegistry()
+    reg.gauge("w_depth", "queue depth", labels=("lane",)).set(
+        7.0, lane="rx")
+    per_worker = {"3": aggregate.parse_prometheus(
+        reg.render_prometheus())}
+    merged = aggregate.merge(per_worker)
+    gs = {(lbl.get("agg"), lbl.get("worker"), lbl.get("lane")): v
+          for _, lbl, v in merged["w_depth"]["samples"]}
+    assert gs == {("min", "3", "rx"): 7.0, ("max", "3", "rx"): 7.0,
+                  ("sum", None, "rx"): 7.0}
+
+
 def test_merge_rejects_mismatched_bucket_edges():
     reg_a = MetricRegistry()
     reg_a.histogram("h_seconds", lo=-2, hi=2).observe(1.0)

@@ -41,7 +41,10 @@
 #      convergence gate, byte conservation) — and tools/hvdtrace
 #      --smoke — merged-trace critical-path attribution over the
 #      recorded chaos-seeded 4-host fixture (the injected straggler
-#      must be the verdict)
+#      must be the verdict) — and tools/hvddoctor --smoke —
+#      training-health verdict under a pinned collective.corrupt seed
+#      (the evaluator must name the injected rank+bucket via
+#      GET /health/job; the clean run must stay verdict-free)
 #  11. hvdsched: re-trace the builtin step entries to jaxprs on CPU and
 #      diff their collective schedules against tests/schedules/
 #      (HVD211 drift; incl. the sharded_distopt_step reduce_scatter →
@@ -186,6 +189,39 @@ finally:
 assert list(present) == [1.0, 0.0], present
 assert insp.straggler_scores()[1] > 0, insp.straggler_scores()
 
+# training-health verdict plane (ISSUE 13): the fused dispatches above
+# fed the eager numerics taps; the local GET /health route serves this
+# worker's slice, and a driver-shaped GET /health/job merges >=2
+# workers into one job verdict (healthy here — the corrupt-seeded
+# unhealthy path is stage 10's hvddoctor smoke)
+import horovod_tpu.health as hhealth
+from horovod_tpu.health.evaluate import HealthEvaluator
+assert hhealth.ACTIVE
+hlocal = json.loads(aggregate.scrape("127.0.0.1", srv.port,
+                                     route="health"))
+assert hlocal["enabled"] and hlocal["healthy"], hlocal
+assert hlocal["checks"]["stats_ingested"] >= 1, hlocal["checks"]
+hevB = HealthEvaluator()
+hevB.process, hevB.host = 1, "cismoke-hostB"
+hsrvA = JsonRpcServer({"health_pull": hhealth.pull_handler}, secret=None)
+hsrvB = JsonRpcServer({"health_pull": lambda p: hevB.snapshot()},
+                      secret=None)
+h_endpoints = {"0": ("127.0.0.1", hsrvA.port),
+               "1": ("127.0.0.1", hsrvB.port)}
+def _health_job_route():
+    return (200, "application/json",
+            json.dumps(hhealth.scrape_job_health(h_endpoints,
+                                                 secret=None)))
+hjsrv = JsonRpcServer({}, secret=None,
+                      get_routes={"health/job": _health_job_route})
+hjob = json.loads(aggregate.scrape("127.0.0.1", hjsrv.port,
+                                   route="health/job"))
+assert hjob["verdict"] == "healthy", hjob
+assert hjob["scraped"] >= 2, hjob
+assert not hjob["verdicts"], hjob["verdicts"]
+for _s in (hsrvA, hsrvB, hjsrv):
+    _s.close()
+
 # job-wide distributed trace (ISSUE 12): the negotiation rounds above
 # recorded spans into the installed tracer; serve them plus a second
 # simulated host's buffer and scrape GET /trace/job (the driver-shaped
@@ -243,13 +279,16 @@ tail_rounds = _family_count("hvd_tail_rounds_total", policy="bounded")
 assert tail_rounds >= 1, fams["hvd_tail_rounds_total"]["samples"]
 straggler = _family_count("hvd_straggler_score", process="1")
 assert straggler > 0, fams["hvd_straggler_score"]["samples"]
+# eager numerics taps fed the health gauge family on this process
+assert "hvd_health_grad_norm" in fams, sorted(fams)
 srv.close()
 
 hvd.shutdown()
-print(f"dist smoke OK (incl. /metrics + /healthz + /trace/job scrape, "
-      f"{int(watch_rounds)} watch rounds, {int(reuse_hits)} keep-alive "
-      f"hits, {int(overlap_buckets)} overlap buckets, "
-      f"{len(host_pids)} trace host pids), imported from",
+print(f"dist smoke OK (incl. /metrics + /healthz + /trace/job + "
+      f"/health/job scrape, {int(watch_rounds)} watch rounds, "
+      f"{int(reuse_hits)} keep-alive hits, {int(overlap_buckets)} "
+      f"overlap buckets, {len(host_pids)} trace host pids, job health "
+      f"{hjob['verdict']}), imported from",
       os.path.dirname(hvd.__file__))
 PYEOF
   )
@@ -341,6 +380,14 @@ tail -1 /tmp/ci_bench_tail.log
 bash tools/hvdtrace --smoke > /tmp/ci_hvdtrace.log 2>&1 \
   || { tail -30 /tmp/ci_hvdtrace.log; exit 1; }
 tail -1 /tmp/ci_hvdtrace.log
+# training-health doctor: under the pinned collective.corrupt seed on a
+# 4-way CPU mesh, the evaluator must name the injected (rank, bucket),
+# the verdict must surface through a driver-shaped GET /health/job
+# scrape, and the clean run must stay verdict-free
+# (docs/observability.md "Training health")
+bash tools/hvddoctor --smoke > /tmp/ci_hvddoctor.log 2>&1 \
+  || { tail -30 /tmp/ci_hvddoctor.log; exit 1; }
+tail -1 /tmp/ci_hvddoctor.log
 
 echo "== 11/11 hvdsched: collective-schedule snapshots + consistency =="
 # re-trace every builtin step entry to a jaxpr on CPU, diff against the
@@ -348,7 +395,9 @@ echo "== 11/11 hvdsched: collective-schedule snapshots + consistency =="
 # an explicit `tools/hvdsched --update` in review) and require identical
 # canonical schedules across mesh sizes (HVD210); incl. the
 # overlapped_distopt_step entry whose per-layer collectives must sit
-# inside the backward-scan sub-jaxpr
+# inside the backward-scan sub-jaxpr, and the health_distopt_step entry
+# whose ONLY delta vs distopt_step is the divergence sentinel's
+# checksum all_gather under its cadence cond
 bash tools/hvdsched --check --consistency
 
 echo "CI matrix: all stages green"
