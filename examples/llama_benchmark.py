@@ -60,8 +60,9 @@ def main():
     p.add_argument("--overlap", action="store_true",
                    help="overlapped gradient dispatch: per-layer fusion "
                         "buckets fire inside the backward scan "
-                        "(dp-only; the one-command real-chip A/B for "
-                        "HOROVOD_OVERLAP — run with and without)")
+                        "(dp-only dense meshes, or with --fsdp; the "
+                        "one-command real-chip A/B for HOROVOD_OVERLAP "
+                        "— run with and without)")
     args = p.parse_args()
 
     hvd.init()
@@ -75,19 +76,45 @@ def main():
     seq = args.seq_len or cfg.max_seq_len
     pmesh = ParallelMesh(mc)
     if args.fsdp:
-        if args.zero1 or args.attn != "ring" or args.tp > 1 \
-                or args.sp > 1 or args.pp > 1 or args.grad_accum \
-                or args.overlap:
-            p.error("--fsdp composes with dp only; drop "
-                    "--zero1/--attn/--tp/--sp/--pp/--grad-accum/"
-                    "--overlap")
-        ts = training.make_llama_fsdp_step(cfg, pmesh)
+        # capability-gated refusals: each names exactly WHICH
+        # composition is unsupported and why (blanket "dp only" hid
+        # that --fsdp --overlap now composes; ISSUE 14)
+        if args.moe:
+            p.error("--fsdp does not support --moe: expert parallelism "
+                    "aliases ep onto dp, so expert weights are "
+                    "dp-sharded by routing and the dp-gathered FSDP "
+                    "working copy would mix different experts across "
+                    "ranks (pinned; use the non-fsdp MoE path)")
+        for flag, name in ((args.tp > 1, "--tp"), (args.sp > 1, "--sp"),
+                           (args.pp > 1, "--pp")):
+            if flag:
+                p.error(f"--fsdp does not compose with {name}: the "
+                        f"model is sharded over that axis, but the "
+                        f"fsdp step only gathers/scatters over dp")
+        if args.zero1:
+            p.error("--fsdp already shards the optimizer state over "
+                    "dp (ZeRO-3 class includes ZeRO-1); --zero1 is "
+                    "redundant — drop it")
+        if args.grad_accum:
+            p.error("--fsdp does not support --grad-accum yet: the "
+                    "in-step microbatch scan is built by "
+                    "make_llama_train_step only")
+        if args.attn != "ring":
+            p.error("--fsdp uses the default attention; drop --attn "
+                    "(sequence-parallel attention needs an sp axis, "
+                    "which fsdp does not compose with)")
+        ts = training.make_llama_fsdp_step(cfg, pmesh,
+                                           overlap=args.overlap)
     else:
         if args.overlap and (args.tp > 1 or args.sp > 1 or args.pp > 1
                              or args.zero1 or args.grad_accum
                              or args.moe):
-            p.error("--overlap composes with dp-only dense meshes; "
-                    "drop --tp/--sp/--pp/--zero1/--grad-accum/--moe")
+            p.error("--overlap composes with dp-only dense meshes "
+                    "(and with --fsdp): drop --tp/--sp/--pp/--zero1/"
+                    "--grad-accum/--moe — MoE stays refused because ep "
+                    "aliases onto dp and dp-averaging taps would "
+                    "corrupt dp-sharded expert weights (pinned); "
+                    "tp/sp/pp need the check_vma transpose psums")
         ts = training.make_llama_train_step(
             cfg, pmesh, attn=args.attn, zero1=args.zero1,
             grad_accum=args.grad_accum,
@@ -111,7 +138,8 @@ def main():
 
     for _ in range(args.num_warmup):
         params, opt_state, loss = ts.step_fn(params, opt_state, toks, tgts)
-    jax.block_until_ready(loss)
+    if args.num_warmup:
+        jax.block_until_ready(loss)
     t0 = time.perf_counter()
     for _ in range(args.num_iters):
         params, opt_state, loss = ts.step_fn(params, opt_state, toks, tgts)

@@ -520,6 +520,69 @@ def _entry_health_distopt_step():
     return step, (spec, spec)
 
 
+#: fixed model axis of the spec-aware (fsdp) entry: the consistency
+#: check varies the DATA axis through ``_AXIS`` — mesh shapes 2x2 and
+#: 4x2 — while the model-shard degree stays 2.
+_FSDP_MODEL = 2
+
+
+def _fsdp_grads_spec():
+    """Representative spec-aware gradient pytree: LOCAL (model-shard)
+    shapes for the sharded leaves, full shapes for the replicated ones,
+    in both dtypes — so the plan carries a sharded and a replicated
+    bucket per dtype (mixed-spec leaves must never fuse)."""
+    import jax
+    import jax.numpy as jnp
+    sds = jax.ShapeDtypeStruct
+    return {
+        # full (8, 16) sharded dim0 over hvd_model=2 -> local (4, 16)
+        "dense/kernel": sds((4, 16), jnp.float32),
+        "dense/bias": sds((16,), jnp.float32),
+        # full (32, 8) sharded dim0 -> local (16, 8)
+        "embed/table": sds((16, 8), jnp.bfloat16),
+        "head/bias": sds((4,), jnp.bfloat16),
+        # full (64, 4) sharded dim1 -> local (64, 2)
+        "head/kernel": sds((64, 2), jnp.float32),
+    }
+
+
+def _entry_fsdp_distopt_step():
+    """The mesh-axis-aware composed step (ISSUE 14): param_specs over a
+    2-D (data x model) mesh + ZeRO sharded update.  Model-sharded
+    buckets reduce-scatter their LOCAL shard over the data axis alone —
+    no model-axis collective, no full-width gradient anywhere;
+    replicated buckets psum over the model axis first, then tile over
+    data; every bucket's updates all_gather over data only.  Specs and
+    model_axes pinned explicitly (env-independent: the snapshot must
+    not flip with HOROVOD_MODEL_AXES or the mesh context)."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+    from ..optim.distributed import DistributedOptimizer
+
+    specs = {
+        "dense/kernel": P("hvd_model"),
+        "dense/bias": P(),
+        "embed/table": P("hvd_model"),
+        "head/bias": P(),
+        "head/kernel": P(None, "hvd_model"),
+    }
+    tx = DistributedOptimizer(optax.adam(1e-3), axis_name=_AXIS,
+                              threshold_bytes=_THRESHOLD,
+                              sharded_update=True, wire_format="none",
+                              param_specs=specs,
+                              model_axes=("hvd_model",))
+    spec = _fsdp_grads_spec()
+
+    def step(grads, params):
+        # 1/N-tile state init runs inside the mapped program (issues no
+        # collectives); grads arrive as the locally-owned shards,
+        # pre-reduced over the model axis by the model's transposes
+        state = tx.init(params)
+        updates, _ = tx.update(grads, state, params)
+        return updates
+    return step, (spec, spec), (("hvd_model", _FSDP_MODEL),)
+
+
 #: fixed local (ICI) axis of the hierarchical tail entry: the
 #: consistency check varies the CROSS (DCN) axis — the one the tail
 #: policy rewrites — through ``_AXIS``.
@@ -572,6 +635,7 @@ BUILTIN_ENTRIES = {
     "overlapped_distopt_step": _entry_overlapped_distopt_step,
     "tail_distopt_step": _entry_tail_distopt_step,
     "health_distopt_step": _entry_health_distopt_step,
+    "fsdp_distopt_step": _entry_fsdp_distopt_step,
 }
 
 #: Mesh sizes the consistency check traces every entry at (HVD210).

@@ -54,13 +54,26 @@ def ring_transmit_bytes(record, axis_sizes: Dict[str, int],
     RAISES on a primitive the model doesn't know instead of guessing
     ``in_bytes``: byte-conservation gates (``tools/bench_tail.py``)
     must fail loudly when a schedule grows a collective the accounting
-    silently mis-prices."""
+    silently mis-prices.
+
+    With ``axis_filter`` the collective is priced as the filtered
+    axis's HOP of a hierarchical factoring: ``n`` is that axis's size
+    alone and the operand bytes are what cross it.  A psum over
+    ``(data, model)`` filtered at ``data`` used to be priced with
+    ``n = data*model`` — charging the model-hop bytes to the data
+    (DCN) filter and over-counting the spec-aware sharded schedules,
+    whose psum operands are model-axis SHARDS that only ever ride the
+    data hop (the record's aval is the shard, so the operand bytes are
+    already right; only the ``n`` factoring was not)."""
     axes = [a for a in record.axes if a in axis_sizes]
     if axis_filter is not None and axis_filter not in axes:
         return 0
     n = 1
-    for a in axes:
-        n *= axis_sizes[a]
+    if axis_filter is not None:
+        n = axis_sizes[axis_filter]
+    else:
+        for a in axes:
+            n *= axis_sizes[a]
     if n <= 1:
         return 0
     in_bytes = sum(aval_nbytes(a) for a in record.inputs)

@@ -19,6 +19,9 @@ Current shims (all formerly private helpers in ``optim/distributed.py``
 * :func:`pcast_varying` — ``jax.lax.pcast(..., to="varying")`` under
   the new varying-manual-axes (VMA) tracking; identity on 0.4.x, where
   there is no VMA state to align.
+* :func:`can_shard_map` / :func:`has_new_shard_map` — capability
+  PROBES (not value shims) for the two shard_map API generations;
+  feature gates call these instead of hasattr at the call site.
 
 Deliberately NOT here: a ``check_vma``→``check_rep`` alias for
 ``shard_map`` — the transpose semantics differ between the two APIs
@@ -64,6 +67,37 @@ def psum_scatter(x, axis_name: str):
     shard = x.shape[0] // axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     return lax.dynamic_slice_in_dim(full, idx * shard, shard)
+
+
+def can_shard_map() -> bool:
+    """Capability probe: does this jax build ship a usable ``shard_map``?
+
+    New jax exposes it as ``jax.shard_map``; the 0.4.x line shipped it
+    as ``jax.experimental.shard_map.shard_map`` (with ``check_rep``
+    instead of ``check_vma`` — transpose semantics differ, which is why
+    there is no value shim here, only the PROBE).  Feature gates — e.g.
+    ``training.make_llama_fsdp_step(overlap=True)``, whose tap-armed
+    step is a ``jax.shard_map`` program — call this instead of
+    scattering ``hasattr`` at call sites (ROADMAP item 5), so the
+    capability has ONE definition and both API shapes stay unit-tested
+    (tests/test_compat.py).
+    """
+    if hasattr(jax, "shard_map"):
+        return True
+    try:
+        from jax.experimental.shard_map import shard_map  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def has_new_shard_map() -> bool:
+    """True only for the NEW API shape (``jax.shard_map`` with
+    ``check_vma``) — the one the framework's shard_map call sites
+    target.  The 0.4.x experimental shape probes true under
+    :func:`can_shard_map` but its ``check_rep`` transposes differently,
+    so features needing the new semantics gate on this instead."""
+    return hasattr(jax, "shard_map")
 
 
 def pcast_varying(tree, axis_name: str):

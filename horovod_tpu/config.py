@@ -135,6 +135,14 @@ class Config:
     # bandwidth is scarcest, keep ICI full-precision.  Off = quantize the
     # whole fused reduction even on flat (single-stage) meshes.
     compression_dcn_only: bool = True
+    # model (parameter-sharding) mesh axes of a 2-D+ (data x model)
+    # mesh, comma-separated ("" = none: pure DP).  A spec-aware
+    # DistributedGradientTransform (param_specs=...) infers its model
+    # axes from the specs themselves; this names them when the spec
+    # tree alone cannot — e.g. every leaf replicated on a mesh that
+    # STILL has a model axis, where replicated buckets must reduce over
+    # (data + model) while the specs name no axis at all.
+    model_axes: str = ""
     # negotiated straggler tolerance for the DCN stage of the
     # hierarchical allreduce (OptiReduce's tail prescription): "strict"
     # waits for every host; "bounded" proceeds at the deadline with the
@@ -255,6 +263,16 @@ class Config:
                 f"{c.compression_block_size}")
         c.compression_dcn_only = _env_bool(
             "HOROVOD_COMPRESSION_DCN_ONLY", c.compression_dcn_only)
+        c.model_axes = (_env_str("HOROVOD_MODEL_AXES", c.model_axes)
+                        or "").strip()
+        for _ax in c.model_axes.split(","):
+            # strip BEFORE the emptiness filter: "tp, " yields a
+            # whitespace segment that the consumer (make_spec_plan)
+            # also ignores, so it must validate clean here too
+            if _ax.strip() and not _ax.strip().isidentifier():
+                raise ValueError(
+                    f"HOROVOD_MODEL_AXES must be comma-separated mesh "
+                    f"axis names, got {c.model_axes!r}")
         c.tail_policy = (_env_str("HOROVOD_TAIL_POLICY", c.tail_policy)
                          or "strict").strip().lower()
         from .ops.collectives import TAIL_POLICIES

@@ -44,6 +44,10 @@ struct Sig {
   // negotiated DCN straggler tolerance ("strict"/"bounded"/"stale"):
   // mixed policies never fuse (mirrors EntrySig.tail_policy)
   std::string tail_policy;
+  // canonicalized PartitionSpec fingerprint ("replicated" = no model-
+  // axis sharding): differently-sharded entries reduce over different
+  // axis sets, so mixed-spec entries never fuse (mirrors EntrySig.spec)
+  std::string spec;
   std::vector<long long> shape;
   long long ps_id = 0;
   bool stacked = false;
@@ -138,6 +142,7 @@ bool parse_sig(PyObject *o, Sig *s) {
   if (!get_str_attr(o, "dtype", &s->dtype)) return false;
   if (!get_str_attr(o, "wire_format", &s->wire_format)) return false;
   if (!get_str_attr(o, "tail_policy", &s->tail_policy)) return false;
+  if (!get_str_attr(o, "spec", &s->spec)) return false;
   if (!get_ll_attr(o, "process_set_id", &s->ps_id)) return false;
   if (!get_bool_attr(o, "stacked", &s->stacked)) return false;
   if (!get_ll_attr(o, "group_id", &s->group_id)) return false;
@@ -199,7 +204,7 @@ bool parse_sigs(PyObject *sigs, std::vector<Sig> *out) {
 
 // Bucket-compatibility key comparison: mirrors EntrySig.bucket_key() tuple
 // ordering (op_type, reduce_op, dtype, process_set_id, stacked,
-// prescale-or-1, postscale-or-1, wire_format, layer, tail_policy).
+// prescale-or-1, postscale-or-1, wire_format, layer, tail_policy, spec).
 int key_cmp(const Sig &a, const Sig &b) {
   int c = a.op_type.compare(b.op_type);
   if (c) return c;
@@ -221,6 +226,10 @@ int key_cmp(const Sig &a, const Sig &b) {
   // mixed tail policies must never fuse: a fused bucket runs ONE
   // deadline gate and one participation mask
   c = a.tail_policy.compare(b.tail_policy);
+  if (c) return c;
+  // mixed specs must never fuse: a bucket reduces over ONE axis set,
+  // decided by its members' (shared) canonical PartitionSpec
+  c = a.spec.compare(b.spec);
   if (c) return c;
   return 0;
 }
@@ -587,6 +596,7 @@ std::string cache_key(const std::vector<Sig> &sigs) {
     append_str(&k, s.dtype);
     append_str(&k, s.wire_format);
     append_str(&k, s.tail_policy);
+    append_str(&k, s.spec);
     append_ll(&k, s.ps_id);
     append_ll(&k, s.stacked ? 1 : 0);
     append_ll(&k, s.group_id);

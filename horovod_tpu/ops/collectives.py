@@ -133,7 +133,8 @@ def quantized_all_gather_p(tile, axis_name: str, fmt: WireFormat):
 
 def quantized_allreduce_p(x, axis_name: str, fmt: WireFormat,
                           op: str = ReduceOp.SUM, residual=None,
-                          error_feedback: bool = False):
+                          error_feedback: bool = False,
+                          denom: Optional[int] = None):
     """Drop-in for ``psum``(+average) with a quantized wire: RS + AG
     staging, fp32 accumulation, any input shape (padded internally to a
     multiple of ``n * fmt.block_size``).
@@ -144,6 +145,13 @@ def quantized_allreduce_p(x, axis_name: str, fmt: WireFormat,
     (``contribution - dequantized(quantized(contribution))``) is
     returned.  Returns ``(reduced, new_residual_or_None)``; ``reduced``
     has ``x``'s shape and dtype.
+
+    ``denom`` overrides the Average divisor (default: the axis size) —
+    the spec-aware gradient plane divides by the GLOBAL batch degree of
+    a multi-axis mesh while reducing over the data axis alone.  The
+    division happens on the scattered tile, BEFORE the gather-side
+    quantization, so the averaged values ride the wire (same staging as
+    the default path, just a different constant).
     """
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError(
@@ -160,7 +168,7 @@ def quantized_allreduce_p(x, axis_name: str, fmt: WireFormat,
     tile, new_res = quantized_sum_scatter_p(
         flat, axis_name, fmt, error_feedback=error_feedback)
     if op == ReduceOp.AVERAGE:
-        tile = tile / n
+        tile = tile / (n if denom is None else denom)
     red = quantized_all_gather_p(tile, axis_name, fmt)
     if pad:
         red = red[:total]

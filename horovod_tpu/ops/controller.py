@@ -256,11 +256,18 @@ def entry_token(entry) -> str:
     # tolerance decides WHICH contributions a round may sum, so a
     # config mismatch must surface as a divergence, never as replicas
     # disagreeing about a deadline (peers tolerate old 11-field tokens
-    # without it — see engine._synthesize)
+    # without it — see engine._synthesize).
+    # field 12 (spec) is the canonical PartitionSpec fingerprint: it
+    # decides WHICH AXES a bucket reduces over (a model-sharded entry's
+    # gradient arrives pre-reduced over its spec axes), so two
+    # processes disagreeing about a leaf's sharding must fail the round
+    # as a divergence, never dispatch reductions over different axis
+    # sets (old 12-field tokens synthesize to "replicated")
     sigs = [[s.name, s.op_type, s.reduce_op, s.dtype, wire_shape(s),
              s.process_set_id, bool(s.stacked),
              -1 if s.group_id == -1 else 0,
-             s.prescale, s.postscale, s.wire_format, s.tail_policy]
+             s.prescale, s.postscale, s.wire_format, s.tail_policy,
+             s.spec]
             for s in entry.sigs()]
     splits = (None if entry.splits is None
               else [int(x) for x in entry.splits])

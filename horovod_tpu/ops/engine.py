@@ -82,14 +82,15 @@ class TensorTableEntry:
     __slots__ = ("name", "op_type", "reduce_op", "arrays", "process_set",
                  "prescale", "postscale", "root_rank", "splits", "stacked",
                  "handle", "enqueue_time", "group_id", "callback",
-                 "peer_rows", "wire_format", "tail_policy")
+                 "peer_rows", "wire_format", "tail_policy", "spec")
 
     def __init__(self, name, op_type, arrays, process_set,
                  reduce_op=ReduceOp.AVERAGE, prescale=None, postscale=None,
                  root_rank=0, splits=None, stacked=None, group_id=-1,
                  callback: Optional[Callable] = None,
                  wire_format: str = "none",
-                 tail_policy: str = "strict"):
+                 tail_policy: str = "strict",
+                 spec: str = "replicated"):
         self.name = name
         self.op_type = op_type
         self.arrays = arrays
@@ -115,6 +116,12 @@ class TensorTableEntry:
         # round cannot apply (non-summable op) — the hierarchical-path
         # gate itself is dispatch-time (_bucket_tail_policy)
         self.tail_policy = tail_policy
+        # canonical PartitionSpec fingerprint ("replicated" for every
+        # eager submission today: the engine's arrays are full-width).
+        # Rides the signatures/token (field 12) so a cross-process
+        # disagreement about a leaf's sharding — which decides the axes
+        # its bucket reduces over — is a detected divergence
+        self.spec = spec
 
     def sigs(self) -> List[EntrySig]:
         from ..compression import quantizable
@@ -144,7 +151,7 @@ class TensorTableEntry:
                 wire_format=(self.wire_format
                              if fmt_ok and quantizable(a.dtype)
                              else "none"),
-                tail_policy=tail))
+                tail_policy=tail, spec=self.spec))
         return out
 
 
@@ -616,15 +623,20 @@ class CollectiveEngine:
             prescale=sigs[0][8], postscale=sigs[0][9],
             root_rank=fields["r"], splits=fields["sp"], stacked=False,
             group_id=self.next_group_id() if len(sigs) > 1 else -1,
-            # the peers' negotiated wire format (token field 10) and
-            # tail policy (field 11); tolerate old-format tokens without
-            # either — a peer running the previous release synthesizes
-            # strict/full-width entries, which still match its own sigs
+            # the peers' negotiated wire format (token field 10), tail
+            # policy (field 11), and partition-spec fingerprint (field
+            # 12); tolerate old-format tokens without any of them — a
+            # peer running a previous release synthesizes strict/
+            # full-width/replicated entries, which still match its own
+            # sigs
             wire_format=next((s[10] for s in sigs
                               if len(s) > 10 and s[10] != "none"), "none"),
             tail_policy=next((s[11] for s in sigs
                               if len(s) > 11 and s[11] != "strict"),
-                             "strict"))
+                             "strict"),
+            spec=next((s[12] for s in sigs
+                       if len(s) > 12 and s[12] != "replicated"),
+                      "replicated"))
         entry.handle = Handle(
             entry.name, single=(len(arrays) == 1
                                 and entry.group_id == -1))

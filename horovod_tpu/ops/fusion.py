@@ -91,6 +91,17 @@ class EntrySig:
     # of the (astuple) ResponseCache key, invalidates cached plans on a
     # policy change.
     tail_policy: str = "strict"
+    # canonicalized PartitionSpec fingerprint over the mesh axes
+    # ("replicated" = no model-axis sharding — every pre-existing plan).
+    # A model-sharded entry's gradient arrives PRE-reduced over the
+    # axes its spec names (the model's gather-transpose collectives did
+    # that), so its bucket reduces over a DIFFERENT axis set than a
+    # replicated bucket — mixed-spec entries must never fuse, and like
+    # wire_format/tail_policy before it the field rides the negotiation
+    # token (field 12) so every process agrees which axes each bucket
+    # reduces over; the (astuple) ResponseCache key invalidates cached
+    # plans on a spec change.
+    spec: str = "replicated"
 
     @property
     def numel(self) -> int:
@@ -109,7 +120,75 @@ class EntrySig:
                 self.process_set_id, self.stacked,
                 1.0 if self.prescale is None else self.prescale,
                 1.0 if self.postscale is None else self.postscale,
-                self.wire_format, self.layer, self.tail_policy)
+                self.wire_format, self.layer, self.tail_policy,
+                self.spec)
+
+
+def canonicalize_spec(spec) -> str:
+    """Canonical string fingerprint of one leaf's PartitionSpec.
+
+    ``"replicated"`` for ``None`` / an empty spec / an all-``None`` spec;
+    otherwise ``"<dim>:<axis>[+<axis>],<dim>:<axis>"`` over the sharded
+    dimensions in dimension order, e.g. ``P(None, "model")`` →
+    ``"1:model"`` and ``P(("data", "model"))`` → ``"0:data+model"``.
+    Already-canonical strings pass through unchanged (idempotent), so
+    plan metadata can be re-canonicalized freely.  The string is the
+    cross-process identity two planners compare — it must not depend on
+    jax object identity, import order, or the spec's Python type.
+    """
+    if spec is None:
+        return "replicated"
+    if isinstance(spec, str):
+        if spec == "replicated" or ":" in spec:
+            return spec
+        # a bare axis name: sharded over that axis on dim 0
+        return f"0:{spec}"
+    entries = list(spec)
+    parts = []
+    for dim, entry in enumerate(entries):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        axes = tuple(str(a) for a in axes if a is not None)
+        if axes:
+            parts.append(f"{dim}:{'+'.join(axes)}")
+    return ",".join(parts) if parts else "replicated"
+
+
+def spec_axes(canonical: str) -> Tuple[str, ...]:
+    """The mesh axes a canonical spec fingerprint shards over, in
+    spec order (deduplicated); ``()`` for ``"replicated"``."""
+    if canonical == "replicated":
+        return ()
+    axes = []
+    for part in canonical.split(","):
+        _dim, names = part.split(":", 1)
+        for a in names.split("+"):
+            if a and a not in axes:
+                axes.append(a)
+    return tuple(axes)
+
+
+def spec_shift(canonical: str) -> str:
+    """The canonical spec of a leading-axis SLICE of a leaf with
+    ``canonical``: dimension indices shift down by one (a stacked
+    ``[L, ...]`` leaf sharded on dim d is, per layer, sharded on
+    dim d-1).  A spec sharding dim 0 cannot be sliced along dim 0 —
+    raises, because silently dropping the axis would change which
+    axes the bucket reduces over."""
+    if canonical == "replicated":
+        return canonical
+    parts = []
+    for part in canonical.split(","):
+        dim, names = part.split(":", 1)
+        if int(dim) == 0:
+            raise ValueError(
+                f"spec {canonical!r} shards the leading (scan) "
+                f"dimension: a per-layer slice of this leaf has no "
+                f"dim to carry the sharding, so the stacked leaf "
+                f"cannot be layer-sliced under this spec")
+        parts.append(f"{int(dim) - 1}:{names}")
+    return ",".join(parts)
 
 
 def plan_fusion(entries: Sequence[EntrySig],

@@ -79,7 +79,8 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
                       compression=Compression.none,
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0,
-                      wire_format=None, residual=None, health=None):
+                      wire_format=None, residual=None, health=None,
+                      spec_plan=None):
     """Reduce a gradient pytree across ``axis_name`` with bucket fusion.
 
     The in-jit analog of the reference's fusion buffer: leaves are bucketed
@@ -109,6 +110,19 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
     ``collective.corrupt`` chaos site (guarded on ``chaos.ACTIVE``) may
     bake a chosen rank's NaN/scale corruption into a chosen bucket —
     the deterministic fault every health verdict is tested against.
+
+    ``spec_plan`` (a :class:`SpecPlan`) makes the reduction
+    mesh-axis-aware (ISSUE 14): each leaf's canonical PartitionSpec
+    rides its EntrySig — differently-sharded leaves never fuse — and a
+    bucket reduces over ``(data_axis,) + model_axes`` MINUS its spec's
+    axes (a model-sharded leaf's gradient arrives pre-reduced over its
+    spec axes via the model's gather-transpose, and is the locally-
+    owned shard: no full-width buffer is ever materialized here).
+    ``op=Average`` divides by the GLOBAL batch degree — the batch
+    shards over data and model axes alike.  With a ``wire_format`` only
+    the DATA-axis (DCN) hop quantizes; any model-axis hop of a
+    replicated bucket runs full-width first (those buckets hold the
+    small unsharded leaves).
     """
     threshold_bytes = _resolve_threshold(threshold_bytes)
     fmt = resolve_wire_format(wire_format)
@@ -119,6 +133,12 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
         return grads if fmt is None else (grads, residual)
     treedef = jax.tree_util.tree_structure(grads)
 
+    if spec_plan is not None and op not in (ReduceOp.AVERAGE,
+                                            ReduceOp.SUM):
+        raise ValueError(
+            f"spec-aware reduction (param_specs) supports op=Average/"
+            f"Sum, got {op!r}: the per-bucket axis-set factoring relies "
+            f"on sum linearity")
     if op == ReduceOp.ADASUM:
         if fmt is not None:
             raise ValueError(
@@ -157,9 +177,13 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
             "wire: pick the block-scaled quantized format OR the cast "
             "compressor, not both")
 
+    specs = (spec_plan.specs_for(_names) if spec_plan is not None
+             else None)
     buckets, _sigs = _plan_buckets(leaves, _names, op, prescale_factor,
                                    postscale_factor, threshold_bytes,
-                                   wire_format=fmt.name if fmt else "none")
+                                   wire_format=fmt.name if fmt else "none",
+                                   specs=specs)
+    global_n = spec_plan.global_size() if spec_plan is not None else None
 
     res_leaves = _residual_leaves(residual, leaves) if fmt is not None \
         else None
@@ -177,22 +201,45 @@ def fused_reduce_tree(grads, axis_name: str, op: str = ReduceOp.AVERAGE,
                 health.observe_bucket(bucket_id, _names[bucket[0]], buf)
             if prescale_factor != 1.0:
                 buf = buf * jnp.asarray(prescale_factor, buf.dtype)
-            if fmt is not None and _sigs[bucket[0]].wire_format != "none":
+            # the bucket's reduce-axis set: everything in the default
+            # path; under a spec plan the data axis + the model axes
+            # its (shared) spec does NOT already shard over
+            if spec_plan is not None:
+                r_axes = spec_plan.reduce_axes(_sigs[bucket[0]].spec)
+            else:
+                r_axes = (axis_name,)
+            quantize = (fmt is not None
+                        and _sigs[bucket[0]].wire_format != "none"
+                        and axis_name in r_axes)
+            if quantize:
                 from ..ops.collectives import quantized_allreduce_p
                 rparts = [res_leaves[i].reshape(-1) for i in bucket]
                 rbuf = (jnp.concatenate(rparts) if len(rparts) > 1
                         else rparts[0])
+                m_axes = tuple(a for a in r_axes if a != axis_name)
+                if m_axes:
+                    # replicated bucket on a multi-axis mesh: the
+                    # model-axis hop runs full-width (these buckets
+                    # hold the small unsharded leaves); only the
+                    # data (DCN) hop quantizes
+                    buf = jax.lax.psum(buf, m_axes)
                 red, nres = quantized_allreduce_p(
                     buf, axis_name, fmt, op=op, residual=rbuf,
-                    error_feedback=True)
+                    error_feedback=True, denom=global_n)
                 if health is not None:
                     health.observe_residual(bucket_id, nres)
             else:
                 wire, ctx = compression.compress(buf)
-                red = jax.lax.psum(wire, axis_name)
+                red = jax.lax.psum(wire, r_axes) if r_axes else wire
                 red = compression.decompress(red, ctx)
                 if op == ReduceOp.AVERAGE:
-                    red = red / _axis_size(axis_name)
+                    red = red / (_axis_size(axis_name)
+                                 if global_n is None else global_n)
+                # a bucket whose spec shards over the data axis itself
+                # (1-D FSDP) arrived fully reduced: r_axes is empty, no
+                # collective ran, only the Average normalization
+                # applies.  nres=None carries any residual through
+                # unchanged below — nothing was quantized.
                 nres = None
             if postscale_factor != 1.0:
                 red = red * jnp.asarray(postscale_factor, red.dtype)
@@ -232,6 +279,93 @@ def _residual_leaves(residual, leaves):
     return r_leaves
 
 
+class SpecPlan(NamedTuple):
+    """Static mesh-axis plan of one spec-aware transform (ISSUE 14).
+
+    ``by_name`` maps a leaf's path keystr to its canonical PartitionSpec
+    fingerprint (``ops.fusion.canonicalize_spec``); ``model_axes`` are
+    the parameter-sharding mesh axes beside the data axis.  The plan is
+    pure trace-time metadata: the contract it encodes is that a leaf's
+    gradient arrives PRE-reduced over every axis its spec shards over
+    (the model's gather-transpose collectives did that) and partial
+    over the rest, so a bucket's reduction runs over
+    ``(data_axis,) + model_axes`` minus its spec's axes — and an
+    ``op=Average`` divides by the GLOBAL batch degree (the product of
+    all axis sizes: the batch shards over data and model axes alike).
+    """
+    by_name: Any                       # dict keystr -> canonical spec
+    model_axes: Tuple[str, ...]
+    data_axis: str
+
+    def specs_for(self, names):
+        """Canonical spec per path-sorted gradient leaf name."""
+        out = []
+        for n in names:
+            spec = self.by_name.get(n)
+            if spec is None:
+                raise ValueError(
+                    f"param_specs has no entry for gradient leaf {n}: "
+                    f"the spec tree must be congruent with the "
+                    f"gradient/param pytree (every leaf needs a "
+                    f"PartitionSpec, None for replicated)")
+            out.append(spec)
+        return out
+
+    def reduce_axes(self, spec: str) -> Tuple[str, ...]:
+        """The axes a bucket with canonical ``spec`` reduces over."""
+        from ..ops.fusion import spec_axes
+        shard = set(spec_axes(spec))
+        return tuple(a for a in (self.data_axis,) + self.model_axes
+                     if a not in shard)
+
+    def global_size(self) -> int:
+        """Trace-time global batch degree (prod of all axis sizes)."""
+        n = 1
+        for a in (self.data_axis,) + self.model_axes:
+            n *= _axis_size(a)
+        return n
+
+
+def make_spec_plan(param_specs, data_axis: str,
+                   model_axes=None) -> SpecPlan:
+    """Canonicalize a param PartitionSpec pytree into a :class:`SpecPlan`.
+
+    ``model_axes`` defaults to the union of axes the specs name plus the
+    validated ``HOROVOD_MODEL_AXES`` config (sorted by name — a
+    deterministic cross-process order), minus the data axis.  The data
+    axis may appear in a spec (an FSDP leaf sharded over the data axis
+    itself arrives fully reduced — its bucket runs no collective), but
+    never in ``model_axes``.
+    """
+    from jax.sharding import PartitionSpec as P
+    from ..ops.fusion import canonicalize_spec, spec_axes
+    keyed = jax.tree_util.tree_leaves_with_path(
+        param_specs,
+        is_leaf=lambda x: x is None or isinstance(x, (P, str, tuple)))
+    by_name = {jax.tree_util.keystr(k): canonicalize_spec(v)
+               for k, v in keyed}
+    if model_axes is None:
+        axes = set()
+        for spec in by_name.values():
+            axes.update(spec_axes(spec))
+        import os
+        cfg = runtime._state().config
+        cfg_axes = (cfg.model_axes if cfg is not None
+                    else os.environ.get("HOROVOD_MODEL_AXES", ""))
+        axes.update(a.strip() for a in cfg_axes.split(",") if a.strip())
+        axes.discard(data_axis)
+        model_axes = tuple(sorted(axes))
+    else:
+        model_axes = tuple(model_axes)
+        if data_axis in model_axes:
+            raise ValueError(
+                f"model_axes {model_axes} must not contain the data "
+                f"axis {data_axis!r}: the data axis is the one the "
+                f"transform itself reduces over")
+    return SpecPlan(by_name=by_name, model_axes=model_axes,
+                    data_axis=data_axis)
+
+
 def _restore_order(sorted_leaves, order):
     """Invert the ``_tree_leaves_sorted`` permutation back to
     ``tree_leaves`` order (no second path walk)."""
@@ -251,12 +385,15 @@ def _resolve_threshold(threshold_bytes: Optional[int]) -> int:
 
 def _plan_buckets(leaves, names, op, prescale_factor, postscale_factor,
                   threshold_bytes, wire_format: str = "none",
-                  tail_policy: str = "strict"):
+                  tail_policy: str = "strict", specs=None):
     """One planner for both worlds: leaves become EntrySigs (name = the
     sorted pytree path, the controller's total order) and the eager
     engine's ``plan_fusion`` decides the buckets.  Within one dtype the
     path-sorted leaf order IS the planner's name order, so this is the
-    plan every process computes."""
+    plan every process computes.  ``specs`` (canonical PartitionSpec
+    fingerprints aligned with ``leaves``; None = all replicated) rides
+    each EntrySig so differently-sharded leaves never fuse — a bucket
+    reduces over ONE axis set."""
     from ..compression import quantizable
     from ..ops.fusion import EntrySig, plan_fusion
     sigs = [EntrySig(name=names[i], op_type="allreduce",
@@ -266,7 +403,8 @@ def _plan_buckets(leaves, names, op, prescale_factor, postscale_factor,
                      postscale=postscale_factor,
                      wire_format=(wire_format if quantizable(leaves[i].dtype)
                                   else "none"),
-                     tail_policy=tail_policy)
+                     tail_policy=tail_policy,
+                     spec=("replicated" if specs is None else specs[i]))
             for i in range(len(leaves))]
     return plan_fusion(sigs, threshold_bytes), sigs
 
@@ -390,19 +528,33 @@ class ShardedLayout(NamedTuple):
 
 
 def _sharded_layout(tree, axis_size: int, op, prescale_factor,
-                    postscale_factor, threshold_bytes, align: int = 1):
+                    postscale_factor, threshold_bytes, align: int = 1,
+                    spec_plan=None):
     """Plan the bucket/padding layout of ``tree`` for an ``axis_size``-way
     reduce-scatter — the SAME ``plan_fusion`` buckets as the replicated
     path (one cross-process ordering contract), plus per-bucket padding
     to a multiple of ``axis_size`` (times ``align``: the quantized wire
     needs block-aligned shards so per-block scales route with their
     blocks).  Returns ``(sorted_leaves, sorted_names, layout)`` so
-    callers reuse the single path walk."""
+    callers reuse the single path walk.
+
+    Under a ``spec_plan`` the buckets are additionally keyed by each
+    leaf's canonical PartitionSpec (mixed-spec buckets never form), and
+    the per-bucket layouts tile each bucket's LOCAL (per-model-shard)
+    flat size over the data axis — ZeRO within each model-shard group,
+    so per-chip state is ``total/(model x data)``.
+
+    Returns ``(sorted_leaves, sorted_names, sorted_specs, layout)``;
+    ``sorted_specs`` is None without a spec plan — callers reuse it
+    instead of re-resolving per leaf."""
     from ..ops.fusion import plan_bucket_layouts
     leaves, names, order = _tree_leaves_sorted(tree)
+    specs = (spec_plan.specs_for(names) if spec_plan is not None
+             else None)
     buckets, sigs = _plan_buckets(leaves, names, op, prescale_factor,
-                                  postscale_factor, threshold_bytes)
-    return leaves, names, ShardedLayout(
+                                  postscale_factor, threshold_bytes,
+                                  specs=specs)
+    return leaves, names, specs, ShardedLayout(
         treedef=jax.tree_util.tree_structure(tree), order=tuple(order),
         shapes=tuple(tuple(l.shape) for l in leaves),
         buckets=tuple(plan_bucket_layouts(sigs, buckets, axis_size,
@@ -447,7 +599,7 @@ def fused_reduce_scatter_tree(grads, axis_name: str,
                               prescale_factor: float = 1.0,
                               postscale_factor: float = 1.0,
                               wire_format=None, residual=None,
-                              health=None):
+                              health=None, spec_plan=None):
     """Reduce-scatter a gradient pytree: each worker keeps 1/N per bucket.
 
     The sharded-update half of ``fused_reduce_tree``: the SAME
@@ -470,6 +622,19 @@ def fused_reduce_scatter_tree(grads, axis_name: str,
     updates all-gather (``all_gather_sharded_tree``) stays full-width:
     it carries optimizer OUTPUT, which has no error-feedback state to
     absorb quantization bias.
+
+    ``spec_plan`` (a :class:`SpecPlan`) composes ZeRO with a model-
+    sharded mesh (ISSUE 14): each bucket's flat buffer is the LOCAL
+    model shard, tiled over the DATA axis *within* this model-shard
+    group — per-chip optimizer state is ``total/(model x data)`` — a
+    model-sharded bucket's ``psum_scatter`` runs over the data axis
+    alone (its gradient is already reduced over the model axes), and a
+    replicated bucket psums over the model axes first.  With a
+    ``wire_format`` the error-feedback residual is shaped like the
+    (local) gradient shard.  A spec naming the data axis itself is
+    refused: such a gradient arrives fully reduced AND sharded, so
+    there is no axis left to scatter over — use the plain spec-aware
+    reduction (``sharded_update=False``) for those leaves.
     """
     if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
         raise ValueError(
@@ -492,9 +657,25 @@ def fused_reduce_scatter_tree(grads, axis_name: str,
     # names ride the single path walk: a chaos rule matching name=
     # must not be silently inert under sharded_update, and verdicts
     # carry the same tensor names as the other fused paths
-    leaves, names, layout = _sharded_layout(
+    leaves, names, specs, layout = _sharded_layout(
         grads, n, op, prescale_factor, postscale_factor,
-        threshold_bytes, align=fmt.block_size if fmt else 1)
+        threshold_bytes, align=fmt.block_size if fmt else 1,
+        spec_plan=spec_plan)
+    if specs is not None:
+        # validate only the leaves actually present in THIS tree (a
+        # data-axis spec on an unused spec-tree entry is not an error
+        # here; the transform-build guard covers the configured case)
+        from ..ops.fusion import spec_axes
+        for nm, spec in zip(names, specs):
+            if axis_name in spec_axes(spec):
+                raise ValueError(
+                    f"sharded_update with param_specs: leaf {nm} is "
+                    f"sharded over the data axis {axis_name!r} itself — "
+                    f"its gradient arrives fully reduced and sharded, "
+                    f"leaving no axis to reduce-scatter over; use "
+                    f"sharded_update=False for spec trees naming the "
+                    f"data axis")
+    global_n = spec_plan.global_size() if spec_plan is not None else n
     res_leaves = _residual_leaves(residual, leaves) if fmt is not None \
         else None
     new_res = [None] * len(leaves) if fmt is not None else None
@@ -510,6 +691,16 @@ def fused_reduce_scatter_tree(grads, axis_name: str,
                 health.observe_bucket(bucket_id, nm, buf)
             if prescale_factor != 1.0:
                 buf = buf * jnp.asarray(prescale_factor, buf.dtype)
+            if specs is not None:
+                # a replicated bucket's model-axis hop runs first (its
+                # members are the small unsharded leaves); a model-
+                # sharded bucket's gradient is already reduced over its
+                # spec axes, so only the data-axis scatter remains
+                m_axes = tuple(
+                    a for a in spec_plan.reduce_axes(
+                        specs[bl.indices[0]]) if a != axis_name)
+                if m_axes:
+                    buf = jax.lax.psum(buf, m_axes)
             if fmt is not None:
                 from ..ops.collectives import quantized_sum_scatter_p
                 rbuf = _bucket_flat(res_leaves, bl).astype(jnp.float32)
@@ -530,7 +721,7 @@ def fused_reduce_scatter_tree(grads, axis_name: str,
                 tile = _psum_scatter(wire, axis_name)
                 tile = compression.decompress(tile, ctx)
             if op == ReduceOp.AVERAGE:
-                tile = tile / n
+                tile = tile / global_n
             if postscale_factor != 1.0:
                 tile = tile * jnp.asarray(postscale_factor, tile.dtype)
             shards.append(tile)
@@ -538,6 +729,22 @@ def fused_reduce_scatter_tree(grads, axis_name: str,
         return tuple(shards), layout
     return tuple(shards), layout, jax.tree_util.tree_unflatten(
         layout.treedef, _restore_order(new_res, list(layout.order)))
+
+
+def sharded_tile_layout(tree, shards: int, op: str = ReduceOp.AVERAGE,
+                        threshold_bytes: Optional[int] = None,
+                        align: int = 1, spec_plan=None) -> ShardedLayout:
+    """The ZeRO bucket/tile layout of ``tree`` tiled ``shards``-way —
+    pure trace-free plan metadata (``tree`` may hold
+    ``ShapeDtypeStruct`` leaves; nothing is materialized).  Callers
+    price per-chip sharded optimizer state EXACTLY from
+    ``layout.buckets[i].shard_numel`` (tools/bench_fsdp.py,
+    tools/rehearse_8b.py) instead of re-deriving the planner's padding
+    arithmetic."""
+    _leaves, _names, _specs, layout = _sharded_layout(
+        tree, shards, op, 1.0, 1.0, _resolve_threshold(threshold_bytes),
+        align=align, spec_plan=spec_plan)
+    return layout
 
 
 def all_gather_sharded_tree(shards, layout: ShardedLayout, axis_name: str):
@@ -673,7 +880,9 @@ def DistributedGradientTransform(
         overlap: Optional[bool] = None,
         overlap_layers: str = "layers",
         health: Optional[bool] = None,
-        health_check_every: Optional[int] = None
+        health_check_every: Optional[int] = None,
+        param_specs=None,
+        model_axes: Optional[Tuple[str, ...]] = None
         ) -> optax.GradientTransformation:
     """optax transformation that cross-worker-reduces gradients.
 
@@ -731,6 +940,29 @@ def DistributedGradientTransform(
     the taps gate on the accumulation boundary — pass
     ``count=state.count`` to ``overlapped_backprop``.
 
+    ``param_specs`` (a pytree of PartitionSpecs congruent with the
+    params; default: the ``param_specs`` of the innermost active
+    :class:`~horovod_tpu.parallel.mesh.ParallelMesh` context) makes the
+    whole gradient plane **mesh-axis-aware** (ISSUE 14 / ROADMAP item
+    3): the mesh factors into the data axis (``axis_name``) times the
+    model axes (``model_axes``; default: the axes the specs name plus
+    ``HOROVOD_MODEL_AXES``), each leaf's canonical spec rides its
+    EntrySig and the negotiation token (field 12) so differently-
+    sharded leaves never fuse and every process agrees which axes each
+    bucket reduces over.  A model-sharded leaf's gradient arrives as
+    the locally-owned shard, pre-reduced over its spec axes (the
+    model's gather-transpose collectives), so its bucket psums over
+    the DATA axis only — never materializing the full-width gradient;
+    replicated buckets reduce over data + model axes.  ``op=Average``
+    divides by the global batch degree.  Composes with
+    ``sharded_update`` (ZeRO tiles over the data axis *within* each
+    model-shard group: per-chip state is ``total/(model x data)``),
+    ``wire_format`` (only the data/DCN hop quantizes; residuals are
+    shaped like the shard) and ``overlap`` (the taps dispatch the
+    spec-aware plan).  Not composed with ``health`` yet (the sentinel's
+    checksum gather assumes one replication group) — that pairing
+    raises, naming itself.
+
     ``health=True`` (default from ``HOROVOD_HEALTH_TAPS``, vetoed by
     ``HOROVOD_HEALTH=0``; in-jit only) arms the **training-health
     numerics taps** (docs/observability.md "Training health"): each
@@ -752,6 +984,26 @@ def DistributedGradientTransform(
     if inner is None:
         inner = optax.identity()
     k = backward_passes_per_step
+    if param_specs is None and axis_name is not None:
+        # the ParallelMesh context is the no-plumbing path: a step
+        # built inside `with pmesh.with_param_specs(specs):` gets the
+        # spec tree without threading it through every call site
+        from ..parallel.mesh import current_mesh
+        _m = current_mesh()
+        if _m is not None and _m.param_specs is not None:
+            param_specs = _m.param_specs
+    spec_plan = None
+    if param_specs is not None:
+        if axis_name is None:
+            raise ValueError(
+                "param_specs requires axis_name: the mesh-axis-aware "
+                "reduction factors the in-jit mesh into data x model "
+                "axes; the eager engine's arrays are full-width "
+                "(spec='replicated') by construction")
+        if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+            raise ValueError(
+                f"param_specs supports op=Average/Sum, got {op!r}")
+        spec_plan = make_spec_plan(param_specs, axis_name, model_axes)
     if sharded_update and axis_name is None:
         raise ValueError(
             "sharded_update=True requires axis_name: the reduce-scatter "
@@ -762,6 +1014,17 @@ def DistributedGradientTransform(
     if sharded and op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
         raise ValueError(
             f"sharded_update supports op=Average/Sum, got {op!r}")
+    if sharded and spec_plan is not None:
+        from ..ops.fusion import spec_axes
+        for _nm, _spec in sorted(spec_plan.by_name.items()):
+            if axis_name in spec_axes(_spec):
+                raise ValueError(
+                    f"sharded_update with param_specs: leaf {_nm} is "
+                    f"sharded over the data axis {axis_name!r} itself — "
+                    f"its gradient arrives fully reduced and sharded, "
+                    f"leaving no axis to ZeRO-tile over; use "
+                    f"sharded_update=False for spec trees naming the "
+                    f"data axis")
     if wire_format is not None and wire_format != "none" \
             and axis_name is None:
         raise ValueError(
@@ -812,7 +1075,8 @@ def DistributedGradientTransform(
         _ov_plan = _ov.OverlapPlan(
             axis_name=axis_name, op=op, threshold_bytes=threshold_bytes,
             prescale=prescale_factor, postscale=postscale_factor,
-            sharded=sharded, fmt=fmt, k=k, layers_key=overlap_layers)
+            sharded=sharded, fmt=fmt, k=k, layers_key=overlap_layers,
+            spec_plan=spec_plan)
 
     if health and axis_name is None:
         raise ValueError(
@@ -827,8 +1091,17 @@ def DistributedGradientTransform(
             "overlapped buckets dispatch inside the backward scan and "
             "never materialize a boundary buffer to tap — use the "
             "trace/metrics plane for overlapped steps, or disable one")
+    if health and spec_plan is not None:
+        raise ValueError(
+            "health=True is not supported with param_specs yet: the "
+            "divergence sentinel's checksum gather assumes ONE "
+            "replication group, but a model-sharded leaf's checksums "
+            "legitimately differ across model shards — disable the "
+            "in-jit taps for spec-aware steps (the eager engine taps "
+            "and the trace/metrics plane still cover them)")
     hl_enabled = (bool(health) if health is not None
                   else (axis_name is not None and _ov_plan is None
+                        and spec_plan is None
                         and _health_taps_default()))
     hl_every = 1
     if hl_enabled:
@@ -844,7 +1117,8 @@ def DistributedGradientTransform(
             return fused_reduce_tree(
                 grads, axis_name, op=op, threshold_bytes=threshold_bytes,
                 compression=compression, prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor, health=health)
+                postscale_factor=postscale_factor, health=health,
+                spec_plan=spec_plan)
         from .. import api
         leaves, names, order = _tree_leaves_sorted(grads)
         wires, ctxs = [], []
@@ -882,14 +1156,16 @@ def DistributedGradientTransform(
                     threshold_bytes=threshold_bytes,
                     prescale_factor=prescale_factor,
                     postscale_factor=postscale_factor,
-                    wire_format=fmt, residual=residual, health=taps)
+                    wire_format=fmt, residual=residual, health=taps,
+                    spec_plan=spec_plan)
             else:
                 shards, layout = fused_reduce_scatter_tree(
                     grads, axis_name, op=op,
                     threshold_bytes=threshold_bytes,
                     compression=compression,
                     prescale_factor=prescale_factor,
-                    postscale_factor=postscale_factor, health=taps)
+                    postscale_factor=postscale_factor, health=taps,
+                    spec_plan=spec_plan)
                 new_res = residual
             # init_fn planned the state layout from PARAMS; the gradient
             # layout above must be the same plan, or the 1/N state tiles
@@ -897,10 +1173,11 @@ def DistributedGradientTransform(
             # instead of a deep optax mismatch
             p_shards = None
             if params is not None:
-                p_leaves, _p_names, p_layout = _sharded_layout(
+                p_leaves, _p_names, _p_specs, p_layout = _sharded_layout(
                     params, _axis_size(axis_name), op, prescale_factor,
                     postscale_factor, _resolve_threshold(threshold_bytes),
-                    align=fmt.block_size if fmt else 1)
+                    align=fmt.block_size if fmt else 1,
+                    spec_plan=spec_plan)
                 expected = (p_layout.shapes, p_layout.buckets)
             else:
                 p_leaves = None
@@ -937,7 +1214,8 @@ def DistributedGradientTransform(
                 grads, axis_name, op=op, threshold_bytes=threshold_bytes,
                 prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor,
-                wire_format=fmt, residual=residual, health=taps)
+                wire_format=fmt, residual=residual, health=taps,
+                spec_plan=spec_plan)
         else:
             reduced = reduce_grads(grads, health=taps)
             new_res = residual
@@ -1060,10 +1338,11 @@ def DistributedGradientTransform(
                 _init_fingerprints.add(layout.fingerprint())
                 inner_state = inner.init(p_tiles)
             else:
-                _leaves, _lnames, layout = _sharded_layout(
+                _leaves, _lnames, _lspecs, layout = _sharded_layout(
                     params, n, op, prescale_factor, postscale_factor,
                     _resolve_threshold(threshold_bytes),
-                    align=fmt.block_size if fmt else 1)
+                    align=fmt.block_size if fmt else 1,
+                    spec_plan=spec_plan)
                 _init_fingerprints.add((layout.shapes, layout.buckets))
                 inner_state = inner.init(
                     shard_tree_like(params, layout, axis_name))
@@ -1241,7 +1520,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          overlap: Optional[bool] = None,
                          overlap_layers: str = "layers",
                          health: Optional[bool] = None,
-                         health_check_every: Optional[int] = None
+                         health_check_every: Optional[int] = None,
+                         param_specs=None,
+                         model_axes: Optional[Tuple[str, ...]] = None
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with distributed gradient reduction.
 
@@ -1266,7 +1547,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         process_set=process_set, sharded_update=sharded_update,
         wire_format=wire_format, wire_block_size=wire_block_size,
         overlap=overlap, overlap_layers=overlap_layers,
-        health=health, health_check_every=health_check_every)
+        health=health, health_check_every=health_check_every,
+        param_specs=param_specs, model_axes=model_axes)
 
 
 def broadcast_parameters(params, root_rank: int = 0, process_set=None):

@@ -106,6 +106,10 @@ class OverlapPlan:
     fmt: Any                      # compression.WireFormat or None
     k: int                        # backward_passes_per_step
     layers_key: str = "layers"
+    # mesh-axis-aware dispatch (ISSUE 14): the transform's SpecPlan
+    # (distributed.make_spec_plan) — per-leaf canonical PartitionSpecs
+    # plus the model axes.  None = the 1-D replicated plan.
+    spec_plan: Any = None
     # trace-time handshake: taps that fired since update_fn last looked
     # (Python counter, never traced), plus the gate predicate the
     # context armed them with (a tracer from the SAME trace update_fn
@@ -118,6 +122,37 @@ class OverlapPlan:
         n, self._fired = self._fired, 0
         fire, self._fire = self._fire, None
         return n, fire
+
+    def tap_specs(self):
+        """Canonical spec lookup for TAP-level leaf names (None when the
+        plan is not spec-aware).
+
+        A tap sees SUB-trees of the params: the per-layer slice of the
+        ``layers_key`` subtree (leaf paths lose the ``['layers']``
+        prefix and the leading scan dim — specs shift down one
+        dimension) and the root rest-dict (paths unchanged).  This
+        merges both into one name->spec dict; a collision between a
+        stripped layer path and a root path with DIFFERENT specs is
+        ambiguous and raises (rename the leaf)."""
+        if self.spec_plan is None:
+            return None
+        from ..ops.fusion import spec_shift
+        prefix = f"['{self.layers_key}']"
+        merged = {}
+        for name, spec in self.spec_plan.by_name.items():
+            if name.startswith(prefix):
+                key, val = name[len(prefix):], spec_shift(spec)
+            else:
+                key, val = name, spec
+            if key in merged and merged[key] != val:
+                raise ValueError(
+                    f"overlap + param_specs: tap-level leaf name "
+                    f"{key} is ambiguous — a root leaf and a "
+                    f"{self.layers_key!r} stack leaf share it with "
+                    f"different specs ({merged[key]} vs {val}); "
+                    f"rename one of the leaves")
+            merged[key] = val
+        return merged
 
 
 #: transform update_fn -> OverlapPlan (weak: dies with the transform).
@@ -279,10 +314,12 @@ class OverlapLayout(NamedTuple):
     buckets: Tuple[Any, ...]               # ops.fusion.BucketLayout
     dispatch: Any                          # ops.fusion.DispatchSchedule
     bucket_wire: Tuple[str, ...]           # wire format name per bucket
+    bucket_spec: Tuple[str, ...] = ()      # canonical spec per bucket
 
     def fingerprint(self) -> Tuple:
         """Static identity for grads-vs-params layout validation."""
-        return (self.entries, self.entry_shapes, self.buckets)
+        return (self.entries, self.entry_shapes, self.buckets,
+                self.bucket_spec)
 
 
 def _is_layered(keystr: str, leaf, layers_key: str) -> bool:
@@ -302,15 +339,34 @@ def build_layout(tree, plan: OverlapPlan, shards: int,
     """
     from ..compression import quantizable
     from ..ops.fusion import (EntrySig, plan_bucket_layouts, plan_dispatch,
-                              plan_fusion)
+                              plan_fusion, spec_shift)
     from .distributed import _resolve_threshold, _tree_leaves_sorted
     leaves, names, order = _tree_leaves_sorted(tree)
     threshold = _resolve_threshold(plan.threshold_bytes)
     n_layers = None
     entries = []
     sigs = []
+    # spec resolution: tap sub-trees (force_root) use tap-level names,
+    # the boundary full tree uses full paths with stacked leaves'
+    # per-layer entries carrying the dim-shifted spec (so the tap plan
+    # and the boundary plan bucket IDENTICALLY — one schedule)
+    spec_of = (None if plan.spec_plan is None
+               else (plan.tap_specs() if force_root
+                     else plan.spec_plan.by_name))
 
-    def add(pos, layer, shape):
+    def _leaf_spec(pos, layered):
+        if spec_of is None:
+            return "replicated"
+        spec = spec_of.get(names[pos])
+        if spec is None:
+            raise ValueError(
+                f"overlap + param_specs: no spec entry for leaf "
+                f"{names[pos]} — the spec tree must be congruent with "
+                f"the param tree (every leaf needs a PartitionSpec, "
+                f"None for replicated)")
+        return spec_shift(spec) if layered else spec
+
+    def add(pos, layer, shape, spec="replicated"):
         leaf = leaves[pos]
         entries.append(OverlapEntry(leaf_pos=pos, layer=layer))
         sigs.append(EntrySig(
@@ -320,7 +376,7 @@ def build_layout(tree, plan: OverlapPlan, shards: int,
             postscale=plan.postscale,
             wire_format=(plan.fmt.name if plan.fmt is not None
                          and quantizable(leaf.dtype) else "none"),
-            layer=layer))
+            layer=layer, spec=spec))
 
     for pos, leaf in enumerate(leaves):
         if not force_root and _is_layered(names[pos], leaf,
@@ -333,10 +389,11 @@ def build_layout(tree, plan: OverlapPlan, shards: int,
                     f"{plan.layers_key!r} disagree on the layer count "
                     f"({n_layers} vs {leaf.shape[0]} at {names[pos]}) — "
                     f"the scanned stack must share one leading dim")
+            spec = _leaf_spec(pos, layered=True)
             for layer in range(n_layers):
-                add(pos, layer, leaf.shape[1:])
+                add(pos, layer, leaf.shape[1:], spec=spec)
         else:
-            add(pos, -1, leaf.shape)
+            add(pos, -1, leaf.shape, spec=_leaf_spec(pos, layered=False))
     buckets = plan_fusion(sigs, threshold)
     align = plan.fmt.block_size if plan.fmt is not None else 1
     layouts = plan_bucket_layouts(sigs, buckets, max(shards, 1),
@@ -348,9 +405,10 @@ def build_layout(tree, plan: OverlapPlan, shards: int,
         entry_shapes=tuple(s.shape for s in sigs),
         buckets=tuple(layouts),
         dispatch=plan_dispatch(sigs, buckets),
-        # mixed formats never fuse (wire_format is in bucket_key), so
+        # mixed formats/specs never fuse (both are in bucket_key), so
         # the first entry speaks for its whole bucket
-        bucket_wire=tuple(sigs[b[0]].wire_format for b in buckets))
+        bucket_wire=tuple(sigs[b[0]].wire_format for b in buckets),
+        bucket_spec=tuple(sigs[b[0]].spec for b in buckets))
 
 
 def _entry_flat(leaves, layout: OverlapLayout, i: int):
@@ -408,21 +466,42 @@ def reduce_full(tree, plan: OverlapPlan, force_root: bool = False):
                                   force_root=force_root)
     if not leaves:
         return tree
+    sp = plan.spec_plan
+    global_n = sp.global_size() if sp is not None else None
     pieces = [None] * len(layout.entries)
     for bucket_id in layout.dispatch.order:
         with jax.named_scope(f"hvd_bucket{bucket_id}"):
             buf = _bucket_buf(leaves, layout, bucket_id)
             if plan.prescale != 1.0:
                 buf = buf * jnp.asarray(plan.prescale, buf.dtype)
-            if plan.fmt is not None \
-                    and layout.bucket_wire[bucket_id] != "none":
-                from ..ops.collectives import quantized_allreduce_p
-                red, _ = quantized_allreduce_p(buf, plan.axis_name,
-                                               plan.fmt, op=plan.op)
+            # spec-aware: the bucket reduces over (data + model axes)
+            # minus its spec's axes — a model-sharded bucket's
+            # cotangent is the locally-owned shard, pre-reduced over
+            # the model axes by the model's gather-transpose
+            if sp is not None:
+                r_axes = sp.reduce_axes(layout.bucket_spec[bucket_id]
+                                        if layout.bucket_spec
+                                        else "replicated")
             else:
-                red = lax.psum(buf, plan.axis_name)
+                r_axes = (plan.axis_name,)
+            if plan.fmt is not None \
+                    and layout.bucket_wire[bucket_id] != "none" \
+                    and plan.axis_name in r_axes:
+                from ..ops.collectives import quantized_allreduce_p
+                m_axes = tuple(a for a in r_axes if a != plan.axis_name)
+                if m_axes:
+                    # replicated bucket on a multi-axis mesh: the
+                    # model hop runs full-width, only the data (DCN)
+                    # hop quantizes
+                    buf = lax.psum(buf, m_axes)
+                red, _ = quantized_allreduce_p(buf, plan.axis_name,
+                                               plan.fmt, op=plan.op,
+                                               denom=global_n)
+            else:
+                red = lax.psum(buf, r_axes) if r_axes else buf
                 if plan.op == ReduceOp.AVERAGE:
-                    red = red / _axis_size(plan.axis_name)
+                    red = red / (_axis_size(plan.axis_name)
+                                 if global_n is None else global_n)
             if plan.postscale != 1.0:
                 red = red * jnp.asarray(plan.postscale, red.dtype)
             _split_entries(red, layout, bucket_id, pieces)
@@ -456,12 +535,26 @@ def scatter_tiles(tree, plan: OverlapPlan, force_root: bool = False,
     else:
         from .distributed import _tree_leaves_sorted
         leaves, _names, _order = _tree_leaves_sorted(tree)
+    sp = plan.spec_plan
+    global_n = sp.global_size() if sp is not None else None
     tiles = [None] * len(layout.buckets)
     for bucket_id in layout.dispatch.order:
         with jax.named_scope(f"hvd_bucket{bucket_id}"):
             buf = _bucket_buf(leaves, layout, bucket_id)
             if plan.prescale != 1.0:
                 buf = buf * jnp.asarray(plan.prescale, buf.dtype)
+            if sp is not None:
+                # replicated buckets psum their model hop first; a
+                # model-sharded bucket's buffer is the local shard and
+                # only the data-axis scatter remains (a spec naming
+                # the data axis itself is refused at transform build)
+                m_axes = tuple(
+                    a for a in sp.reduce_axes(
+                        layout.bucket_spec[bucket_id]
+                        if layout.bucket_spec else "replicated")
+                    if a != plan.axis_name)
+                if m_axes:
+                    buf = lax.psum(buf, m_axes)
             if plan.fmt is not None \
                     and layout.bucket_wire[bucket_id] != "none":
                 from ..ops.collectives import quantized_sum_scatter_p
@@ -471,7 +564,8 @@ def scatter_tiles(tree, plan: OverlapPlan, force_root: bool = False,
             else:
                 tile = psum_scatter(buf, plan.axis_name)
             if plan.op == ReduceOp.AVERAGE:
-                tile = tile / _axis_size(plan.axis_name)
+                tile = tile / (_axis_size(plan.axis_name)
+                               if global_n is None else global_n)
             if plan.postscale != 1.0:
                 tile = tile * jnp.asarray(plan.postscale, tile.dtype)
             tiles[bucket_id] = tile

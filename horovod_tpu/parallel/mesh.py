@@ -62,6 +62,13 @@ class ParallelMesh:
 
     def __init__(self, config: MeshConfig, devices: Optional[Sequence] = None):
         self.config = config
+        # optional pytree of PartitionSpecs describing how PARAMS are
+        # sharded over this mesh's axes: set it (directly or via
+        # with_param_specs) before entering the mesh context and the
+        # spec-aware gradient plane (optim.distributed
+        # DistributedGradientTransform(param_specs=None)) reads it from
+        # current_mesh() instead of requiring the tree at every call
+        self.param_specs = None
         devices = list(devices if devices is not None else jax.devices())
         n = config.n_devices
         if len(devices) < n:
@@ -83,12 +90,45 @@ class ParallelMesh:
             return self.config.dp  # aliased onto dp
         return self.config.axis_sizes()[name]
 
+    def with_param_specs(self, param_specs) -> "ParallelMesh":
+        """Attach a param PartitionSpec pytree for the NEXT context
+        entry (returns self, so ``with pmesh.with_param_specs(specs):``
+        reads naturally).  The attachment is SCOPED: ``__exit__``
+        clears it, so a later unrelated ``with pmesh:`` block cannot
+        silently inherit stale specs.  Assign ``pmesh.param_specs``
+        directly for a persistent attachment."""
+        self.param_specs = param_specs
+        self._specs_scoped = True
+        return self
+
     def __enter__(self):
         self._ctx = self.mesh
-        return self.mesh.__enter__()
+        # enter the jax mesh FIRST: if it raises, the with-statement
+        # never runs __exit__, and a pre-pushed entry would leak on
+        # the context stack for the process lifetime
+        out = self.mesh.__enter__()
+        _ACTIVE_MESHES.append(self)
+        return out
 
     def __exit__(self, *a):
+        if _ACTIVE_MESHES and _ACTIVE_MESHES[-1] is self:
+            _ACTIVE_MESHES.pop()
+        if getattr(self, "_specs_scoped", False):
+            self.param_specs = None
+            self._specs_scoped = False
         return self.mesh.__exit__(*a)
+
+
+#: innermost-first stack of ParallelMesh contexts currently entered
+#: (trace-time Python state, like the overlap taps' _ACTIVE token)
+_ACTIVE_MESHES: list = []
+
+
+def current_mesh() -> Optional["ParallelMesh"]:
+    """The innermost active ``ParallelMesh`` context (None outside any).
+    The spec-aware gradient plane reads ``param_specs`` from here when a
+    transform is built without an explicit tree."""
+    return _ACTIVE_MESHES[-1] if _ACTIVE_MESHES else None
 
 
 def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None,

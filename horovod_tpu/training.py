@@ -407,9 +407,44 @@ def fsdp_param_specs(param_shapes, dp: int, axis: str = "dp"):
     return jax.tree_util.tree_map_with_path(spec_for, param_shapes)
 
 
+def spec_all_gather(tree, specs, axis: str):
+    """Materialize the full value of every leaf sharded over ``axis``
+    (per-leaf tiled ``all_gather`` along the sharded dimension; leaves
+    whose spec does not name ``axis`` pass through).  The shard_map-side
+    inverse of ``fsdp_param_specs``-style storage sharding."""
+    def gather_leaf(spec, leaf):
+        for dim, entry in enumerate(spec):
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            if axis in axes:
+                return lax.all_gather(leaf, axis, axis=dim, tiled=True)
+        return leaf
+    return jax.tree_util.tree_map(
+        gather_leaf, specs, tree, is_leaf=lambda x: isinstance(x, P))
+
+
+def spec_shard(tree, specs, axis: str):
+    """This shard's slice of every leaf sharded over ``axis`` — the
+    inverse of :func:`spec_all_gather` (full values in, local shards
+    out, sliced by ``lax.axis_index(axis)`` along the spec'd dim)."""
+    from .compat import axis_size as _axis_size
+    n = _axis_size(axis)
+    idx = lax.axis_index(axis)
+
+    def shard_leaf(spec, leaf):
+        for dim, entry in enumerate(spec):
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            if axis in axes:
+                size = leaf.shape[dim] // n
+                return lax.dynamic_slice_in_dim(leaf, idx * size, size,
+                                                axis=dim)
+        return leaf
+    return jax.tree_util.tree_map(
+        shard_leaf, specs, tree, is_leaf=lambda x: isinstance(x, P))
+
+
 def make_llama_fsdp_step(cfg: LlamaConfig, pmesh: ParallelMesh,
                          optimizer: Optional[optax.GradientTransformation]
-                         = None) -> TrainStep:
+                         = None, overlap: bool = False) -> TrainStep:
     """Fully-sharded data parallelism (ZeRO-3 class): params, grads AND
     optimizer state all live dp-sharded; each layer's weights are
     all-gathered just-in-time inside the scanned layer loop and the
@@ -425,16 +460,51 @@ def make_llama_fsdp_step(cfg: LlamaConfig, pmesh: ParallelMesh,
     reference's DP (SURVEY.md §2.9) always replicates the full model; this
     is the capability class FSDP/ZeRO-3 adds beyond it.
 
-    Composes with dp only (tp/pp/sp shard the model differently; use
-    ``make_llama_train_step`` for those, optionally with ``zero1``).
+    ``overlap=True`` composes FSDP storage with the overlapped gradient
+    plane (ISSUE 14): the step becomes an explicit ``shard_map``
+    program — params enter as their dp shards, one gather block
+    materializes the working copy, the model's grad taps reduce-scatter
+    each layer's fusion buckets INSIDE the backward scan
+    (``DistributedGradientTransform(overlap=True, sharded_update=
+    True)``: flat 1/dp optimizer-state tiles, updates all-gathered at
+    the boundary), and the updated shards are sliced back to storage.
+    Persistent per-chip bytes stay at the 1/dp fraction; the tradeoff
+    vs the GSPMD path is one whole-model gather per step instead of
+    just-in-time per-layer gathers (documented in docs/performance.md).
+
+    Capability gates (each refusal names exactly what is unsupported):
+    MoE stays refused — expert parallelism aliases onto dp, so expert
+    weights are dp-sharded and dp-averaging taps would corrupt them —
+    and tp/pp/sp/ep meshes shard the model on axes this step does not
+    gather over (use ``make_llama_train_step``).
     """
-    if (pmesh.config.tp > 1 or pmesh.config.pp > 1 or pmesh.config.sp > 1
-            or (pmesh.config.ep or 1) > 1 or cfg.n_experts > 0):
-        raise ValueError("FSDP composes with dp only — use "
-                         "make_llama_train_step for tp/pp/sp/ep meshes")
+    if cfg.n_experts > 0:
+        raise ValueError(
+            "make_llama_fsdp_step does not support MoE: expert "
+            "parallelism aliases the ep axis onto dp, so expert "
+            "weights are dp-SHARDED by routing — FSDP's dp-gathered "
+            "working copy (and any dp-averaging gradient plane) would "
+            "mix weights of DIFFERENT experts across ranks; use "
+            "make_llama_train_step for MoE")
+    for ax in ("tp", "pp", "sp"):
+        if getattr(pmesh.config, ax) > 1:
+            raise ValueError(
+                f"make_llama_fsdp_step does not compose with {ax}>1: "
+                f"the model is sharded over the {ax!r} axis, but this "
+                f"step only gathers/scatters over dp — use "
+                f"make_llama_train_step (optionally with zero1) for "
+                f"{ax} meshes")
+    if (pmesh.config.ep or 1) > 1:
+        raise ValueError(
+            "make_llama_fsdp_step does not compose with a dedicated "
+            "ep axis: expert routing shards weights over ep, which "
+            "this step does not gather over — use "
+            "make_llama_train_step for MoE/ep meshes")
     mesh = pmesh.mesh
     dp = pmesh.config.dp
     opt = optimizer if optimizer is not None else optax.adamw(3e-4)
+    if overlap:
+        return _make_llama_fsdp_overlap_step(cfg, pmesh, opt)
     par = ParallelSpec()  # no named-axis collectives — GSPMD does it all
     param_shapes = jax.eval_shape(
         partial(llama_mod.init_params, cfg, tp=1), jax.random.PRNGKey(0))
@@ -471,6 +541,93 @@ def make_llama_fsdp_step(cfg: LlamaConfig, pmesh: ParallelMesh,
             partial(llama_mod.init_params, cfg, tp=1),
             out_shardings=param_sharding)(rng)
         opt_state = jax.jit(opt.init, out_shardings=opt_sharding)(params)
+        return params, opt_state
+
+    return TrainStep(step_fn=step_fn, init_fn=init_fn, par=par, mesh=mesh,
+                     data_spec=data_spec, param_sharding=param_sharding)
+
+
+def _make_llama_fsdp_overlap_step(cfg: LlamaConfig, pmesh: ParallelMesh,
+                                  opt) -> TrainStep:
+    """FSDP storage + overlapped gradient dispatch (see
+    ``make_llama_fsdp_step(overlap=True)``).  An explicit shard_map
+    program: gather sharded params → tap-armed backward (per-layer
+    reduce-scatters inside the scan) → 1/dp-tile optimizer step →
+    boundary all-gather of updates → slice shards back to storage."""
+    from .compat import has_new_shard_map
+    if not has_new_shard_map():
+        raise ValueError(
+            "make_llama_fsdp_step(overlap=True) needs the new-API "
+            "jax.shard_map (compat.has_new_shard_map): this jax build "
+            "only ships the experimental 0.4.x shape, whose check_rep "
+            "transposes differently — run the GSPMD fsdp step "
+            "(overlap=False) on this build, or upgrade jax")
+    from .optim import overlap as _ovl
+    from .optim.distributed import (DistributedGradientTransform,
+                                    state_partition_specs)
+    from .runtime import ReduceOp
+    mesh = pmesh.mesh
+    dp = pmesh.config.dp
+    par = ParallelSpec(dp_axis="dp")
+    param_shapes = jax.eval_shape(
+        partial(llama_mod.init_params, cfg, tp=1), jax.random.PRNGKey(0))
+    pspec_tree = fsdp_param_specs(param_shapes, dp)
+    param_sharding = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), pspec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+    data_spec = P("dp")
+    # flat 1/dp optimizer-state tiles + in-backward-scan dispatch; the
+    # taps psum_scatter each layer bucket, the transform carves tiles
+    ov_tx = DistributedGradientTransform(
+        inner=opt, axis_name="dp", op=ReduceOp.AVERAGE, overlap=True,
+        sharded_update=True)
+
+    def local_loss(params, tokens, targets):
+        # par carries dp_axis for loss semantics only; the gradient
+        # collectives are the taps' (check_vma=False below)
+        return llama_mod.loss_fn(params, tokens, targets, cfg,
+                                 ParallelSpec())
+
+    def ov_shard_step(params_local, opt_state, tokens, targets):
+        full = spec_all_gather(params_local, pspec_tree, "dp")
+        with _ovl.overlapped_backprop(ov_tx):
+            loss, grads = jax.value_and_grad(local_loss)(full, tokens,
+                                                         targets)
+        updates, opt_state = ov_tx.update(grads, opt_state, full)
+        new_full = optax.apply_updates(full, updates)
+        params_local = spec_shard(new_full, pspec_tree, "dp")
+        return params_local, opt_state, lax.pmean(loss, "dp")
+
+    # the sharded-update state structure references the mapped axis at
+    # init, so derive it under an abstract axis env and shard_map the
+    # real init (state tiles are per-worker: varying over dp)
+    _, state_shape = jax.make_jaxpr(
+        lambda p: ov_tx.init(p), axis_env=[("dp", dp)],
+        return_shape=True)(param_shapes)
+    state_specs = state_partition_specs(state_shape, "dp",
+                                        sharded_update=True)
+    state_sharding = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), state_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    step_fn = jax.jit(jax.shard_map(
+        ov_shard_step, mesh=mesh,
+        in_specs=(pspec_tree, state_specs, data_spec, data_spec),
+        out_specs=(pspec_tree, state_specs, P()),
+        check_vma=False), donate_argnums=(0, 1))
+
+    def init_fn(rng):
+        params = jax.jit(
+            partial(llama_mod.init_params, cfg, tp=1),
+            out_shardings=param_sharding)(rng)
+
+        def _init(params_local):
+            return ov_tx.init(
+                spec_all_gather(params_local, pspec_tree, "dp"))
+
+        opt_state = jax.jit(jax.shard_map(
+            _init, mesh=mesh, in_specs=(pspec_tree,),
+            out_specs=state_specs, check_vma=False),
+            out_shardings=state_sharding)(params)
         return params, opt_state
 
     return TrainStep(step_fn=step_fn, init_fn=init_fn, par=par, mesh=mesh,

@@ -499,8 +499,10 @@ def test_hierarchical_dcn_stage_quantized():
 # eager engine: negotiated per-bucket wire format end to end
 # ---------------------------------------------------------------------------
 
+from horovod_tpu.compat import has_new_shard_map
+
 _NEEDS_SHARD_MAP = pytest.mark.skipif(
-    not hasattr(jax, "shard_map"),
+    not has_new_shard_map(),
     reason="stacked eager dispatch needs jax.shard_map (absent on this "
            "container's jax 0.4.37; the whole stacked path fails at seed)")
 

@@ -335,6 +335,18 @@ def test_llama3_8b_aot_rehearsal_subprocess():
     assert r["stablehlo_bytes"] > 10_000
     # sharded state + transients leave ample activation headroom on v5p
     assert r["per_chip_gib"]["steady_plus_peak"] < 0.5 * r["v5p_hbm_gib"]
+    # ISSUE 14: the composed spec-aware plane's train-state bytes DROP
+    # by the data-axis degree (exact planner tile accounting — the
+    # same layout tools/bench_fsdp.py gates against live state): bf16
+    # moments tile 1/dp within each tp shard, padding included
+    spec = r["specaware"]
+    assert spec["moments_bf16_zero_tiles_bytes"] < \
+        spec["moments_bf16_replicated_dp_bytes"]
+    assert spec["state_drop_vs_replicated"] >= 0.9 * r["mesh"]["dp"]
+    # and the composed number sits beside (not above) the GSPMD zero1
+    # reading it must eventually replace
+    assert spec["per_chip_gib"] <= \
+        r["per_chip_gib"]["opt_moments_bf16_zero1"] * 1.25 + 0.01
 
 
 def test_bench_llama8b_dp_mode_forced_measurement():

@@ -112,3 +112,27 @@ def test_schedule_accounting_from_a_trace():
     # the one-call convenience form the benches use
     assert trace_transmit_bytes(step, (jax.ShapeDtypeStruct(
         (64,), jnp.float32),), [("w", 4)]) == want
+
+
+def test_multi_axis_filter_prices_the_filtered_hop_only():
+    """Regression (ISSUE 14): a psum over (data, model) filtered at
+    the data axis used to be priced with n = data*model — charging the
+    model hop's bytes to the data (DCN) filter and over-counting the
+    spec-aware sharded schedules.  Filtered pricing factors
+    hierarchically: n is the FILTERED axis's size, the operand bytes
+    are what cross that hop."""
+    sizes = {"data": 2, "model": 2}
+    r = _rec("psum", ["float32[64]"], ["float32[64]"],
+             axes=("data", "model"))
+    # unfiltered: the flat combined ring over all 4 workers
+    assert ring_transmit_bytes(r, sizes) == 2 * 3 * 256 // 4
+    # filtered at data: one ring of size 2 moving the full operand
+    assert ring_transmit_bytes(r, sizes, axis_filter="data") == \
+        2 * 1 * 256 // 2
+    # sharded vs full-width: a model-shard operand (half the aval)
+    # costs exactly half on the data hop — the wire win the spec-aware
+    # plan buys, visible only with the per-hop factoring
+    shard = _rec("psum", ["float32[32]"], ["float32[32]"],
+                 axes=("data",))
+    assert ring_transmit_bytes(shard, sizes, axis_filter="data") * 2 \
+        == ring_transmit_bytes(r, sizes, axis_filter="data")

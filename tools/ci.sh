@@ -38,7 +38,12 @@
 #      bit-identical incl. sharded x int8) — and tools/bench_tail.py
 #      --smoke — tail-tolerant-collective invariants (chaos-seeded
 #      p99 bound, strict/bounded one-program bit-exactness,
-#      convergence gate, byte conservation) — and tools/hvdtrace
+#      convergence gate, byte conservation) — and tools/bench_fsdp.py
+#      --smoke — mesh-axis-aware gradient-plane invariants (exact
+#      model-shard-fraction per-chip bytes, data-hop wire bytes with
+#      int8 on the 2-D mesh, one-program fire-gated A/B bit-identical
+#      weights across plain/zero/int8/int8+zero, replicated parity)
+#      — and tools/hvdtrace
 #      --smoke — merged-trace critical-path attribution over the
 #      recorded chaos-seeded 4-host fixture (the injected straggler
 #      must be the verdict) — and tools/hvddoctor --smoke —
@@ -373,6 +378,17 @@ tail -1 /tmp/ci_bench_overlap.log
 python tools/bench_tail.py --smoke > /tmp/ci_bench_tail.log 2>&1 \
   || { tail -30 /tmp/ci_bench_tail.log; exit 1; }
 tail -1 /tmp/ci_bench_tail.log
+# mesh-axis-aware gradient plane: on the 2x2 (data x model) CPU mesh,
+# per-chip param+opt-state bytes must sit at the EXACT model-shard
+# fraction (tree_nbytes vs the planner's tile layout), the data-hop
+# wire bytes must shrink with shard operands and >=3.5x further under
+# int8 (strict ring accounting), the one-program fire-gated A/B must
+# land on bit-identical weights across plain/zero/int8/int8+zero, and
+# the spec-aware trajectory must match the flat replicated reference
+# (docs/performance.md "Mesh-axis-aware sharding")
+python tools/bench_fsdp.py --smoke > /tmp/ci_bench_fsdp.log 2>&1 \
+  || { tail -30 /tmp/ci_bench_fsdp.log; exit 1; }
+tail -1 /tmp/ci_bench_fsdp.log
 # merged-trace critical path: replay the recorded chaos-seeded 4-host
 # fixture (collective.dcn group=1 every=3 delay:0.8) through
 # tools/hvdtrace — the injected straggler host must come out as the top
@@ -395,9 +411,12 @@ echo "== 11/11 hvdsched: collective-schedule snapshots + consistency =="
 # an explicit `tools/hvdsched --update` in review) and require identical
 # canonical schedules across mesh sizes (HVD210); incl. the
 # overlapped_distopt_step entry whose per-layer collectives must sit
-# inside the backward-scan sub-jaxpr, and the health_distopt_step entry
+# inside the backward-scan sub-jaxpr, the health_distopt_step entry
 # whose ONLY delta vs distopt_step is the divergence sentinel's
-# checksum all_gather under its cadence cond
+# checksum all_gather under its cadence cond, and the fsdp_distopt_step
+# entry whose model-sharded buckets reduce-scatter shard-sized operands
+# over the data axis alone (HVD210 sweeps the data axis: mesh shapes
+# 2x2 and 4x2)
 bash tools/hvdsched --check --consistency
 
 echo "CI matrix: all stages green"

@@ -96,6 +96,39 @@ def main():
     g_bytes = p_bytes                    # fp32 grads, param-sharded
     c_bytes = p_bytes // 2               # bf16 cast of the tp shard
     gib = 1 << 30
+
+    # --- composed spec-aware plane (ISSUE 14): the same 8B geometry
+    # under DistributedGradientTransform(param_specs=..., sharded_
+    # update=True) with bf16 moments — tp is the model axis, dp the
+    # data axis, and the per-chip moment bytes are the EXACT data-axis
+    # tile sizes of the tp-local bucket layout (planner metadata, the
+    # same accounting tools/bench_fsdp.py gates against the live state)
+    from horovod_tpu.optim.distributed import (make_spec_plan,
+                                               sharded_tile_layout)
+    leaves_s = jax.tree_util.tree_leaves(params_s)
+    leaves_p = jax.tree_util.tree_leaves(
+        ts.param_sharding, is_leaf=lambda x: hasattr(x, "spec"))
+    treedef = jax.tree_util.tree_structure(params_s)
+    local_leaves, spec_leaves = [], []
+    for sh, nsh in zip(leaves_s, leaves_p):
+        dims = list(sh.shape)
+        for d, axes in enumerate(nsh.spec):
+            if axes is None:
+                continue
+            for ax in (axes if isinstance(axes, tuple) else (axes,)):
+                dims[d] //= pmesh.mesh.shape[ax]
+        local_leaves.append(jax.ShapeDtypeStruct(tuple(dims), sh.dtype))
+        spec_leaves.append(nsh.spec)
+    local_shapes = jax.tree_util.tree_unflatten(treedef, local_leaves)
+    spec_tree = jax.tree_util.tree_unflatten(treedef, spec_leaves)
+    layout = sharded_tile_layout(
+        local_shapes, dp,
+        spec_plan=make_spec_plan(spec_tree, "dp"))
+    local_numel = sum(x.size for x in local_leaves)
+    # 2 moments (mu, nu) x bf16 (2 B): replicated-DP vs tiled per chip
+    mo_repl = 2 * 2 * local_numel
+    mo_spec = 2 * 2 * sum(bl.shard_numel for bl in layout.buckets)
+
     print(json.dumps({
         "ok": True,
         "n_params": int(n_params),
@@ -110,6 +143,17 @@ def main():
             "bf16_copy_transient": round(c_bytes / gib, 2),
             "steady_plus_peak": round(
                 (p_bytes + o_bytes + g_bytes + c_bytes) / gib, 2),
+        },
+        # ISSUE 14: the composed spec-aware path's state accounting
+        # (exact planner tile bytes, not a fraction estimate) next to
+        # the GSPMD zero1 number above — what the explicit gradient
+        # plane holds when ZeRO tiles/quantized wire/overlap taps ride
+        # the dp axis of the dp x tp mesh
+        "specaware": {
+            "moments_bf16_replicated_dp_bytes": mo_repl,
+            "moments_bf16_zero_tiles_bytes": mo_spec,
+            "state_drop_vs_replicated": round(mo_repl / mo_spec, 2),
+            "per_chip_gib": round(mo_spec / gib, 3),
         },
         "v5p_hbm_gib": 95,
     }))
