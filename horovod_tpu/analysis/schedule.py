@@ -622,6 +622,33 @@ def _entry_tail_distopt_step():
     return step, (spec, spec), (("hvd_local", _TAIL_LOCAL),)
 
 
+def _entry_serve_forward_step():
+    """The serving data path (ISSUE 15): one batched ragged KV-cache
+    decode step (prefill + per-row-positioned greedy decode scan) of
+    the llama family, traced under the worker mesh axis.  Serving is
+    pure data parallelism — a forward must NEVER negotiate a gradient
+    collective (a straggling replica must stall only its own leases,
+    and a worker joining or leaving mid-traffic must not deadlock
+    peers) — so the pinned schedule is EMPTY: a regression that routes
+    serving through the gradient plane (a stray psum from a reused
+    training step, a health tap's sentinel gather) adds records and
+    fails HVD211 structurally."""
+    import jax
+    import jax.numpy as jnp
+    from ..models import llama
+    from ..models.generate import batched_greedy_decode
+
+    cfg = llama.tiny(vocab=64, seq=32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def step(tokens, lengths):
+        return batched_greedy_decode(params, cfg, tokens, lengths,
+                                     max_new_tokens=4, max_len=20)
+
+    sds = jax.ShapeDtypeStruct
+    return step, (sds((2, 16), jnp.int32), sds((2,), jnp.int32))
+
+
 #: entry name -> builder returning (fn, example_args) or
 #: (fn, example_args, extra_axes): ``extra_axes`` extends the trace's
 #: axis_env past the varied ``_AXIS`` (hierarchical entries need a
@@ -636,6 +663,7 @@ BUILTIN_ENTRIES = {
     "tail_distopt_step": _entry_tail_distopt_step,
     "health_distopt_step": _entry_health_distopt_step,
     "fsdp_distopt_step": _entry_fsdp_distopt_step,
+    "serve_forward_step": _entry_serve_forward_step,
 }
 
 #: Mesh sizes the consistency check traces every entry at (HVD210).

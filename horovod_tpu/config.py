@@ -183,6 +183,35 @@ class Config:
     health_grad_factor: float = 10.0
     health_loss_factor: float = 4.0
     health_residual_factor: float = 4.0
+    # --- serving plane (docs/serving.md; env table in docs/env.md) ---
+    # attach a ServingPlane to the elastic driver (run_elastic_launcher)
+    serve: bool = False
+    # admission tick (ms): the request-batching window — the serving
+    # analog of HOROVOD_CYCLE_TIME.  A micro-batch dispatches when it
+    # fills its batch cap or its oldest request has waited one tick.
+    serve_tick_ms: float = 2.0
+    # batch cap: most rows one micro-batch may carry (the fusion byte
+    # cap restated — the admission planner maps it onto plan_fusion's
+    # threshold)
+    serve_max_batch: int = 8
+    # admitted shape buckets (comma-separated ascending ints).  Every
+    # batch pads up to the smallest (batch, seq) bucket that fits, so
+    # steady-state serving never recompiles; "" batch buckets default
+    # to powers of two up to serve_max_batch.
+    serve_batch_buckets: str = ""
+    serve_seq_buckets: str = "32,64,128"
+    # default per-request deadline (ms): a request still QUEUED past it
+    # fails as "expired" instead of wasting a batch slot; 0 = no bound.
+    # Dispatched requests always complete (a late answer still lands).
+    serve_deadline_ms: float = 1000.0
+    # lease: how long a dispatched micro-batch may stay un-pushed
+    # before the plane requeues its requests (silent-worker-death
+    # backstop; the elastic reaper requeues eagerly on a known death)
+    serve_lease_s: float = 10.0
+    # straggler rotation: a worker whose batch-service EWMA exceeds
+    # this factor x the median of its peers stops receiving pulls
+    # (>= 2 active workers; never the last one).  0 disables.
+    serve_straggler_factor: float = 3.0
 
     @staticmethod
     def from_env() -> "Config":
@@ -322,4 +351,47 @@ class Config:
                 raise ValueError(
                     f"{_name} must be > 1 (a bar at or below the "
                     f"baseline fires on every step), got {_v}")
+        c.serve = _env_bool("HOROVOD_SERVE", c.serve)
+        c.serve_tick_ms = _env_float(
+            "HOROVOD_SERVE_TICK_MS", c.serve_tick_ms)
+        if c.serve_tick_ms < 0:
+            raise ValueError(
+                f"HOROVOD_SERVE_TICK_MS must be >= 0, got "
+                f"{c.serve_tick_ms}")
+        c.serve_max_batch = _env_int(
+            "HOROVOD_SERVE_MAX_BATCH", c.serve_max_batch)
+        if c.serve_max_batch < 1:
+            raise ValueError(
+                f"HOROVOD_SERVE_MAX_BATCH must be >= 1, got "
+                f"{c.serve_max_batch}")
+        c.serve_batch_buckets = (_env_str(
+            "HOROVOD_SERVE_BATCH_BUCKETS", c.serve_batch_buckets)
+            or "").strip()
+        c.serve_seq_buckets = (_env_str(
+            "HOROVOD_SERVE_SEQ_BUCKETS", c.serve_seq_buckets)
+            or "").strip()
+        from .serving.shapes import parse_buckets
+        if c.serve_batch_buckets:
+            parse_buckets(c.serve_batch_buckets,
+                          "HOROVOD_SERVE_BATCH_BUCKETS")
+        parse_buckets(c.serve_seq_buckets, "HOROVOD_SERVE_SEQ_BUCKETS")
+        c.serve_deadline_ms = _env_float(
+            "HOROVOD_SERVE_DEADLINE_MS", c.serve_deadline_ms)
+        if c.serve_deadline_ms < 0:
+            raise ValueError(
+                f"HOROVOD_SERVE_DEADLINE_MS must be >= 0 (0 disables), "
+                f"got {c.serve_deadline_ms}")
+        c.serve_lease_s = _env_float(
+            "HOROVOD_SERVE_LEASE_S", c.serve_lease_s)
+        if c.serve_lease_s <= 0:
+            raise ValueError(
+                f"HOROVOD_SERVE_LEASE_S must be positive, got "
+                f"{c.serve_lease_s}")
+        c.serve_straggler_factor = _env_float(
+            "HOROVOD_SERVE_STRAGGLER_FACTOR", c.serve_straggler_factor)
+        if c.serve_straggler_factor != 0 and c.serve_straggler_factor <= 1:
+            raise ValueError(
+                f"HOROVOD_SERVE_STRAGGLER_FACTOR must be 0 (off) or > 1 "
+                f"(a bar at or below the peer median rotates every "
+                f"worker), got {c.serve_straggler_factor}")
         return c

@@ -147,6 +147,10 @@ class ElasticDriver:
         self._assignment: Dict[int, dict] = {}   # worker_id → assignment
         self._workers: Dict[int, _Worker] = {}   # live workers by id
         self._notif: Dict[int, tuple] = {}       # worker_id → (addr, port)
+        # serving plane (attach_serving): worker deaths and re-forms
+        # requeue its in-flight leases so mid-traffic churn loses zero
+        # requests (docs/serving.md)
+        self._serving = None
         self._next_worker_id = 0
         self._hosts: Dict[str, int] = {}
         self._shutdown = False
@@ -250,6 +254,26 @@ class ElasticDriver:
         job = _health.scrape_job_health(endpoints)
         return (200, "application/json",
                 json.dumps(job, separators=(",", ":")))
+
+    # --- serving plane -----------------------------------------------------
+
+    def attach_serving(self, plane):
+        """Attach a :class:`~horovod_tpu.serving.plane.ServingPlane`:
+        its ``serve_*`` data path joins this driver's control server
+        (same port, same HMAC discipline, same keep-alive pool), and
+        the driver's lifecycle feeds its elasticity — a reaped worker's
+        leases requeue immediately (``worker_gone``) and a re-form
+        requeues the leases of every worker that left the epoch
+        (``retain_workers``), so mid-traffic churn re-queues in-flight
+        requests instead of dropping them."""
+        self._serving = plane
+        self._server.add_handlers(plane.rpc_handlers())
+        self._server.add_get_routes({"serve/stats": self._serve_stats_route})
+        self._emit("serving_attached")
+
+    def _serve_stats_route(self):
+        return (200, "application/json",
+                json.dumps(self._serving.stats(), separators=(",", ":")))
 
     # --- lifecycle events --------------------------------------------------
 
@@ -657,6 +681,12 @@ class ElasticDriver:
         # here; otherwise dead round keys accumulate and every
         # watch/dir-get reply pays the full-store snapshot scan for them
         self._prune_dead_epoch_keys(epoch)
+        if self._serving is not None:
+            # re-form mid-traffic: leases of workers that left the new
+            # epoch's membership are requeued, not dropped; survivors
+            # keep theirs (their processes keep serving through the
+            # re-form)
+            self._serving.retain_workers(assigned_wids)
         if self.verbose:
             print(f"elastic: epoch {epoch} — {np_} slots on "
                   f"{list(hosts)}", file=sys.stderr)
@@ -889,6 +919,11 @@ class ElasticDriver:
             with self._lock:
                 self._workers.pop(w.worker_id, None)
                 self._notif.pop(w.worker_id, None)
+            if self._serving is not None:
+                # any exit (failure, churn, scale-down drain) releases
+                # the worker's in-flight serving leases back into the
+                # admission queue — zero lost requests under churn
+                self._serving.worker_gone(w.worker_id)
             if w.expected_exit:
                 self._emit("worker_exit", worker_id=w.worker_id, rc=rc,
                            kind="expected")
@@ -991,4 +1026,12 @@ def run_elastic_launcher(args) -> int:
         port=args.port, start_timeout=args.start_timeout,
         verbose=args.verbose,
         network_interface=args.network_interface)
+    from ..config import _env_bool
+    if _env_bool("HOROVOD_SERVE", False):
+        # the driver doubles as the serving plane's admission endpoint:
+        # workers (whose script runs ServingWorker against
+        # HOROVOD_ELASTIC_DRIVER_ADDR/PORT) pull from the same control
+        # server clients submit to (docs/serving.md)
+        from ..serving.plane import ServingPlane
+        driver.attach_serving(ServingPlane())
     return driver.run()

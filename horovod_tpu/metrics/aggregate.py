@@ -38,6 +38,17 @@ _LABEL_RE = re.compile(r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\]'
 _UNESCAPE_RE = re.compile(r"\\(.)")
 
 
+def percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an ASCENDING-sorted sequence — the
+    one definition every bench's p50/p99 means (tools/bench_control,
+    bench_serve, the serving example all delegate here so 'p99' cannot
+    silently diverge between the gates CI pins)."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
 def _unescape(value: str) -> str:
     # single left-to-right scan: sequential str.replace corrupts values
     # where a literal backslash precedes an 'n' or quote (the escaped

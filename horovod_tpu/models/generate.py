@@ -92,16 +92,59 @@ def _write_kv(x, lp, cfg: LlamaConfig, k_cache, v_cache, positions, start):
     return k_cache, v_cache
 
 
-def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: KVCache):
+def _write_kv_rows(x, lp, cfg: LlamaConfig, k_cache, v_cache, positions):
+    """Project x to k/v, rope them, write each ROW at its own cache slot.
+
+    Per-row variant of :func:`_write_kv` for ragged batched decode
+    (T == 1): row ``b`` writes at slot ``positions[b, 0]``.  The write is
+    a ``where`` over a one-hot slot mask instead of a
+    ``dynamic_update_slice`` — bit-identical values either way (``where``
+    selects, never blends), which the batched-vs-sequential decode
+    parity test pins.
+    """
+    B, T, _ = x.shape
+    Dh = cfg.head_dim
+    Hkv = cfg.n_kv_heads
+    k = (x @ lp["wk"].astype(x.dtype)).reshape(B, T, Hkv, Dh)
+    v = (x @ lp["wv"].astype(x.dtype)).reshape(B, T, Hkv, Dh)
+    k = _rope(k, positions, cfg.rope_theta)
+    max_len = k_cache.shape[1]
+    slot = (jnp.arange(max_len)[None, :]
+            == positions[:, 0][:, None])[:, :, None, None]
+    k_cache = jnp.where(slot, k.astype(k_cache.dtype), k_cache)
+    v_cache = jnp.where(slot, v.astype(v_cache.dtype), v_cache)
+    return k_cache, v_cache
+
+
+def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: KVCache,
+                       row_starts=None):
     """Run ``tokens`` [B, T] through the model, extending ``cache``.
 
     Returns ``(logits [B, T, V], new_cache)``.  Serves both phases:
     prefill (T = prompt length, cache.length == 0) and decode (T == 1).
+
+    ``row_starts`` [B] int32 gives each row its OWN absolute position —
+    the ragged-batch decode path (serving micro-batches coalesce prompts
+    of different lengths): row ``b``'s token sits at position
+    ``row_starts[b]``, its k/v is written at that per-row cache slot,
+    and the causal mask bounds attention at the per-row position.
+    Decode-only (T must be 1); ``cache.length`` is not advanced — the
+    caller tracks per-row lengths.  Prefill of a right-padded ragged
+    batch uses the default path (positions 0..T-1 are correct for every
+    row; pad rows write garbage k/v beyond their length, which decode
+    overwrites slot by slot and the position mask hides meanwhile).
     """
     par = ParallelSpec()  # decode path is single-shard per replica
     B, T = tokens.shape
     start = cache.length
-    positions = (jnp.arange(T)[None, :] + start) * jnp.ones_like(tokens)
+    if row_starts is None:
+        positions = (jnp.arange(T)[None, :] + start) * jnp.ones_like(tokens)
+    else:
+        if T != 1:
+            raise ValueError(
+                f"row_starts is decode-only (T == 1), got T={T}: ragged "
+                f"prefill right-pads and uses the default path")
+        positions = row_starts[:, None] * jnp.ones_like(tokens)
     h = params["embed"].astype(cfg.dtype)[tokens]
 
     layers = jax.tree_util.tree_map(
@@ -111,7 +154,10 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: KVCache):
     def scan_body(h, layer_io):
         lp, kc, vc = layer_io
         attn_in = _rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
-        kc, vc = _write_kv(attn_in, lp, cfg, kc, vc, positions, start)
+        if row_starts is None:
+            kc, vc = _write_kv(attn_in, lp, cfg, kc, vc, positions, start)
+        else:
+            kc, vc = _write_kv_rows(attn_in, lp, cfg, kc, vc, positions)
         h = h + _cached_attention(attn_in, lp, cfg, kc, vc, positions)
         pre = _rmsnorm(h, lp["mlp_norm"], cfg.norm_eps)
         y, _aux = ffn(pre, lp, cfg, par)  # local routing (no ep axis)
@@ -122,7 +168,8 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: KVCache):
                                  (layers, cache.k, cache.v))
     h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
     logits = h @ params["embed"].T.astype(h.dtype)
-    return logits, KVCache(k_new, v_new, start + T)
+    return logits, KVCache(
+        k_new, v_new, start + T if row_starts is None else start)
 
 
 def _select(logits, rng, temperature: float, top_k: int):
@@ -183,3 +230,51 @@ def greedy_generate(params, cfg: LlamaConfig, prompt, max_new_tokens: int,
                     max_len: Optional[int] = None):
     """Greedy decode (temperature-0 :func:`generate`)."""
     return generate(params, cfg, prompt, max_new_tokens, max_len=max_len)
+
+
+def batched_greedy_decode(params, cfg: LlamaConfig, prompts, lengths,
+                          max_new_tokens: int,
+                          max_len: Optional[int] = None):
+    """Greedy decode over a RAGGED batch of right-padded prompts.
+
+    ``prompts``: [B, T] int32 right-padded to a common T (pad id is
+    irrelevant — pad k/v never survives the per-row position mask);
+    ``lengths``: [B] int32 true prompt lengths (1 <= lengths <= T).
+    Returns [B, max_new_tokens] ids where row ``b`` continues its own
+    prompt from position ``lengths[b]``.
+
+    This is the serving micro-batch correctness floor: every row must be
+    **bit-identical** to running :func:`greedy_generate` on that row
+    alone with the same ``max_len`` (pinned in tests/test_generate.py).
+    Mechanics: prefill runs the standard full-width forward (positions
+    0..T-1 are correct for every row; pad rows deposit garbage k/v past
+    their length), each row's first token comes from its OWN last prompt
+    logit (``lengths - 1``), and decode steps write/attend at per-row
+    positions ``lengths + i`` via ``row_starts`` — overwriting the pad
+    garbage slot by slot, masked until overwritten.
+    """
+    B, T = prompts.shape
+    max_len = max_len or (T + max_new_tokens)
+    if T + max_new_tokens > max_len:
+        raise ValueError(f"max_len={max_len} < padded prompt {T} + new "
+                         f"{max_new_tokens}")
+    if max_new_tokens <= 0:
+        return jnp.zeros((B, 0), jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    cache = init_kv_cache(cfg, B, max_len)
+    logits, cache = forward_with_cache(params, prompts, cfg, cache)
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None], axis=1)[:, 0, :]
+    next_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+    def step(carry, i):
+        cache, tok = carry
+        logits, cache = forward_with_cache(
+            params, tok[:, None], cfg, cache, row_starts=lengths + i)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        return (cache, nxt), nxt
+
+    (_, _), toks = lax.scan(step, (cache, next_tok),
+                            jnp.arange(max_new_tokens - 1))
+    return jnp.concatenate(
+        [next_tok[:, None], jnp.moveaxis(toks, 0, 1)], axis=1)

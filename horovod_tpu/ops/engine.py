@@ -1096,6 +1096,14 @@ class CollectiveEngine:
             # "Training health"): the full snapshot is GET /health /
             # the health_pull RPC; stats() carries the compact verdict
             out["health"] = _health.evaluator().summary()
+        # serving-plane summary (docs/observability.md "Serving"):
+        # present only when a ServingPlane or ServingWorker lives in
+        # this process.  Lazy import — the serving package is optional
+        # state, not an engine dependency
+        from .. import serving as _serving
+        serving_stats = _serving.stats()
+        if serving_stats:
+            out["serving"] = serving_stats
         if self.autotuner is not None:
             out["autotune"] = {
                 "fusion_threshold_bytes": self._fusion_threshold(),

@@ -410,6 +410,19 @@ class JsonRpcServer:
             target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
 
+    def add_handlers(self, handlers: Dict[str, Callable]) -> None:
+        """Register additional POST handlers on a live server (the
+        elastic driver attaches the serving plane's
+        ``serve_submit``/``serve_pull``/``serve_push`` data path to its
+        already-running control server).  Publication is one dict
+        rebind: an in-flight dispatch sees the old table or the new
+        one, never a torn state."""
+        self._handlers = {**self._handlers, **handlers}
+
+    def add_get_routes(self, routes: Dict[str, Callable]) -> None:
+        """Same post-construction registration for GET routes."""
+        self._get_routes = {**self._get_routes, **routes}
+
     def close(self):
         self._httpd.shutdown()
         self._httpd.server_close()

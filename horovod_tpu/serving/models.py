@@ -1,0 +1,97 @@
+"""Model adapters: the zoo's forwards as bucketed serving functions.
+
+Each builder returns a :class:`~horovod_tpu.serving.worker.
+BucketedForward` mapping a padded micro-batch ``(tokens [B, S] int32,
+lengths [B] int32)`` to a per-row output array — the one signature the
+serving worker speaks:
+
+* ``llama_decode_forward`` — the KV-cache ragged batched greedy decode
+  (``models/generate.py batched_greedy_decode``): each row continues
+  its own prompt; per-row bit-parity with sequential
+  ``greedy_generate`` is the micro-batching correctness floor.
+* ``classifier_forward`` — plain forwards (bert, mnist, anything
+  ``fn(params, x) -> logits``): rows are flat feature/token vectors,
+  output is the argmax label (pad rows discarded by the plane).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from .shapes import ShapeBuckets
+from .worker import BucketedForward
+
+
+def llama_decode_forward(params, cfg, max_new_tokens: int,
+                         buckets: ShapeBuckets) -> BucketedForward:
+    """Greedy KV-cache decode over a padded ragged micro-batch.
+
+    Output rows are ``[max_new_tokens]`` generated ids.  ``max_len`` is
+    derived from the (static) padded seq, so each shape bucket compiles
+    exactly one program — prefill + decode scan end to end.
+    """
+    from ..models.generate import batched_greedy_decode
+    if buckets.max_seq + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"largest seq bucket {buckets.max_seq} + max_new_tokens "
+            f"{max_new_tokens} exceeds the model's max_seq_len "
+            f"{cfg.max_seq_len}")
+
+    def fn(tokens, lengths):
+        return batched_greedy_decode(
+            params, cfg, tokens, lengths, max_new_tokens,
+            max_len=tokens.shape[1] + max_new_tokens)
+
+    return BucketedForward(fn, buckets)
+
+
+def classifier_forward(forward: Callable, params,
+                       buckets: ShapeBuckets,
+                       preprocess: Callable = None) -> BucketedForward:
+    """A plain forward (bert/mnist-shaped ``forward(params, x) ->
+    logits``) as a serving function: rows are flat inputs, output is
+    the ``[B, 1]`` argmax label.  ``preprocess`` maps the int32 token
+    batch to the model's input (e.g. reshape/scale image bytes)."""
+    import jax.numpy as jnp
+
+    def fn(tokens, lengths):
+        x = tokens if preprocess is None else preprocess(tokens)
+        logits = forward(params, x)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+
+    return BucketedForward(fn, buckets)
+
+
+def toy_echo_forward(buckets: ShapeBuckets, burn_dim: int = 200,
+                     burn_iters: int = 3) -> BucketedForward:
+    """Deterministic verification forward for benches and smokes.
+
+    Burns a BATCH-INDEPENDENT matmul chain (``burn_iters`` x
+    ``[burn_dim, burn_dim]``) — the CPU stand-in for the per-forward
+    fixed cost (weight streaming, kernel dispatch) that real
+    accelerator serving amortizes over the batch; a per-row cost would
+    make CPU micro-batching pointless and the bench meaningless.  Then
+    echoes ``tokens * 2 + 1``: unique payloads round-trip, so a routing
+    or requeue bug shows up as a WRONG answer, not just a lost one.
+    The burn result is folded in at a scale that truncates to +0 at
+    runtime but cannot be simplified away at trace time.
+    """
+    import jax.numpy as jnp
+
+    def fn(tokens, lengths):
+        z = jnp.ones((burn_dim, burn_dim), jnp.float32) \
+            * (1.0 + tokens.sum().astype(jnp.float32) * 1e-9)
+        for _ in range(burn_iters):
+            z = jnp.tanh(z @ z)
+        return tokens * 2 + 1 + (z.sum() * 1e-30).astype(jnp.int32)
+
+    return BucketedForward(fn, buckets)
+
+
+def decode_rows(outputs: np.ndarray, lengths: np.ndarray,
+                n_rows: int) -> list:
+    """Strip pad rows from a batched output (helper for callers that
+    bypass the plane)."""
+    return [np.asarray(outputs[i]) for i in range(n_rows)]

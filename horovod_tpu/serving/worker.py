@@ -1,0 +1,269 @@
+"""Worker-side serving loop: pull a micro-batch, run the jit'd forward,
+push the outputs.
+
+Pure data parallelism: every worker owns a full replica and serves its
+batches independently — the forward NEVER negotiates a collective (the
+``serve_forward_step`` hvdsched snapshot pins that structurally: its
+collective schedule is EMPTY), so a straggling or dying worker stalls
+only its own leases, which the plane requeues.  The pull is a long-poll
+over the keep-alive RPC pool (one parked request, not a poll tick —
+the control-plane watch transport's shape applied to the data path).
+
+Per-request latency is observed HERE, per worker: the pulled batch
+carries each request's age at dispatch (driver clock) and the worker
+adds its own service time — no cross-host clock needed — feeding
+``hvd_serve_request_latency_seconds`` on this worker's ``GET /metrics``,
+which the driver's ``GET /metrics/job`` merges bucket-wise into the
+job-level p50/p99.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from .. import chaos as _chaos
+from .. import metrics as _metrics
+from ..runner.rpc import json_request
+
+logger = logging.getLogger("horovod_tpu")
+
+# -- metric families (docs/metrics.md; sites guard on _metrics.ACTIVE) --------
+_m_latency = _metrics.histogram(
+    "hvd_serve_request_latency_seconds",
+    "Per-request serving latency (queue age at dispatch + worker "
+    "service time).  lo=-13: sub-ms requests must resolve — the "
+    "2^-10 tail-lateness floor cannot (tests/test_serving.py)",
+    lo=-13, hi=7)
+_m_forward = _metrics.histogram(
+    "hvd_serve_forward_seconds",
+    "Wall time of one jit'd batched forward", lo=-13, hi=4)
+_m_recompiles = _metrics.counter(
+    "hvd_serve_recompiles_total",
+    "Forward compilations for an ALREADY-SEEN shape bucket after "
+    "warmup — steady-state serving must keep this at 0 (gated by "
+    "tools/bench_serve.py)")
+_m_cache_size = _metrics.gauge(
+    "hvd_serve_compile_cache_size",
+    "Distinct compiled entries in the serving forward's jit cache")
+
+
+class BucketedForward:
+    """A jit'd forward restricted to the admitted shape buckets.
+
+    Wraps ``fn(tokens [B, S] int32, lengths [B] int32) -> array`` with
+    the no-recompile discipline: calls outside the bucket set raise,
+    and compilations are counted — a compile for a shape seen before
+    (cache eviction, a static-arg leak) increments
+    ``hvd_serve_recompiles_total``, the gated steady-state invariant.
+    """
+
+    def __init__(self, fn: Callable, buckets=None):
+        import jax
+        self._jit = jax.jit(fn)
+        self._buckets = buckets
+        self._seen: set = set()
+        self.calls = 0
+        self.compiles = 0
+        self.recompiles = 0
+
+    def _cache_size(self) -> Optional[int]:
+        size = getattr(self._jit, "_cache_size", None)
+        try:
+            return int(size()) if callable(size) else None
+        except Exception:  # noqa: BLE001 - jax-version dependent
+            return None
+
+    def __call__(self, tokens: np.ndarray, lengths: np.ndarray):
+        import jax.numpy as jnp
+        shape = tuple(tokens.shape)
+        if self._buckets is not None:
+            b, s = shape
+            if (b not in self._buckets.batch_buckets
+                    or s not in self._buckets.seq_buckets):
+                raise ValueError(
+                    f"forward called outside the shape buckets: {shape} "
+                    f"not in {self._buckets.batch_buckets} x "
+                    f"{self._buckets.seq_buckets} (every recompile is a "
+                    f"p99 outlier)")
+        before = self._cache_size()
+        out = self._jit(jnp.asarray(tokens, jnp.int32),
+                        jnp.asarray(lengths, jnp.int32))
+        out = np.asarray(out)
+        after = self._cache_size()
+        self.calls += 1
+        if after is None:
+            # no jit cache introspection on this jax: distinct shapes
+            # stand in for compiles (jit retraces exactly per shape)
+            compiled = shape not in self._seen
+        else:
+            compiled = after > (before or 0)
+            if _metrics.ACTIVE:
+                _m_cache_size.set(after)
+        if compiled:
+            self.compiles += 1
+            if shape in self._seen:
+                self.recompiles += 1
+                if _metrics.ACTIVE:
+                    _m_recompiles.inc()
+                logger.warning("serving: recompiled already-seen shape "
+                               "%s", shape)
+        self._seen.add(shape)
+        return out
+
+    def warmup(self) -> int:
+        """Compile every admitted shape bucket up front (the deploy-time
+        pre-compile real serving does): after this, a steady-state
+        compile is by definition a recompile — the gated invariant.
+        Returns the number of shapes compiled."""
+        if self._buckets is None:
+            return 0
+        n = 0
+        for b in self._buckets.batch_buckets:
+            for s in self._buckets.seq_buckets:
+                if (b, s) not in self._seen:
+                    self(np.zeros((b, s), np.int32),
+                         np.ones((b,), np.int32))
+                    n += 1
+        return n
+
+    def stats(self) -> Dict[str, int]:
+        return {"calls": self.calls, "compiles": self.compiles,
+                "recompiles": self.recompiles,
+                "shapes_seen": len(self._seen)}
+
+
+class ServingWorker:
+    """Pull-loop worker: ``serve_pull`` -> forward -> ``serve_push``.
+
+    ``forward`` maps ``(tokens [B, S] int32, lengths [B] int32)`` to an
+    output array whose leading dim is B (a :class:`BucketedForward` or
+    any callable).  Runs on a daemon thread (``start()``); exits when
+    the plane replies ``{"stop"}`` or ``stop()`` is called.  Transport
+    failures back off and retry — mid-re-form the driver is briefly
+    unreachable and the worker must ride it out, not die.
+    """
+
+    def __init__(self, addr: str, port: int, forward: Callable,
+                 worker_id: str = "0", wait_s: float = 5.0,
+                 secret=None, metrics_port: Optional[int] = None,
+                 warmup: bool = False):
+        self.addr = addr
+        self.port = port
+        self.forward = forward
+        self.worker_id = str(worker_id)
+        self.wait_s = float(wait_s)
+        self._secret = secret
+        self._metrics_port = metrics_port
+        self._warmup = warmup
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.batches = 0
+        self.rows = 0
+        self.pulls = 0
+        from . import register as _register
+        _register("worker", self)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.run, name=f"hvd-serve-worker-{self.worker_id}",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def join(self, timeout: Optional[float] = None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def run(self):
+        try:
+            if self._warmup:
+                # pre-compile the shape set BEFORE the first pull:
+                # compile latency must never ride a request (it would
+                # both blow that request's p99 and pollute this
+                # worker's straggler score with a one-time cost)
+                wu = getattr(self.forward, "warmup", None)
+                if callable(wu):
+                    n = wu()
+                    logger.info("serving worker %s warmed %d shapes",
+                                self.worker_id, n)
+            while not self._stop.is_set():
+                if not self._serve_once():
+                    break
+        finally:
+            from . import unregister as _unregister
+            _unregister(self)
+
+    # -- one pull/forward/push round ----------------------------------------
+    def _serve_once(self) -> bool:
+        try:
+            payload = {"worker": self.worker_id, "wait_s": self.wait_s}
+            if self._metrics_port:
+                payload["metrics_port"] = self._metrics_port
+            batch = json_request(
+                self.addr, self.port, "serve_pull", payload,
+                timeout=self.wait_s + 10.0, secret=self._secret,
+                retries=0)
+        except Exception:  # noqa: BLE001 - driver mid-re-form/gone
+            logger.debug("serve_pull failed; backing off", exc_info=True)
+            if self._stop.wait(0.2):
+                return False
+            return True
+        if batch.get("stop"):
+            return False
+        if batch.get("empty"):
+            if batch.get("rotated"):
+                # rotated out of the pull rotation: stay alive (the
+                # operator may clear the rotation) but stop hammering
+                self._stop.wait(0.5)
+            return True
+        self.pulls += 1
+        tokens = np.asarray(batch["tokens"], np.int32)
+        lengths = np.asarray(batch["lengths"], np.int32)
+        n_rows = int(batch["rows"])
+        t0 = time.monotonic()
+        if _chaos.ACTIVE:
+            # serve.batch: deterministic per-worker service faults
+            # (delay = a straggling replica the rotation must catch;
+            # error/crash = a dying worker whose lease must requeue).
+            # Inside the service clock: an injected slow forward must
+            # look slow to the latency histogram and the plane's
+            # straggler score, exactly like a real one
+            _chaos.fire("serve.batch", worker=self.worker_id,
+                        batch=batch["batch_id"], rows=n_rows)
+        out = self.forward(tokens, lengths)
+        service = time.monotonic() - t0
+        self.batches += 1
+        self.rows += n_rows
+        if _metrics.ACTIVE:
+            _m_forward.observe(service)
+            for age in batch["age_s"][:n_rows]:
+                _m_latency.observe(float(age) + service)
+        outputs = np.asarray(out)[:n_rows].tolist()
+        try:
+            json_request(
+                self.addr, self.port, "serve_push",
+                {"worker": self.worker_id,
+                 "batch_id": batch["batch_id"],
+                 "outputs": outputs,
+                 "service_s": round(service, 6)},
+                timeout=10.0, secret=self._secret, idempotent=False)
+        except Exception:  # noqa: BLE001 - lease reaper covers the loss
+            logger.warning("serve_push failed; plane will requeue the "
+                           "lease", exc_info=True)
+        return True
+
+    def stats(self) -> dict:
+        out = {"worker": self.worker_id, "pulls": self.pulls,
+               "batches": self.batches, "rows": self.rows}
+        fwd_stats = getattr(self.forward, "stats", None)
+        if callable(fwd_stats):
+            out["forward"] = fwd_stats()
+        return out

@@ -113,3 +113,69 @@ def test_sampling_requires_rng(hvd):
         assert "rng" in str(e)
     else:
         raise AssertionError("expected ValueError")
+
+
+def test_batched_ragged_decode_bit_identical_per_row(hvd):
+    """The serving micro-batch correctness floor (ISSUE 15): a padded
+    RAGGED batch through batched_greedy_decode must be BIT-identical
+    per row to sequential greedy_generate on that row alone (same
+    max_len) — position/start masking may not perturb a single
+    logit."""
+    params = _params()
+    rng = np.random.RandomState(7)
+    lens = [3, 5, 9, 16]
+    T, n_new = max(lens), 7
+    max_len = T + n_new
+    prompts = np.zeros((len(lens), T), np.int32)
+    rows = []
+    for b, L in enumerate(lens):
+        row = rng.randint(0, 64, (L,)).astype(np.int32)
+        rows.append(row)
+        prompts[b, :L] = row
+
+    batched = np.asarray(jax.jit(
+        lambda p, t, n: generate.batched_greedy_decode(
+            p, CFG, t, n, n_new, max_len=max_len))(
+        params, jnp.asarray(prompts), jnp.asarray(lens, jnp.int32)))
+    for b, row in enumerate(rows):
+        seq = np.asarray(generate.greedy_generate(
+            params, CFG, jnp.asarray(row[None, :]), n_new,
+            max_len=max_len))
+        np.testing.assert_array_equal(batched[b], seq[0])
+
+
+def test_batched_decode_pad_id_irrelevant(hvd):
+    """Pad tokens never leak through the per-row masking: the pad id
+    must not change any row's output."""
+    params = _params()
+    rng = np.random.RandomState(8)
+    lens = [4, 11]
+    T, n_new = 16, 5
+    base = np.zeros((2, T), np.int32)
+    for b, L in enumerate(lens):
+        base[b, :L] = rng.randint(0, 64, (L,))
+    alt = base.copy()
+    for b, L in enumerate(lens):
+        alt[b, L:] = 63   # a different (valid) pad id
+
+    fn = jax.jit(lambda p, t, n: generate.batched_greedy_decode(
+        p, CFG, t, n, n_new, max_len=T + n_new))
+    lengths = jnp.asarray(lens, jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(fn(params, jnp.asarray(base), lengths)),
+        np.asarray(fn(params, jnp.asarray(alt), lengths)))
+
+
+def test_row_starts_is_decode_only(hvd):
+    """Per-row starts with T > 1 must raise (ragged prefill right-pads
+    and uses the default path)."""
+    params = _params()
+    cache = generate.init_kv_cache(CFG, 2, 16)
+    try:
+        generate.forward_with_cache(
+            params, jnp.zeros((2, 3), jnp.int32), CFG, cache,
+            row_starts=jnp.asarray([0, 1], jnp.int32))
+    except ValueError as e:
+        assert "decode-only" in str(e)
+    else:
+        raise AssertionError("expected ValueError")

@@ -1,0 +1,586 @@
+"""The elastic inference serving plane (ISSUE 15).
+
+Shape buckets, plan_fusion-backed admission, the RPC data path, lease
+requeue (kill/re-form loses nothing), straggler rotation, the
+no-recompile discipline, the hvd_serve_* metric families (sub-ms edge
+resolution + job merge), config validation, and the pinned EMPTY
+serve_forward_step schedule.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.serving.admission import AdmissionQueue, ServeRequest
+from horovod_tpu.serving.shapes import ShapeBuckets, parse_buckets
+
+
+def _req(rid, n_tokens, arrival=None, deadline=None):
+    return ServeRequest(id=rid,
+                        tokens=np.arange(n_tokens, dtype=np.int32),
+                        arrival=(time.monotonic() if arrival is None
+                                 else arrival),
+                        deadline=deadline, seq_bucket=0)
+
+
+# -- shape buckets ------------------------------------------------------------
+
+def test_shape_bucket_selection_and_overflow():
+    b = ShapeBuckets(batch_buckets=(1, 2, 4), seq_buckets=(8, 32))
+    assert b.seq_bucket(1) == 8 and b.seq_bucket(8) == 8
+    assert b.seq_bucket(9) == 32
+    assert b.batch_bucket(3) == 4
+    assert b.bucket(3, 9).key == "b4xs32"
+    assert len(b) == 6
+    with pytest.raises(ValueError, match="largest seq bucket"):
+        b.seq_bucket(33)
+
+
+def test_shape_bucket_padding():
+    b = ShapeBuckets(batch_buckets=(1, 2, 4), seq_buckets=(8,))
+    rows = [np.array([1, 2, 3], np.int32), np.array([7], np.int32),
+            np.array([], np.int32)]
+    tokens, lengths = b.pad_batch(rows, 8)
+    assert tokens.shape == (4, 8)        # 3 rows -> batch bucket 4
+    assert list(tokens[0][:3]) == [1, 2, 3] and tokens[0][3:].sum() == 0
+    # empty rows clamp to length 1 so per-row gathers stay in bounds
+    assert list(lengths) == [3, 1, 1, 1]
+
+
+def test_parse_buckets_grammar():
+    assert parse_buckets("1, 2,8", "x") == (1, 2, 8)
+    for bad in ("", "0", "2,2", "8,4", "a,b"):
+        with pytest.raises(ValueError):
+            parse_buckets(bad, "x")
+
+
+# -- admission queue (the engine planner, reused) -----------------------------
+
+def test_admission_caps_batches_and_separates_seq_classes():
+    b = ShapeBuckets(batch_buckets=(1, 2, 4), seq_buckets=(8, 32))
+    q = AdmissionQueue(b, tick_s=0.0, max_batch=4)
+    for i in range(6):
+        q.submit(_req(f"s{i}", 5))          # seq class 8
+    for i in range(3):
+        q.submit(_req(f"l{i}", 20))         # seq class 32
+    batches = []
+    while True:
+        batch = q.take()
+        if batch is None:
+            break
+        batches.append(batch)
+    sizes = [(bt.seq_bucket, len(bt.requests)) for bt in batches]
+    # plan_fusion's byte cap became the batch cap: 4-then-2 in class 8,
+    # one 3-batch in class 32, never mixed
+    assert sorted(sizes) == [(8, 2), (8, 4), (32, 3)]
+    for bt in batches:
+        assert len({r.seq_bucket for r in bt.requests}) == 1
+    # FIFO inside a class: the planner's name sort is the ordinal
+    first = [r.id for r in batches[0].requests]
+    assert first == sorted(first)
+
+
+def test_admission_partial_batch_waits_one_tick():
+    b = ShapeBuckets(batch_buckets=(1, 4), seq_buckets=(8,))
+    q = AdmissionQueue(b, tick_s=10.0, max_batch=4)
+    now = time.monotonic()
+    q.submit(_req("a", 3, arrival=now))
+    # partial and young: held inside its tick window
+    assert q.take(now=now + 1.0) is None
+    # aged one tick: dispatches even partial (continuous batching)
+    batch = q.take(now=now + 10.01)
+    assert batch is not None and [r.id for r in batch.requests] == ["a"]
+    # a FULL batch never waits
+    for i in range(4):
+        q.submit(_req(f"f{i}", 3, arrival=now))
+    assert q.take(now=now + 0.001) is not None
+
+
+def test_admission_deadline_expires_queued_requests():
+    b = ShapeBuckets(batch_buckets=(1,), seq_buckets=(8,))
+    dead = []
+    q = AdmissionQueue(b, tick_s=0.0, max_batch=1,
+                       on_expired=dead.append)
+    now = time.monotonic()
+    q.submit(_req("dead", 2, arrival=now, deadline=now + 0.5))
+    q.submit(_req("live", 2, arrival=now))
+    batch = q.take(now=now + 1.0)
+    assert [r.id for r in batch.requests] == ["live"]
+    assert [r.id for r in dead] == ["dead"]
+    assert q.stats()["expired"] == 1
+
+
+def test_admission_requeue_rejoins_front_of_class():
+    b = ShapeBuckets(batch_buckets=(1, 4), seq_buckets=(8,))
+    q = AdmissionQueue(b, tick_s=0.0, max_batch=4)
+    now = time.monotonic()
+    for i in range(4):
+        q.submit(_req(f"r{i}", 3, arrival=now))
+    first = q.take(now=now + 1)
+    q.submit(_req("later", 3, arrival=now))
+    q.requeue(first.requests)     # worker died: original ordinals ride
+    again = q.take(now=now + 2)
+    # the requeued four precede the later submission
+    assert [r.id for r in again.requests] == ["r0", "r1", "r2", "r3"]
+    assert q.stats()["requeued"] == 4
+
+
+def test_admission_oldest_class_dispatches_first():
+    b = ShapeBuckets(batch_buckets=(1, 4), seq_buckets=(8, 32))
+    q = AdmissionQueue(b, tick_s=0.0, max_batch=4)
+    now = time.monotonic()
+    q.submit(_req("old_long", 20, arrival=now - 5))
+    q.submit(_req("new_short", 3, arrival=now))
+    batch = q.take(now=now)
+    # FIFO across shape classes: the older request's class goes first
+    # even though the short class sorts first in the plan
+    assert [r.id for r in batch.requests] == ["old_long"]
+
+
+# -- the serving plane end to end ---------------------------------------------
+
+@pytest.fixture
+def plane_srv():
+    from horovod_tpu.runner.rpc import JsonRpcServer
+    from horovod_tpu.serving.plane import ServingPlane
+    plane = ServingPlane(tick_ms=1.0, max_batch=4, seq_buckets="8,16",
+                         deadline_ms=0, lease_s=30.0)
+    srv = JsonRpcServer(plane.rpc_handlers(), secret=None)
+    yield plane, srv
+    plane.close()
+    srv.close()
+
+
+def _toy_worker(plane_srv, worker_id="0", **kw):
+    from horovod_tpu.serving.models import toy_echo_forward
+    from horovod_tpu.serving.worker import ServingWorker
+    plane, srv = plane_srv
+    fwd = toy_echo_forward(plane.buckets, burn_dim=16, burn_iters=1)
+    w = ServingWorker("127.0.0.1", srv.port, fwd, worker_id=worker_id,
+                      wait_s=1.0, secret=None, **kw)
+    w.start()
+    return w
+
+
+def test_plane_end_to_end_echo_and_stats(plane_srv, hvd):
+    from horovod_tpu.runner.rpc import json_request
+    plane, srv = plane_srv
+    w = _toy_worker(plane_srv)
+    try:
+        payloads = {f"q{i}": list(range(i + 1)) for i in range(10)}
+        json_request("127.0.0.1", srv.port, "serve_submit",
+                     {"requests": [{"id": k, "tokens": v}
+                                   for k, v in payloads.items()]},
+                     secret=None)
+        for rid, toks in payloads.items():
+            res = json_request("127.0.0.1", srv.port, "serve_result",
+                               {"id": rid, "wait_s": 20.0},
+                               secret=None)
+            assert res["done"] and not res.get("expired")
+            assert res["output"][:len(toks)] == [t * 2 + 1 for t in toks]
+            assert res["latency_s"] >= 0
+        st = plane.stats()
+        assert st["completed"] == 10 and st["queue"]["submitted"] == 10
+        assert st["workers"]["0"]["observations"] >= 1
+        # engine.stats() carries the serving section while components
+        # are live in this process
+        from horovod_tpu.runtime import _state
+        est = _state().engine.stats()
+        assert est["serving"]["plane"]["completed"] == 10
+    finally:
+        w.stop()
+        w.join(10)
+
+
+def test_plane_drain_fan_in(plane_srv, hvd):
+    from horovod_tpu.runner.rpc import json_request
+    plane, srv = plane_srv
+    w = _toy_worker(plane_srv)
+    try:
+        for i in range(6):
+            plane.submit([1, 2, 3], request_id=f"d{i}")
+        got = {}
+        deadline = time.monotonic() + 20
+        while len(got) < 6 and time.monotonic() < deadline:
+            reply = json_request("127.0.0.1", srv.port, "serve_drain",
+                                 {"wait_s": 2.0}, secret=None)
+            got.update(reply["results"])
+        assert sorted(got) == [f"d{i}" for i in range(6)]
+    finally:
+        w.stop()
+        w.join(10)
+
+
+def test_worker_gone_requeues_and_sibling_serves(plane_srv, hvd):
+    """Kill-worker semantics without a kill: a worker pulls a lease and
+    vanishes; worker_gone requeues; a live worker completes everything
+    — zero lost requests, first completion wins."""
+    plane, srv = plane_srv
+    for i in range(4):
+        plane.submit([5, 6, 7], request_id=f"k{i}")
+    # the "dying" worker pulls directly and never pushes
+    batch = plane.pull("dead", wait_s=5.0)
+    assert batch["rows"] >= 1
+    requeued = plane.worker_gone("dead")
+    assert requeued == batch["rows"]
+    assert plane.stats()["queue"]["requeued"] == requeued
+    w = _toy_worker((plane, srv), worker_id="alive")
+    try:
+        for i in range(4):
+            res = plane.result(f"k{i}", wait_s=20.0)
+            assert res["done"] and res["worker"] == "alive"
+        # the corpse's late push is acknowledged but dropped
+        late = plane.push("dead", batch["batch_id"],
+                          [[0] * 8] * batch["rows"], service_s=0.1)
+        assert late.get("stale")
+        assert plane.stats()["completed"] == 4
+    finally:
+        w.stop()
+        w.join(10)
+
+
+def test_retain_workers_requeues_departed_epoch_members(plane_srv, hvd):
+    plane, _srv = plane_srv
+    for i in range(2):
+        plane.submit([1], request_id=f"e{i}")
+    b0 = plane.pull("0", wait_s=5.0)
+    assert b0["rows"] >= 1
+    # re-form: only worker "1" survives into the new epoch
+    n = plane.retain_workers(["1"])
+    assert n == b0["rows"]
+
+
+def test_lease_reaper_requeues_silent_death(hvd):
+    from horovod_tpu.serving.plane import ServingPlane
+    plane = ServingPlane(tick_ms=1.0, max_batch=2, seq_buckets="8",
+                         deadline_ms=0, lease_s=0.2)
+    try:
+        plane.submit([1, 2], request_id="silent")
+        batch = plane.pull("ghost", wait_s=5.0)
+        assert batch["rows"] == 1
+        deadline = time.monotonic() + 10
+        while (plane.stats()["queue"]["requeued"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert plane.stats()["queue"]["requeued"] == 1
+    finally:
+        plane.close()
+
+
+def test_straggler_rotation(hvd):
+    from horovod_tpu.serving.plane import ServingPlane
+    plane = ServingPlane(tick_ms=1.0, max_batch=1, seq_buckets="8",
+                         deadline_ms=0, straggler_factor=3.0)
+    try:
+        def feed(worker, service_s, n):
+            for i in range(n):
+                plane.submit([1], request_id=f"{worker}.{i}")
+                batch = plane.pull(worker, wait_s=5.0)
+                lease = plane._leases[batch["batch_id"]]
+                # bench-free determinism: backdate the dispatch so the
+                # driver-side wall IS the intended service time
+                lease.t_dispatch = time.monotonic() - service_s
+                plane.push(worker, batch["batch_id"], [[3]],
+                           service_s=service_s)
+
+        feed("fast", 0.01, 4)
+        feed("slow", 0.40, 2)
+        assert not plane.stats()["workers"]["slow"]["rotated"]  # <3 obs
+        feed("slow", 0.40, 1)
+        st = plane.stats()["workers"]
+        assert st["slow"]["rotated"] and st["slow"]["rotated_at"]
+        assert not st["fast"]["rotated"]
+        # a rotated worker's pull parks empty
+        reply = plane.pull("slow", wait_s=0.05)
+        assert reply.get("rotated")
+        # the fast worker is never rotated below the noise floor even
+        # when peers' median is ~0
+        assert plane.rotations == 1
+    finally:
+        plane.close()
+
+
+def test_rotation_noise_floor(hvd):
+    """Sub-floor EWMAs never rotate, however slow relative to peers."""
+    from horovod_tpu.serving.plane import ServingPlane, _STRAGGLER_MIN_S
+    plane = ServingPlane(tick_ms=1.0, max_batch=1, seq_buckets="8",
+                         deadline_ms=0, straggler_factor=3.0)
+    try:
+        for worker, svc in (("a", 0.001), ("b", 0.02)):
+            for i in range(4):
+                plane.submit([1], request_id=f"{worker}.{i}")
+                batch = plane.pull(worker, wait_s=5.0)
+                plane._leases[batch["batch_id"]].t_dispatch = \
+                    time.monotonic() - svc
+                plane.push(worker, batch["batch_id"], [[3]],
+                           service_s=svc)
+        st = plane.stats()["workers"]
+        assert st["b"]["ewma_s"] < _STRAGGLER_MIN_S
+        assert not st["b"]["rotated"] and plane.rotations == 0
+    finally:
+        plane.close()
+
+
+def test_deadline_expires_before_dispatch(hvd):
+    from horovod_tpu.serving.plane import ServingPlane
+    plane = ServingPlane(tick_ms=1.0, max_batch=1, seq_buckets="8",
+                         deadline_ms=40.0, lease_s=1.0)
+    try:
+        plane.submit([9, 9], request_id="doomed")
+        time.sleep(0.15)                   # no worker pulls in time
+        res = plane.result("doomed", wait_s=5.0)
+        assert res["done"] and res["expired"]
+    finally:
+        plane.close()
+
+
+def test_sweep_expired_duplicate_ids_no_ndarray_eq(hvd):
+    """Review regression: two same-id pending requests (an idempotent
+    client resubmit) must expire by object identity — dataclass
+    equality over the ndarray field used to raise ambiguous-truth from
+    the reaper thread."""
+    b = ShapeBuckets(batch_buckets=(1,), seq_buckets=(8,))
+    dead = []
+    q = AdmissionQueue(b, tick_s=0.0, max_batch=1,
+                       on_expired=dead.append)
+    now = time.monotonic()
+    q.submit(_req("dup", 3, arrival=now, deadline=now + 0.1))
+    q.submit(_req("dup", 3, arrival=now, deadline=now + 0.2))
+    assert q.sweep_expired(now=now + 0.15) == 1
+    assert len(dead) == 1 and q.depth() == 1
+
+
+def test_completed_ids_dedup_is_bounded(hvd, monkeypatch):
+    """Review regression: the requeue/late-push dedup set must not
+    grow with job lifetime (the plane is a job-lifetime process)."""
+    from horovod_tpu.serving import plane as plane_mod
+    monkeypatch.setattr(plane_mod, "_COMPLETED_CACHE", 8)
+    plane = plane_mod.ServingPlane(tick_ms=1.0, max_batch=1,
+                                   seq_buckets="8", deadline_ms=0)
+    try:
+        for i in range(50):
+            plane._finish(f"c{i}", {"done": True})
+        assert len(plane._completed_ids) == 8
+        # LRU: the newest ids survive
+        assert "c49" in plane._completed_ids
+        assert "c0" not in plane._completed_ids
+    finally:
+        plane.close()
+
+
+def test_worker_gone_prunes_rotation_state(hvd):
+    """Review regression: a dead worker's stale EWMA must leave the
+    straggler peer median (and the worker table) — a ghost used to
+    shield a live straggler from rotation."""
+    from horovod_tpu.serving.plane import ServingPlane
+    plane = ServingPlane(tick_ms=1.0, max_batch=1, seq_buckets="8",
+                         deadline_ms=0, straggler_factor=3.0)
+    try:
+        def feed(worker, service_s, n):
+            for i in range(n):
+                plane.submit([1], request_id=f"{worker}.{i}")
+                batch = plane.pull(worker, wait_s=5.0)
+                plane._leases[batch["batch_id"]].t_dispatch = \
+                    time.monotonic() - service_s
+                plane.push(worker, batch["batch_id"], [[3]],
+                           service_s=service_s)
+
+        feed("ghost", 0.50, 4)      # slow, then dies
+        feed("fast", 0.01, 4)
+        plane.worker_gone("ghost")
+        assert "ghost" not in plane.stats()["workers"]
+        # the live straggler rotates against the LIVE median — the
+        # ghost's 0.5 s EWMA no longer drags it up (it rotates on its
+        # 3rd observation; a 4th pull would already be parked)
+        feed("slow", 0.20, 3)
+        assert plane.stats()["workers"]["slow"]["rotated"]
+    finally:
+        plane.close()
+
+
+# -- no-recompile discipline --------------------------------------------------
+
+def test_bucketed_forward_compile_accounting(hvd):
+    from horovod_tpu.serving.models import toy_echo_forward
+    b = ShapeBuckets(batch_buckets=(1, 2), seq_buckets=(8, 16))
+    fwd = toy_echo_forward(b, burn_dim=8, burn_iters=1)
+    assert fwd.warmup() == 4
+    stats = fwd.stats()
+    assert stats["compiles"] == 4 and stats["shapes_seen"] == 4
+    # steady state: every admitted shape is a cache hit
+    fwd(np.zeros((2, 8), np.int32), np.ones((2,), np.int32))
+    fwd(np.zeros((1, 16), np.int32), np.ones((1,), np.int32))
+    stats = fwd.stats()
+    assert stats["compiles"] == 4 and stats["recompiles"] == 0
+    # out-of-bucket shapes are refused, never compiled
+    with pytest.raises(ValueError, match="shape buckets"):
+        fwd(np.zeros((3, 8), np.int32), np.ones((3,), np.int32))
+
+
+# -- metrics: edge resolution + job merge -------------------------------------
+
+def test_serve_latency_edges_resolve_sub_ms(hvd):
+    """The satellite check: the 2^-10 floor (hvd_tail_lateness_seconds
+    precedent) canNOT separate 0.3 ms from 0.9 ms — both land under the
+    ~0.98 ms edge — so the serve-latency families use 2^-13, which
+    can.  Pinned against the live family declarations."""
+    import bisect
+
+    from horovod_tpu import metrics as _metrics
+    from horovod_tpu.metrics.registry import log2_edges
+
+    coarse = log2_edges(-10, 7)
+    fine = log2_edges(-13, 7)
+    a, b = 0.0003, 0.0009
+    assert bisect.bisect_left(coarse, a) == bisect.bisect_left(coarse, b)
+    assert bisect.bisect_left(fine, a) != bisect.bisect_left(fine, b)
+
+    import horovod_tpu.serving.plane   # noqa: F401 - declares families
+    import horovod_tpu.serving.worker  # noqa: F401
+    reg = {f.name: f for f in _metrics.registry().families()}
+    for fam in ("hvd_serve_request_latency_seconds",
+                "hvd_serve_e2e_latency_seconds",
+                "hvd_serve_admission_latency_seconds"):
+        assert (reg[fam].lo, reg[fam].hi) == (-13, 7), fam
+
+
+def test_serve_families_job_merge(hvd):
+    """Gauge/histogram merge semantics for the new families: counters
+    sum, histograms merge bucket-wise, gauges split per-worker
+    min/max/sum with owner attribution."""
+    from horovod_tpu.metrics import aggregate
+
+    def worker_text(depth, lat_bucket_counts, completed):
+        cum = 0
+        lines = [
+            "# TYPE hvd_serve_requests_total counter",
+            f'hvd_serve_requests_total{{outcome="completed"}} '
+            f"{completed}",
+            "# TYPE hvd_serve_queue_depth gauge",
+            f"hvd_serve_queue_depth {depth}",
+            "# TYPE hvd_serve_request_latency_seconds histogram",
+        ]
+        edges = ["0.0001220703125", "0.000244140625"]
+        for e, n in zip(edges, lat_bucket_counts):
+            cum += n
+            lines.append(
+                f'hvd_serve_request_latency_seconds_bucket{{le="{e}"}} '
+                f"{cum}")
+        lines.append(
+            f'hvd_serve_request_latency_seconds_bucket{{le="+Inf"}} '
+            f"{cum}")
+        lines.append(f"hvd_serve_request_latency_seconds_sum 1.0")
+        lines.append(
+            f"hvd_serve_request_latency_seconds_count {cum}")
+        return "\n".join(lines) + "\n"
+
+    merged = aggregate.merge({
+        "0": aggregate.parse_prometheus(worker_text(3, (2, 1), 5)),
+        "1": aggregate.parse_prometheus(worker_text(1, (1, 4), 7)),
+    })
+    reqs = merged["hvd_serve_requests_total"]["samples"]
+    assert [v for _, lbl, v in reqs
+            if lbl.get("outcome") == "completed"] == [12]
+    depth = {(lbl.get("agg"), lbl.get("worker")): v
+             for _, lbl, v in merged["hvd_serve_queue_depth"]["samples"]}
+    assert depth[("min", "1")] == 1 and depth[("max", "0")] == 3
+    assert depth[("sum", None)] == 4
+    lat = {lbl.get("le"): v for nm, lbl, v
+           in merged["hvd_serve_request_latency_seconds"]["samples"]
+           if nm.endswith("_bucket")}
+    assert lat["0.0001220703125"] == 3          # 2 + 1, bucket-wise
+    assert lat["0.000244140625"] == 8           # cumulative 3 + 5
+    # a worker with MISMATCHED edges must fail the merge loudly
+    bad = worker_text(1, (1, 1), 1).replace("0.000244140625", "0.0005")
+    with pytest.raises(ValueError, match="mismatched bucket edges"):
+        aggregate.merge({
+            "0": aggregate.parse_prometheus(worker_text(1, (1, 1), 1)),
+            "1": aggregate.parse_prometheus(bad)})
+
+
+# -- config validation --------------------------------------------------------
+
+def test_serve_config_validation(monkeypatch):
+    from horovod_tpu.config import Config
+    monkeypatch.setenv("HOROVOD_SERVE_TICK_MS", "5")
+    monkeypatch.setenv("HOROVOD_SERVE_MAX_BATCH", "16")
+    monkeypatch.setenv("HOROVOD_SERVE_SEQ_BUCKETS", "16,64")
+    c = Config.from_env()
+    assert (c.serve_tick_ms, c.serve_max_batch) == (5.0, 16)
+    assert c.serve_seq_buckets == "16,64"
+    for var, bad in (("HOROVOD_SERVE_TICK_MS", "-1"),
+                     ("HOROVOD_SERVE_MAX_BATCH", "0"),
+                     ("HOROVOD_SERVE_SEQ_BUCKETS", "64,16"),
+                     ("HOROVOD_SERVE_BATCH_BUCKETS", "2,2"),
+                     ("HOROVOD_SERVE_DEADLINE_MS", "-5"),
+                     ("HOROVOD_SERVE_LEASE_S", "0"),
+                     ("HOROVOD_SERVE_STRAGGLER_FACTOR", "0.5")):
+        monkeypatch.setenv(var, bad)
+        with pytest.raises(ValueError):
+            Config.from_env()
+        monkeypatch.delenv(var)
+
+
+def test_plane_respects_env_defaults(monkeypatch, hvd):
+    from horovod_tpu.serving.plane import ServingPlane
+    monkeypatch.setenv("HOROVOD_SERVE_MAX_BATCH", "2")
+    monkeypatch.setenv("HOROVOD_SERVE_SEQ_BUCKETS", "4,8")
+    plane = ServingPlane()
+    try:
+        assert plane.buckets.seq_buckets == (4, 8)
+        assert plane.buckets.max_batch == 2
+        with pytest.raises(ValueError, match="largest seq bucket"):
+            plane.submit(list(range(9)))
+    finally:
+        plane.close()
+
+
+# -- elastic driver wiring ----------------------------------------------------
+
+def test_elastic_driver_attach_serving(hvd):
+    """attach_serving joins the serve data path to the driver's control
+    server and routes worker deaths into lease requeue."""
+    from horovod_tpu.elastic.discovery import FixedHostDiscovery
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.rpc import json_request
+    from horovod_tpu.serving.plane import ServingPlane
+
+    driver = ElasticDriver(FixedHostDiscovery({"localhost": 2}),
+                           ["true"], min_np=1, max_np=2, port=0)
+    plane = ServingPlane(tick_ms=1.0, max_batch=2, seq_buckets="8",
+                         deadline_ms=0)
+    try:
+        driver.attach_serving(plane)
+        json_request("127.0.0.1", driver._server.port, "serve_submit",
+                     {"id": "via_driver", "tokens": [1, 2]})
+        batch = plane.pull("3", wait_s=5.0)
+        assert batch["ids"] == ["via_driver"]
+        # the reaper's hook: a dead worker's lease requeues
+        driver._serving.worker_gone(3)
+        assert plane.stats()["queue"]["requeued"] == 1
+        # serve/stats rides the driver's GET routes
+        import json as _json
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{driver._server.port}/serve/stats",
+                timeout=5) as resp:
+            st = _json.loads(resp.read())
+        assert st["queue"]["requeued"] == 1
+    finally:
+        plane.close()
+        driver._server.close()
+        if driver._kv_server is not None:
+            driver._kv_server.close()
+
+
+# -- the pinned empty schedule ------------------------------------------------
+
+def test_serve_forward_step_schedule_is_empty(hvd):
+    """A serving forward must never negotiate a gradient collective:
+    the builtin entry's schedule has ZERO collective records (the
+    committed snapshot + HVD211 keep it that way)."""
+    from horovod_tpu.analysis.schedule import builtin_schedule
+    sched = builtin_schedule("serve_forward_step")
+    assert sched.records == []

@@ -43,10 +43,9 @@ _TOKEN = json.dumps(
 
 
 def _percentile(sorted_vals, q):
-    if not sorted_vals:
-        return float("nan")
-    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
-    return sorted_vals[idx]
+    # lazy: sys.path gains the repo inside run_worker/_spawn_and_collect
+    from horovod_tpu.metrics.aggregate import percentile
+    return percentile(sorted_vals, q)
 
 
 # -- worker -------------------------------------------------------------------

@@ -1,0 +1,486 @@
+#!/usr/bin/env python
+"""Serving-plane loopback bench: the four tail-latency gates, CPU-only.
+
+Real OS worker processes run real ``ServingWorker`` pull loops against a
+real ``ServingPlane`` over the HMAC-free loopback RPC transport; the
+driver sweeps an OPEN-LOOP (seeded Poisson) arrival process over the
+``serve_submit`` data path and measures per-request end-to-end latency
+from the result stream.  Every gate must hold every run:
+
+1. **throughput**: at ~0.9x the sequential path's capacity the cap-1
+   plane queues hard (that IS the sequential serving system); the
+   batched plane at >= 3x that offered load must complete everything
+   with p50 no worse — micro-batching buys >= 3x throughput at equal
+   p50.
+2. **tail under chaos**: under the pinned ``serve.batch worker=1``
+   delay seed one worker straggles every batch; the plane's EWMA
+   rotation must evict it and the post-rotation p99 must sit under the
+   bound (while the pre-rotation max proves the seed was not inert).
+3. **elasticity**: SIGKILL a worker mid-traffic; the lease reaper
+   requeues its in-flight batch and every request still completes with
+   the right answer — zero lost requests.
+4. **no recompiles**: across the whole sweep every worker's forward
+   compiles at most once per shape bucket and never after warmup
+   (``recompiles == 0``) — the compile-cache hit-rate invariant.
+
+    python tools/bench_serve.py            # full sweep
+    python tools/bench_serve.py --smoke    # CI: small matrix, all gates
+
+Results print as JSON; see docs/serving.md and docs/performance.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: The pinned chaos seed of gate 2: worker 1 sleeps on EVERY batch.
+CHAOS_DELAY_S = 0.25
+CHAOS_RULE = f"serve.batch worker=1 every=1 action=delay:{CHAOS_DELAY_S}"
+CHAOS_SEED = 7
+
+SEQ_BUCKETS = "8,16,32"
+MAX_BATCH = 8
+
+
+def _percentile(sorted_vals, q):
+    # lazy: sys.path gains the repo inside worker/_Phase setup
+    from horovod_tpu.metrics.aggregate import percentile
+    return percentile(sorted_vals, q)
+
+
+# -- worker -------------------------------------------------------------------
+
+def run_worker(args) -> int:
+    sys.path.insert(0, REPO)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from horovod_tpu.runner.rpc import JsonRpcServer
+    from horovod_tpu.serving.models import toy_echo_forward
+    from horovod_tpu.serving.shapes import ShapeBuckets
+    from horovod_tpu.serving.worker import ServingWorker
+
+    buckets = ShapeBuckets(
+        batch_buckets=tuple(1 << i for i in range(MAX_BATCH.bit_length())
+                            if (1 << i) <= MAX_BATCH),
+        seq_buckets=tuple(int(s) for s in SEQ_BUCKETS.split(",")))
+    fwd = toy_echo_forward(buckets)
+    # per-worker metrics exposition: the plane learns the port from the
+    # pull payload, so the driver can scrape-and-merge /metrics across
+    # workers exactly like the elastic driver's /metrics/job
+    msrv = JsonRpcServer({}, secret=None)
+    worker = ServingWorker(args.addr, args.port, fwd,
+                           worker_id=str(args.id), wait_s=2.0,
+                           secret=None, metrics_port=msrv.port,
+                           warmup=True)
+    worker.run()   # returns on the plane's {"stop"} after close()
+    with open(args.out, "w") as f:
+        json.dump(worker.stats(), f)
+    msrv.close()
+    return 0
+
+
+# -- driver -------------------------------------------------------------------
+
+class _Phase:
+    """One plane + worker-pool lifecycle."""
+
+    def __init__(self, n_workers: int, max_batch: int,
+                 chaos: str = "", lease_s: float = 10.0,
+                 straggler_factor: float = 0.0, tmp: str = "."):
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        from horovod_tpu.runner.rpc import JsonRpcServer
+        from horovod_tpu.serving.plane import ServingPlane
+        # buckets always cover the full batch table; ``max_batch`` only
+        # moves the ADMISSION cap (cap 1 = the sequential baseline —
+        # same plane, same workers, one request per forward)
+        self.plane = ServingPlane(
+            tick_ms=2.0, max_batch=MAX_BATCH, seq_buckets=SEQ_BUCKETS,
+            deadline_ms=0, lease_s=lease_s,
+            straggler_factor=straggler_factor)
+        if max_batch != MAX_BATCH:
+            self.plane.set_max_batch(max_batch)
+        self.srv = JsonRpcServer(self.plane.rpc_handlers(), secret=None)
+        self.tmp = tmp
+        self.procs = []
+        for wid in range(n_workers):
+            env = dict(os.environ)
+            env.update({"JAX_PLATFORMS": "cpu",
+                        "HOROVOD_TPU_FORCE_PLATFORM": "cpu",
+                        "PYTHONPATH": REPO + os.pathsep
+                        + env.get("PYTHONPATH", "")})
+            env.pop("HOROVOD_SECRET_KEY", None)
+            if chaos:
+                env["HVD_CHAOS"] = chaos
+                env["HVD_CHAOS_SEED"] = str(CHAOS_SEED)
+            else:
+                env.pop("HVD_CHAOS", None)
+            out = os.path.join(tmp, f"w{len(self.procs)}_{wid}.json")
+            cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+                   "--id", str(wid), "--addr", "127.0.0.1",
+                   "--port", str(self.srv.port), "--out", out]
+            self.procs.append((subprocess.Popen(cmd, env=env), out, wid))
+
+    def wait_ready(self, timeout: float = 180.0):
+        """Block until every worker has pulled once (jax import +
+        shape warmup are seconds; traffic must not race them)."""
+        deadline = time.monotonic() + timeout
+        want = len(self.procs)
+        while time.monotonic() < deadline:
+            if len(self.plane.stats()["workers"]) >= want:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"only {len(self.plane.stats()['workers'])}"
+                           f"/{want} bench workers came up")
+
+    def submit(self, rid: str, tokens):
+        from horovod_tpu.runner.rpc import json_request
+        json_request("127.0.0.1", self.srv.port, "serve_submit",
+                     {"id": rid, "tokens": tokens}, secret=None)
+
+    def result(self, rid: str, wait_s: float = 30.0):
+        # one serve_result hold is server-capped at 30 s; re-poll up to
+        # the caller's deadline so a slow machine waits, never asserts
+        from horovod_tpu.runner.rpc import json_request
+        deadline = time.monotonic() + wait_s
+        while True:
+            hold = min(max(deadline - time.monotonic(), 0.0), 20.0)
+            res = json_request("127.0.0.1", self.srv.port,
+                               "serve_result",
+                               {"id": rid, "wait_s": hold},
+                               timeout=hold + 10.0, secret=None)
+            if res.get("done") or time.monotonic() >= deadline:
+                return res
+
+    def drain(self, wait_s: float = 1.0):
+        from horovod_tpu.runner.rpc import json_request
+        return json_request("127.0.0.1", self.srv.port, "serve_drain",
+                            {"wait_s": wait_s}, timeout=wait_s + 10.0,
+                            secret=None)
+
+    def close(self, expect_stats: bool = True) -> list:
+        self.plane.close()
+        stats = []
+        for proc, out, wid in self.procs:
+            try:
+                rc = proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                rc = proc.wait()
+            if rc == 0 and os.path.exists(out):
+                with open(out) as f:
+                    stats.append(json.load(f))
+            elif expect_stats and rc not in (0, -9):
+                raise RuntimeError(f"bench worker {wid} exited {rc}")
+        self.srv.close()
+        return stats
+
+
+def _open_loop(phase: _Phase, n: int, rate: float, seed: int,
+               rng_tokens, tag: str, submitters: int = 4):
+    """Submit ``n`` requests at seeded-Poisson ``rate``; wait for every
+    result; returns (latencies sorted, per-request records, wall).
+
+    The arrival SCHEDULE (tokens + absolute due times) is pre-generated
+    single-threaded from the seed, then driven by several submitter
+    threads — one thread's POST round-trip must not throttle the
+    offered rate below the schedule.
+    """
+    rng = random.Random(seed)
+    toks_list = [rng_tokens(rng) for _ in range(n)]
+    due = []
+    t_acc = 0.0
+    for _ in range(n):
+        t_acc += rng.expovariate(rate)
+        due.append(t_acc)
+    expected = {f"{tag}{i}": toks_list[i] for i in range(n)}
+    submits: dict = {}
+    records: dict = {}
+    lock = threading.Lock()
+    fail = []
+    t0 = time.monotonic()
+
+    def collector():
+        # one fan-in serve_drain long-poll instead of a result poll per
+        # request: the client must not throttle the offered rate
+        hard = time.monotonic() + 120
+        try:
+            while len(records) < n and time.monotonic() < hard:
+                reply = phase.drain(wait_s=1.0)
+                t_done = time.monotonic()
+                for rid, res in reply.get("results", {}).items():
+                    toks = expected.get(rid)
+                    if toks is None:
+                        continue
+                    assert res.get("done") and not res.get("expired"), \
+                        (rid, res)
+                    got = (res.get("output") or [])[:len(toks)]
+                    assert got == [t * 2 + 1 for t in toks], \
+                        f"{tag}: wrong answer for {rid}"
+                    records[rid] = {"lat": float(res["latency_s"]),
+                                    "t_done": t_done}
+        except Exception as e:  # noqa: BLE001 - surfaced by the join
+            fail.append(e)
+
+    col = threading.Thread(target=collector, daemon=True)
+    col.start()
+
+    def submit_loop(indices):
+        for i in indices:
+            target = t0 + due[i]
+            while True:
+                dt = target - time.monotonic()
+                if dt <= 0:
+                    break
+                time.sleep(min(dt, 0.0005))
+            rid = f"{tag}{i}"
+            t_submit = time.monotonic()
+            phase.submit(rid, toks_list[i])
+            with lock:
+                submits[rid] = t_submit
+
+    subs = [threading.Thread(
+        target=submit_loop, args=(range(k, n, submitters),), daemon=True)
+        for k in range(submitters)]
+    for th in subs:
+        th.start()
+    for th in subs:
+        th.join(timeout=120)
+        assert not th.is_alive(), f"{tag}: submitter wedged"
+    col.join(timeout=120)
+    if fail:
+        raise fail[0]
+    assert len(records) == n, (f"{tag}: {len(records)}/{n} requests "
+                               f"completed")
+    wall = max(r["t_done"] for r in records.values()) - t0
+    recs = [{"rid": rid, "t_submit": submits[rid],
+             "t_done": r["t_done"], "lat": r["lat"]}
+            for rid, r in records.items()]
+    lats = sorted(r["lat"] for r in recs)
+    return lats, recs, wall
+
+
+def _tokens_sampler(rng):
+    # lengths sweep all three seq buckets (workers pre-warm every
+    # bucket, so this only varies which compiled shapes serve)
+    length = rng.choice((5, 8, 13, 16, 21, 32))
+    return [rng.randrange(0, 100) for _ in range(length)]
+
+
+def _short_sampler(rng):
+    # one seq class: the latency-gated phases keep the arrival stream
+    # in a single shape bucket so micro-batches fill instead of
+    # fragmenting across classes (real fleets route per shape class)
+    length = rng.choice((3, 5, 8))
+    return [rng.randrange(0, 100) for _ in range(length)]
+
+
+def _gate(report, name, ok, detail):
+    report["gates"][name] = {"ok": bool(ok), **detail}
+    status = "PASS" if ok else "FAIL"
+    print(f"gate {name}: {status} {json.dumps(detail)}", file=sys.stderr)
+    if not ok:
+        report["failed"] = True
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="CI run: small request counts, all four gates")
+    p.add_argument("--seed", type=int, default=5)
+    p.add_argument("--n-seq", type=int, default=150)
+    p.add_argument("--n-batched", type=int, default=400)
+    p.add_argument("--n-chaos", type=int, default=300)
+    p.add_argument("--n-kill", type=int, default=200)
+    # internal: worker mode
+    p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--id", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--addr", default="127.0.0.1", help=argparse.SUPPRESS)
+    p.add_argument("--port", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--out", default="", help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.worker:
+        return run_worker(args)
+
+    if args.smoke:
+        args.n_seq, args.n_batched = 80, 240
+        args.n_chaos, args.n_kill = 300, 120
+
+    import tempfile
+    report = {"gates": {}, "failed": False}
+    all_worker_stats = []
+    with tempfile.TemporaryDirectory(prefix="bench_serve_") as tmp:
+        # ---- gates 1 + 4: sequential baseline vs batched, one worker ----
+        phase = _Phase(n_workers=1, max_batch=1, tmp=tmp)
+        try:
+            phase.wait_ready()
+            # closed-loop service probe: per-request latency with no
+            # queueing — the sequential system's service time (sweeps
+            # every seq class; the worker pre-warmed all shapes)
+            svc = []
+            rng = random.Random(args.seed)
+            for i in range(24):
+                rid = f"probe{i}"
+                t0 = time.monotonic()
+                phase.submit(rid, _tokens_sampler(rng))
+                res = phase.result(rid, wait_s=60.0)
+                assert res.get("done"), res
+                svc.append(time.monotonic() - t0)
+            svc_p50 = _percentile(sorted(svc), 0.5)
+            # the sequential serving system AT LOAD: ~0.85x its
+            # capacity, Poisson arrivals — the queueing its p50 pays
+            # there is the cost micro-batching exists to remove
+            seq_rate = 0.85 / svc_p50
+            lats_seq, _, wall_seq = _open_loop(
+                phase, args.n_seq, seq_rate, args.seed + 1,
+                _short_sampler, "seq")
+            thr_seq = args.n_seq / wall_seq
+
+            phase.plane.set_max_batch(MAX_BATCH)
+            batched_rate = 3.5 * thr_seq
+            lats_b, _, wall_b = _open_loop(
+                phase, args.n_batched, batched_rate, args.seed + 2,
+                _short_sampler, "bat")
+            thr_b = args.n_batched / wall_b
+        finally:
+            all_worker_stats += phase.close()
+        p50_seq = _percentile(lats_seq, 0.5)
+        p50_b = _percentile(lats_b, 0.5)
+        report["sequential"] = {
+            "service_p50_ms": round(svc_p50 * 1e3, 2),
+            "offered_rps": round(seq_rate, 1),
+            "throughput_rps": round(thr_seq, 1),
+            "p50_ms": round(p50_seq * 1e3, 2),
+            "p99_ms": round(_percentile(lats_seq, 0.99) * 1e3, 2)}
+        report["batched"] = {
+            "offered_rps": round(batched_rate, 1),
+            "throughput_rps": round(thr_b, 1),
+            "p50_ms": round(p50_b * 1e3, 2),
+            "p99_ms": round(_percentile(lats_b, 0.99) * 1e3, 2)}
+        _gate(report, "throughput_3x_at_equal_p50",
+              # "equal p50" with a 10% measurement tolerance: both
+              # medians ride loopback RPC + scheduler noise
+              thr_b >= 3.0 * thr_seq and p50_b <= 1.10 * p50_seq,
+              {"speedup": round(thr_b / max(thr_seq, 1e-9), 2),
+               "p50_seq_ms": round(p50_seq * 1e3, 2),
+               "p50_batched_ms": round(p50_b * 1e3, 2)})
+
+        # ---- gate 2: chaos straggler + rotation ----
+        phase = _Phase(n_workers=3, max_batch=MAX_BATCH,
+                       chaos=CHAOS_RULE, straggler_factor=3.0, tmp=tmp)
+        try:
+            phase.wait_ready()
+            lats_c, recs_c, _ = _open_loop(
+                phase, args.n_chaos, 1.5 * thr_seq, args.seed + 3,
+                _short_sampler, "chaos")
+            stats = phase.plane.stats()
+        finally:
+            all_worker_stats += phase.close()
+        rotated = [wid for wid, w in stats["workers"].items()
+                   if w["rotated"]]
+        # tail window: requests submitted after the rotation landed
+        # (plus one injected-delay drain margin) must see healthy-path
+        # latency — the straggler's last held batch finishes slow, but
+        # nothing NEW rides it
+        rot_at = max((w["rotated_at"] or 0.0
+                      for w in stats["workers"].values()), default=0.0)
+        tail = sorted(r["lat"] for r in recs_c
+                      if r["t_submit"] >= rot_at + CHAOS_DELAY_S)
+        p99_tail = _percentile(tail, 0.99)
+        worst = max(lats_c)
+        bound = 0.6 * CHAOS_DELAY_S
+        report["chaos"] = {
+            "rule": CHAOS_RULE, "seed": CHAOS_SEED,
+            "rotated_workers": rotated,
+            "p99_all_ms": round(_percentile(lats_c, 0.99) * 1e3, 2),
+            "post_rotation_n": len(tail),
+            "p99_post_rotation_ms": round(p99_tail * 1e3, 2),
+            "max_ms": round(worst * 1e3, 2),
+            "bound_ms": round(bound * 1e3, 2)}
+        _gate(report, "chaos_p99_bounded_with_rotation",
+              rotated == ["1"] and len(tail) >= args.n_chaos // 6
+              and p99_tail <= bound and worst >= CHAOS_DELAY_S,
+              {"rotated": rotated, "post_rotation_n": len(tail),
+               "p99_post_rotation_ms": round(p99_tail * 1e3, 2),
+               "bound_ms": round(bound * 1e3, 2),
+               "seed_not_inert_max_ms": round(worst * 1e3, 2)})
+
+        # ---- gate 3: kill a worker mid-traffic ----
+        # the victim (worker 0) gets one injected 1.2 s batch hold; the
+        # assassin SIGKILLs it MID-LEASE, so the requeue path is
+        # exercised every run, not only on lucky timing
+        phase = _Phase(n_workers=2, max_batch=MAX_BATCH, lease_s=2.0,
+                       chaos="serve.batch worker=0 nth=10 "
+                             "action=delay:1.2", tmp=tmp)
+        killed = {"done": False}
+        try:
+            phase.wait_ready()
+            victim = phase.procs[0][0]
+
+            def assassin():
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    if "0" in phase.plane.stats()["leased_workers"]:
+                        # re-check after a beat: a normal ~ms lease has
+                        # been pushed by now; the injected hold has not
+                        time.sleep(0.15)
+                        if "0" in phase.plane.stats()["leased_workers"]:
+                            victim.kill()
+                            killed["done"] = True
+                            return
+                    time.sleep(0.002)
+
+            th = threading.Thread(target=assassin, daemon=True)
+            th.start()
+            lats_k, _, _ = _open_loop(
+                phase, args.n_kill, 2.0 * thr_seq, args.seed + 4,
+                _tokens_sampler, "kill")
+            th.join(timeout=60)
+            kstats = phase.plane.stats()
+        finally:
+            all_worker_stats += phase.close(expect_stats=False)
+        requeued = kstats["queue"]["requeued"]
+        _gate(report, "kill_worker_zero_lost",
+              killed["done"] and len(lats_k) == args.n_kill
+              and kstats["completed"] == args.n_kill and requeued >= 1,
+              {"killed": killed["done"],
+               "completed": kstats["completed"], "expected": args.n_kill,
+               "requeued": requeued,
+               "p99_ms": round(_percentile(lats_k, 0.99) * 1e3, 2)})
+
+        # ---- gate 4: zero recompiles after warmup ----
+        n_buckets_max = 4 * len(SEQ_BUCKETS.split(","))  # batch x seq
+        fwd = [s.get("forward", {}) for s in all_worker_stats]
+        recompiles = sum(f.get("recompiles", 0) for f in fwd)
+        over = [f for f in fwd
+                if f.get("compiles", 0) > n_buckets_max
+                or f.get("compiles", 0) != f.get("shapes_seen", 0)]
+        seen = max((f.get("shapes_seen", 0) for f in fwd), default=0)
+        _gate(report, "zero_recompiles_after_warmup",
+              recompiles == 0 and not over and seen >= 3
+              and len(fwd) >= 4,
+              {"recompiles": recompiles, "workers_reporting": len(fwd),
+               "max_shapes_seen": seen,
+               "bucket_ceiling": n_buckets_max})
+
+    print(json.dumps(report, indent=2))
+    if report["failed"]:
+        print("bench_serve: GATE FAILURE", file=sys.stderr)
+        return 1
+    if args.smoke:
+        print("bench_serve smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,14 +49,21 @@
 #      must be the verdict) — and tools/hvddoctor --smoke —
 #      training-health verdict under a pinned collective.corrupt seed
 #      (the evaluator must name the injected rank+bucket via
-#      GET /health/job; the clean run must stay verdict-free)
+#      GET /health/job; the clean run must stay verdict-free) — and
+#      tools/bench_serve.py --smoke — serving-plane invariants
+#      (batched >= 3x sequential throughput at equal p50, chaos-seeded
+#      straggler rotated out with post-rotation p99 bounded,
+#      kill-worker-mid-lease re-forms with zero lost requests, zero
+#      post-warmup recompiles across the shape buckets)
 #  11. hvdsched: re-trace the builtin step entries to jaxprs on CPU and
 #      diff their collective schedules against tests/schedules/
 #      (HVD211 drift; incl. the sharded_distopt_step reduce_scatter →
 #      all_gather plan and the tail_distopt_step rewritten DCN stage) +
 #      the cross-mesh-size consistency check
 #      (HVD210); any fusion-plan change is an explicit snapshot update
-#      in review (docs/analysis.md "Schedule snapshots")
+#      in review (docs/analysis.md "Schedule snapshots"); incl. the
+#      EMPTY serve_forward_step entry (a serving forward must never
+#      negotiate a gradient collective)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -268,6 +275,59 @@ htrace_critical.analyze(trace)   # analyzable, not just parseable
 for _s in (wsrvA, wsrvB, tsrv):
     _s.close()
 
+# serving plane (ISSUE 15): an in-process plane + worker serve a small
+# request burst end to end; hvd_serve_requests_total and a computable
+# p99 from the request-latency histogram must ride a /metrics/job-shaped
+# scrape-and-merge, and engine.stats() must grow a "serving" section
+from horovod_tpu.serving.models import toy_echo_forward
+from horovod_tpu.serving.plane import ServingPlane
+from horovod_tpu.serving.worker import ServingWorker
+splane = ServingPlane(tick_ms=2.0, max_batch=8, seq_buckets="8,16",
+                      deadline_ms=0)
+ssrv = JsonRpcServer(splane.rpc_handlers(), secret=None)
+sworker = ServingWorker("127.0.0.1", ssrv.port,
+                        toy_echo_forward(splane.buckets, burn_dim=32,
+                                         burn_iters=1),
+                        worker_id="0", wait_s=2.0, secret=None)
+sworker.start()
+from horovod_tpu.runner.rpc import json_request as _jr
+sids = []
+for i in range(12):
+    toks = [i, i + 1, i + 2]
+    _jr("127.0.0.1", ssrv.port, "serve_submit",
+        {"id": f"smoke{i}", "tokens": toks}, secret=None)
+    sids.append((f"smoke{i}", toks))
+for rid, toks in sids:
+    res = _jr("127.0.0.1", ssrv.port, "serve_result",
+              {"id": rid, "wait_s": 20.0}, secret=None)
+    assert res.get("done") and res["output"][:3] == [t * 2 + 1
+                                                    for t in toks], res
+from horovod_tpu.runtime import _state as _hvd_state
+est = _hvd_state().engine.stats()
+assert est.get("serving", {}).get("plane", {})["completed"] == 12, \
+    est.get("serving")
+# job-shaped merge over this worker's /metrics: the serve families
+# must merge and the latency histogram must yield a p99
+merged = aggregate.parse_prometheus(aggregate.scrape_and_merge(
+    {"0": ("127.0.0.1", srv.port)}))
+sreq = sum(v for _, lbl, v
+           in merged["hvd_serve_requests_total"]["samples"]
+           if lbl.get("outcome") == "completed")
+assert sreq >= 12, merged["hvd_serve_requests_total"]["samples"]
+slat = [(lbl.get("le"), v) for nm, lbl, v
+        in merged["hvd_serve_request_latency_seconds"]["samples"]
+        if nm.endswith("_bucket")]
+scount = sum(v for nm, _, v
+             in merged["hvd_serve_request_latency_seconds"]["samples"]
+             if nm.endswith("_count"))
+assert scount >= 12, scount
+sp99 = next(float(le) for le, cum in slat
+            if le != "+Inf" and cum >= 0.99 * scount)
+assert sp99 < 128.0, sp99   # inside the histogram's finite edges
+splane.close()
+sworker.stop(); sworker.join(10)
+ssrv.close()
+
 fams = aggregate.parse_prometheus(aggregate.scrape("127.0.0.1", srv.port))
 def _family_count(fam, **want):
     return sum(v for _, lbl, v in fams[fam]["samples"]
@@ -293,7 +353,8 @@ print(f"dist smoke OK (incl. /metrics + /healthz + /trace/job + "
       f"/health/job scrape, {int(watch_rounds)} watch rounds, "
       f"{int(reuse_hits)} keep-alive hits, {int(overlap_buckets)} "
       f"overlap buckets, {len(host_pids)} trace host pids, job health "
-      f"{hjob['verdict']}), imported from",
+      f"{hjob['verdict']}, {int(sreq)} served requests @ p99<="
+      f"{sp99:g}s), imported from",
       os.path.dirname(hvd.__file__))
 PYEOF
   )
@@ -404,6 +465,14 @@ tail -1 /tmp/ci_hvdtrace.log
 bash tools/hvddoctor --smoke > /tmp/ci_hvddoctor.log 2>&1 \
   || { tail -30 /tmp/ci_hvddoctor.log; exit 1; }
 tail -1 /tmp/ci_hvddoctor.log
+# serving plane: real worker processes against a real ServingPlane on
+# loopback — all four tail-latency gates must hold every run (batched
+# >= 3x sequential at equal p50, chaos straggler rotated with p99
+# bounded, SIGKILL-mid-lease loses zero requests, zero post-warmup
+# recompiles).  (docs/serving.md)
+python tools/bench_serve.py --smoke > /tmp/ci_bench_serve.log 2>&1 \
+  || { tail -30 /tmp/ci_bench_serve.log; exit 1; }
+tail -1 /tmp/ci_bench_serve.log
 
 echo "== 11/11 hvdsched: collective-schedule snapshots + consistency =="
 # re-trace every builtin step entry to a jaxpr on CPU, diff against the
