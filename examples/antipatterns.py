@@ -26,6 +26,15 @@ and the comment shows the corrected form.  The bugs:
 * HVD210 — rank_asymmetric_toy_step: a step whose COMPILED collective
            schedule depends on the rank (the hvdsched extractor's
            teaching fixture; tests/test_schedule.py traces both ranks)
+* HVD300–HVD307 — the cross-layer contract-drift family: an
+           undocumented raw env read, a validated-but-undocumented
+           config row, phantom metric families, one histogram with two
+           bucket-edge sets, orphan RPC surfaces on both sides, inert /
+           typo'd chaos seeds, a mislabelled metric call site, and a
+           short negotiation-token producer whose consumer indexes past
+           its arity.  Every name is FAKE: the contract engine reasons
+           repo-wide, and a real name would silently satisfy (or dirty)
+           the real registries.
 """
 
 import socket
@@ -244,6 +253,94 @@ def rank_asymmetric_toy_step(rank):
             g = jax.lax.psum(g, "workers")   # only rank 0's trace has this
         return jax.lax.psum(g, "workers")
     return step
+
+
+# ---------------------------------------------------------------------------
+# cross-layer contract-drift fixtures (HVD300–HVD307, engine 5)
+# ---------------------------------------------------------------------------
+
+import os
+
+from horovod_tpu import metrics as _metrics
+from horovod_tpu.config import _env_int
+from horovod_tpu.ops.controller import token_fields
+from horovod_tpu.runner.rpc import JsonRpcServer, json_request
+
+# HVD305 (inert seed): no code path anywhere fires 'phantom.site', so
+# the chaos regression test this seed powers injects nothing — silently.
+INERT_CHAOS_SEED = "phantom.site nth=1 action=drop"
+
+# HVD305 (unknown action): the site is real, the action is a typo —
+# FaultSchedule.parse would fail loudly at install time.
+TYPOD_CHAOS_SEED = "collective.corrupt every=1 action=explode"
+
+
+def undocumented_env_read():
+    # HVD300: a raw environ read with no validated config.py row and no
+    # docs/env.md entry — an operator can neither discover nor trust it.
+    # Fix: parse it in Config.from_env() or document it in docs/env.md.
+    return os.environ.get("HOROVOD_ANTIPATTERN_PHANTOM_KNOB", "0")
+
+
+def from_env():
+    # HVD301: parsed through the validated _env_* config layer — so it
+    # IS a config row — but docs/env.md never documents it.  Fix: add
+    # the docs/env.md table row (the env table is the operator contract).
+    return _env_int("HOROVOD_ANTIPATTERN_UNDOCUMENTED", 7)
+
+
+def phantom_metric_family():
+    # HVD302: the family is created here but docs/metrics.md does not
+    # list it — dashboards and the job-level merge are built from that
+    # table.  Fix: add the docs row (or delete the dead family).
+    reg = _metrics.registry()
+    return reg.counter("hvd_antipattern_phantom_total",
+                       "created but never documented")
+
+
+def edge_mismatched_histograms():
+    # HVD303: ONE family, TWO bucket-edge sets — the driver's job-level
+    # merge sums buckets edge-wise and raises ValueError on the
+    # mismatch.  Fix: one (lo, hi) for every declaration of the family.
+    reg = _metrics.registry()
+    fast = reg.histogram("hvd_antipattern_latency_seconds", "fast path")
+    slow = reg.histogram("hvd_antipattern_latency_seconds", "slow path",
+                         lo=-13)
+    return fast, slow
+
+
+def orphan_rpc_surfaces():
+    # HVD304 (client): no JsonRpcServer/add_handlers table anywhere
+    # registers this method — a guaranteed 'unknown method' error.
+    json_request("127.0.0.1", 1, "antipattern_telemetry_push", {})
+    # HVD304 (handler): registered, but no client ever requests it —
+    # dead wire surface.  Fix: delete it (or call it).
+    return JsonRpcServer({"antipattern_dead_handler": lambda body: {}})
+
+
+def mislabelled_metric_call():
+    # HVD307: the family declares labels=("kind",) but the call site
+    # passes flavor= — the registry silently drops the unknown label
+    # and the series the author meant to split never materializes.
+    reg = _metrics.registry()
+    labeled = reg.counter("hvd_antipattern_labeled_total",
+                          "labelled family", labels=("kind",))
+    labeled.inc(kind="x", flavor="vanilla")
+
+
+def entry_token(entries):
+    # HVD306 (producer): a negotiation-token sig row with only FOUR
+    # fields — the real controller emits 13 (append-only schema).
+    rows = [[e.name, e.op, e.dtype, e.shape] for e in entries]
+    return str(rows)
+
+
+def read_past_token_arity(token):
+    # HVD306 (consumer): indexes sig field [9] of the 4-field producer
+    # above — an IndexError at negotiation time.  Fix: keep producer
+    # and every consumer in lockstep (append-only fields).
+    fields = token_fields(token)
+    return fields["s"][0][9]
 
 
 def main():

@@ -11,6 +11,7 @@ import sys
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from . import baseline as baseline_mod
+from . import contracts as contracts_mod
 from . import divergence, guarded_by, lock_order, user_rules
 from .report import (Finding, RULES, apply_suppressions,
                      file_skipped, iter_suppressions)
@@ -20,8 +21,14 @@ _SKIP_DIRS = {"__pycache__", ".git", "build", "dist", "node_modules",
 
 #: All engines, in run order.  "guards" is the HVD110–115 guarded-by
 #: race detector (guarded_by.py); "divergence" is the HVD200–HVD205
-#: SPMD rank-divergence dataflow engine (divergence.py).
-ENGINES = ("user", "locks", "guards", "divergence")
+#: SPMD rank-divergence dataflow engine (divergence.py); "contracts"
+#: is the HVD300–HVD307 cross-artifact contract checker
+#: (contracts.py) — the only engine that reasons repo-wide instead of
+#: per-module, so it runs once per analyze_files() call, not per file.
+ENGINES = ("user", "locks", "guards", "divergence", "contracts")
+
+#: The per-module engines (everything except the repo-wide pass).
+_MODULE_ENGINES = ("user", "locks", "guards", "divergence")
 
 #: Parsed-AST cache keyed by absolute path: every pass (user rules,
 #: lock-order, guarded-by, divergence) and every re-run in one process
@@ -94,7 +101,10 @@ def analyze_source(source: str, path: str = "<string>",
                    engines: Iterable[str] = ENGINES,
                    tree: Optional[ast.Module] = None,
                    ) -> List[Finding]:
-    """Run the selected engines over one module's source."""
+    """Run the selected PER-MODULE engines over one module's source.
+
+    The repo-wide "contracts" engine cannot see a single module in
+    isolation and is ignored here; it runs from analyze_files()."""
     if not include_skipped and file_skipped(source):
         return []
     if tree is None:
@@ -159,11 +169,21 @@ def _parse_cached(path: str, source: str) -> Optional[ast.Module]:
     return tree
 
 
+def _read_or_empty(path: str) -> str:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
 def analyze_files(files: Sequence[str], include_skipped: bool = False,
                   engines: Iterable[str] = ENGINES,
                   select: Optional[Sequence[str]] = None,
                   ) -> List[Finding]:
     findings: List[Finding] = []
+    module_engines = [e for e in engines if e in _MODULE_ENGINES]
+    inputs: List[Tuple[str, str, Optional[ast.Module]]] = []
     for path in files:
         try:
             with open(path, "r", encoding="utf-8") as f:
@@ -172,9 +192,16 @@ def analyze_files(files: Sequence[str], include_skipped: bool = False,
             findings.append(Finding("HVD000", path, 1, 0,
                                     f"could not read: {exc}"))
             continue
+        tree = _parse_cached(path, source)
+        inputs.append((path, source, tree))
         findings.extend(analyze_source(
-            source, path, include_skipped=include_skipped, engines=engines,
-            tree=_parse_cached(path, source)))
+            source, path, include_skipped=include_skipped,
+            engines=module_engines, tree=tree))
+    if "contracts" in engines:
+        # repo-wide pass: one extraction over the canonical scan set
+        # (plus the explicit inputs), riding the shared AST cache
+        findings.extend(contracts_mod.check_files(
+            inputs, include_skipped=include_skipped, parse=_parse_cached))
     if select:
         wanted = {c.strip().upper() for c in select}
         findings = [f for f in findings if f.code in wanted]
@@ -269,12 +296,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "ranges allowed (HVD110-HVD115)")
     parser.add_argument("--engine",
                         choices=("user", "locks", "guards", "divergence",
-                                 "all"),
+                                 "contracts", "all"),
                         default="all",
                         help="user-script rules, the lock-order "
                              "self-check, the guarded-by race detector, "
-                             "the SPMD divergence dataflow engine, or "
-                             "all four (default)")
+                             "the SPMD divergence dataflow engine, the "
+                             "cross-artifact contract checker, or all "
+                             "five (default)")
     parser.add_argument("--include-skipped", action="store_true",
                         help="analyze files marked '# hvdlint: skip-file' "
                              "(for linting the lint fixtures themselves)")
@@ -295,6 +323,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="print the docs/analysis.md entry for a rule "
                              "and exit")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--contracts-json", action="store_true",
+                        help="print the extracted registries (env knobs, "
+                             "metric families, RPC methods, chaos sites) "
+                             "as stable JSON and exit — the machine-"
+                             "readable inventory downstream controllers "
+                             "consume")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -304,6 +338,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         text = explain_rule(args.explain)
         print(text)
         return 0 if not text.startswith("unknown rule code") else 2
+    if args.contracts_json:
+        # registries only — no per-module findings pass needed; paths
+        # (or the cwd) locate the repo root the scan anchors at
+        repo = contracts_mod.build_repo(
+            [], parse=_parse_cached) if not args.paths else \
+            contracts_mod.build_repo(
+                [(p, _read_or_empty(p), None)
+                 for p in collect_files(args.paths)],
+                include_skipped=args.include_skipped, parse=_parse_cached)
+        print(json.dumps(contracts_mod.registries(repo), indent=2,
+                         sort_keys=True))
+        return 0
     if args.update_baseline and not args.baseline:
         parser.error("--update-baseline requires --baseline FILE")
     if args.update_baseline and (args.changed or args.select

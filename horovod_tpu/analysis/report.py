@@ -12,8 +12,9 @@ from typing import Dict, Iterable, List, Set, Tuple
 #: fingerprints are keyed on it, so a stale cache entry or an outdated
 #: baseline can never silently mask (or resurrect) findings across an
 #: analyzer upgrade.  v3 = schedule extractor + divergence dataflow
-#: engine (HVD200–HVD215) + nested-def held-set inheritance.
-ANALYZER_VERSION = 3
+#: engine (HVD200–HVD215) + nested-def held-set inheritance.  v4 =
+#: cross-artifact contract engine (HVD300–HVD307, contracts.py).
+ANALYZER_VERSION = 4
 
 # code -> (title, default fix-it).  The fix-it is the actionable half of
 # every message: what to change so the job cannot deadlock/diverge.
@@ -142,6 +143,47 @@ RULES: Dict[str, Tuple[str, str]] = {
         "--update and commit the snapshot diff for review; otherwise the "
         "fusion plan changed by accident and multi-host jobs may "
         "diverge"),
+    "HVD300": (
+        "env var read with no validated config row or docs entry",
+        "add the knob to config.py's from_env() (validated) or at least "
+        "a docs/env.md row — an operator cannot discover or trust a knob "
+        "that exists only as a raw os.environ read"),
+    "HVD301": (
+        "config.py row and docs/env.md table drifted apart",
+        "add the missing docs/env.md row (or delete the dead one) — the "
+        "env table is the operator contract, and a knob that parses but "
+        "isn't documented (or vice versa) WILL be set wrong"),
+    "HVD302": (
+        "metric family and docs/metrics.md table drifted apart",
+        "add the family to the docs/metrics.md table (or drop the stale "
+        "row) — dashboards and the job-level merge are built from that "
+        "table"),
+    "HVD303": (
+        "histogram family declared with two different bucket edges",
+        "use one (lo, hi) for every declaration of the family — the "
+        "driver's job-level merge sums buckets edge-wise and raises on "
+        "mismatched edges, so this is a guaranteed runtime ValueError"),
+    "HVD304": (
+        "RPC method with no handler, or handler no client calls",
+        "register the method in a JsonRpcServer({...})/add_handlers "
+        "table (or delete the dead handler) — an unregistered method is "
+        "a guaranteed 'unknown method' error on first use"),
+    "HVD305": (
+        "chaos site drift between code, docs and fault seeds",
+        "fire the site, fix the seed's site/action string, or update "
+        "docs/env.md's site list — an inert seed turns its chaos "
+        "regression test into a silent no-op"),
+    "HVD306": (
+        "negotiation-token / EntrySig field schema drift",
+        "keep entry_token's sig row, every token_fields consumer, "
+        "EntrySig and native parse_sig in lockstep (append-only fields) "
+        "— a consumer indexing past the producer's arity is an "
+        "IndexError at negotiation time"),
+    "HVD307": (
+        "metric call-site labels outside the family's declared labels",
+        "pass only the labels the family declared (or extend the "
+        "declaration) — the registry silently drops unknown labels, so "
+        "the series you meant to split never materializes"),
 }
 
 

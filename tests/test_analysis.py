@@ -481,13 +481,16 @@ def test_antipatterns_fixture_trips_every_user_rule():
     # skip-file honored by default (CI stage 8 stays green) ...
     assert analyze_paths([path]) == []
     # ... and every documented antipattern fires under --include-skipped,
-    # including the RacyMetricsSink guarded-by fixture and the
-    # HVD200–HVD205 divergence dataflow fixtures
+    # including the RacyMetricsSink guarded-by fixture, the HVD200–HVD205
+    # divergence dataflow fixtures, and the HVD300–HVD307 cross-layer
+    # contract-drift fixtures (engine 5)
     found = [f.code for f in analyze_paths([path], include_skipped=True)]
     assert sorted(set(found)) == [
         "HVD001", "HVD002", "HVD003", "HVD004", "HVD005", "HVD006",
         "HVD110", "HVD111", "HVD113", "HVD114",
-        "HVD200", "HVD201", "HVD202", "HVD203", "HVD204", "HVD205"]
+        "HVD200", "HVD201", "HVD202", "HVD203", "HVD204", "HVD205",
+        "HVD300", "HVD301", "HVD302", "HVD303", "HVD304", "HVD305",
+        "HVD306", "HVD307"]
 
 
 def test_cli_json_output_and_exit_codes():

@@ -761,9 +761,13 @@ def test_expand_select_ranges():
     codes, unknown = expand_select("HVD200-HVD215")
     assert codes == ["HVD200", "HVD201", "HVD202", "HVD203", "HVD204",
                      "HVD205", "HVD210", "HVD211"] and not unknown
+    # the contract family (engine 5) is selectable as a band too
+    codes, unknown = expand_select("HVD300-HVD307")
+    assert codes == ["HVD300", "HVD301", "HVD302", "HVD303", "HVD304",
+                     "HVD305", "HVD306", "HVD307"] and not unknown
     # ... but a range selecting NOTHING is a typo, not a filter
-    _, unknown = expand_select("HVD300-HVD999")
-    assert unknown == ["HVD300-HVD999"]
+    _, unknown = expand_select("HVD400-HVD999")
+    assert unknown == ["HVD400-HVD999"]
     _, unknown = expand_select("HVD115-HVD110")
     assert unknown == ["HVD115-HVD110"]
 

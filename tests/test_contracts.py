@@ -1,0 +1,560 @@
+"""Engine 5 (cross-layer contracts, HVD300–HVD307) unit + e2e tests.
+
+Mirrors tests/test_analysis.py's pattern: hermetic per-rule fixtures in
+throwaway mini-repos (each rule convicts AND its near-miss stays
+clean), parser edge cases for the markdown-table and chaos-seed
+grammars, and the framework-vs-fixture pin — the real tree runs clean
+while examples/antipatterns.py trips every HVD300–HVD307 rule under
+``--include-skipped``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from horovod_tpu.analysis import analyze_paths
+from horovod_tpu.analysis import contracts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# markdown-table parser
+# ---------------------------------------------------------------------------
+
+def test_md_tables_basic_and_separator_dropped():
+    text = """# Doc
+
+| Variable | Default |
+|---|---|
+| `HOROVOD_A` | 1 |
+| `HOROVOD_B` | 2 |
+"""
+    tables = contracts.parse_md_tables(text)
+    assert len(tables) == 1
+    cells = [row for _, row in tables[0]]
+    assert cells == [["Variable", "Default"],
+                     ["`HOROVOD_A`", "1"],
+                     ["`HOROVOD_B`", "2"]]
+    # line numbers point at the source rows (separator skipped)
+    assert [ln for ln, _ in tables[0]] == [3, 5, 6]
+
+
+def test_md_tables_multiple_tables_with_prose_between():
+    text = """| a | b |
+|---|---|
+| 1 | 2 |
+
+Some prose that ends the first table.
+
+| c |
+|---|
+| 3 |
+"""
+    tables = contracts.parse_md_tables(text)
+    assert len(tables) == 2
+    assert tables[0][-1][1] == ["1", "2"]
+    assert tables[1][-1][1] == ["3"]
+
+
+def test_md_tables_wrapped_cell_folds_into_previous_row():
+    text = """| Variable | Meaning |
+|---|---|
+| `HOROVOD_X` | a long meaning that was
+  hand-wrapped onto a second line |
+| `HOROVOD_Y` | short |
+"""
+    tables = contracts.parse_md_tables(text)
+    rows = tables[0]
+    assert len(rows) == 3                     # header + 2 data rows
+    assert "hand-wrapped onto a second line" in rows[1][1][-1]
+    assert rows[2][1][0] == "`HOROVOD_Y`"
+
+
+def test_md_tables_escaped_pipe_stays_in_cell():
+    text = "| kind | hit\\|miss\\|stale |\n|---|---|\n| x | y |\n"
+    tables = contracts.parse_md_tables(text)
+    assert tables[0][0][1] == ["kind", "hit|miss|stale"]
+
+
+def test_md_tables_heading_ends_a_table():
+    text = """| a |
+|---|
+| 1 |
+## next section
+| b |
+|---|
+| 2 |
+"""
+    tables = contracts.parse_md_tables(text)
+    assert [t[0][1] for t in tables] == [["a"], ["b"]]
+
+
+def test_first_backticked_cell_name():
+    assert contracts._first_backticked("`HOROVOD_X` (alias `HVD_X`)") \
+        == "HOROVOD_X"
+    assert contracts._first_backticked("no ticks here") is None
+
+
+# ---------------------------------------------------------------------------
+# chaos-seed grammar re-parse
+# ---------------------------------------------------------------------------
+
+def test_seed_rules_sites_and_action_kinds():
+    text = ("collective.dcn every=3 action=delay:0.05\n"
+            "# a comment\n"
+            "elastic.assignment nth=1 action=drop; "
+            "kv.set:key_value_set every=2 action=error:boom")
+    assert contracts.parse_seed_rules(text) == [
+        ("collective.dcn", "delay"),
+        ("elastic.assignment", "drop"),
+        ("kv.set", "error"),
+    ]
+
+
+def test_seed_rules_skip_undotted_grammar_test_sites():
+    # the schedule grammar unit tests use sites like "a" that exist
+    # nowhere — they must not join the contract surface
+    assert contracts.parse_seed_rules("a every=1 action=delay:0") == []
+    assert contracts.parse_seed_rules("no_action_here every=1") == []
+
+
+def test_seed_rules_last_action_token_wins():
+    # "action=" may appear inside an arg; the rule's action is the last
+    assert contracts.parse_seed_rules(
+        # grammar-only fixture — the site deliberately exists nowhere
+        # hvdlint: disable=HVD305
+        "site.x nth=1 action=error:retry_action=delay action=reset") == [
+        ("site.x", "reset")]
+
+
+# ---------------------------------------------------------------------------
+# hermetic mini-repo helper
+# ---------------------------------------------------------------------------
+
+#: Minimal doc anchors: their PRESENCE gates the doc-drift directions,
+#: and an empty docs surface means "nothing documented" — each test
+#: adds exactly the rows/prose it needs.
+ENV_MD = "# env\n"
+METRICS_MD = "# metrics\n"
+#: For the chaos tests: one documented site the module also fires.
+CHAOS_ENV_MD = ENV_MD + "\n## Chaos\n\nSites: `collective.dcn`.\n"
+
+
+def _mini_repo(tmp_path, module_src, env_md=ENV_MD, metrics_md=METRICS_MD,
+               config_src=None, extra=None):
+    """Build a throwaway repo root and run the contracts engine over it."""
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "env.md").write_text(env_md)
+    if metrics_md is not None:
+        (docs / "metrics.md").write_text(metrics_md)
+    if config_src is not None:
+        (tmp_path / "config.py").write_text(config_src)
+    for name, src in (extra or {}).items():
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    mod = tmp_path / "mod.py"
+    mod.write_text(module_src)
+    return contracts.check_files([(str(mod), module_src, None)])
+
+
+def _codes(findings):
+    return sorted({f.code for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# HVD300 / HVD301 — env knob contract
+# ---------------------------------------------------------------------------
+
+def test_hvd300_undocumented_env_read(tmp_path):
+    fs = _mini_repo(tmp_path, """import os
+v = os.environ.get("HOROVOD_PHANTOM")
+""")
+    assert _codes(fs) == ["HVD300"]
+    assert "HOROVOD_PHANTOM" in fs[0].message
+
+
+def test_hvd300_clean_when_documented_or_validated(tmp_path):
+    fs = _mini_repo(tmp_path, """import os
+a = os.environ.get("HOROVOD_DOCUMENTED")
+b = os.environ.get("HOROVOD_VALIDATED")
+""", config_src="""def _env_int(n, d):
+    import os
+    return int(os.environ.get(n, d))
+
+def from_env():
+    return _env_int("HOROVOD_VALIDATED", 1)
+""", env_md=ENV_MD + "\nSet `HOROVOD_DOCUMENTED=1`; `HOROVOD_VALIDATED` "
+            "is parsed by config.py.\n")
+    assert fs == [], [f.format_text() for f in fs]
+
+
+def test_hvd300_non_horovod_names_ignored(tmp_path):
+    fs = _mini_repo(tmp_path, """import os
+v = os.environ.get("PATH")
+w = os.environ.get("JAX_PLATFORMS")
+""")
+    assert fs == []
+
+
+def test_hvd301_validated_but_undocumented_row(tmp_path):
+    fs = _mini_repo(tmp_path, "x = 1\n", config_src="""def _env_str(n, d):
+    import os
+    return os.environ.get(n, d)
+
+def from_env():
+    return _env_str("HOROVOD_SECRET_KNOB", "")
+""")
+    assert _codes(fs) == ["HVD301"]
+    assert "HOROVOD_SECRET_KNOB" in fs[0].message
+    assert fs[0].path.endswith("config.py")
+
+
+def test_hvd301_dead_doc_row(tmp_path):
+    fs = _mini_repo(tmp_path, """import os
+v = os.environ.get("HOROVOD_DOCUMENTED")
+""", env_md="""# env
+| Variable | Default |
+|---|---|
+| `HOROVOD_DOCUMENTED` | 1 |
+| `HOROVOD_GHOST` | 0 |
+""")
+    assert _codes(fs) == ["HVD301"]
+    assert "HOROVOD_GHOST" in fs[0].message
+    assert fs[0].path.endswith("env.md")
+
+
+def test_hvd301_prose_mention_keeps_doc_contract(tmp_path):
+    # a knob documented in prose as `HOROVOD_X=0` (value tail) counts
+    fs = _mini_repo(tmp_path, """import os
+v = os.environ.get("HOROVOD_PROSE_KNOB")
+""", env_md=ENV_MD + "\nSet `HOROVOD_PROSE_KNOB=0` to disable.\n")
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# HVD302 / HVD303 / HVD307 — metric family contract
+# ---------------------------------------------------------------------------
+
+HIST_DOC = METRICS_MD + """
+| Family | Type |
+|---|---|
+| `hvd_documented_total` | histogram |
+"""
+
+
+def test_hvd302_created_but_undocumented(tmp_path):
+    fs = _mini_repo(tmp_path, """from horovod_tpu import metrics
+c = metrics.registry().counter("hvd_phantom_total", "nope")
+""")
+    assert _codes(fs) == ["HVD302"]
+    assert "hvd_phantom_total" in fs[0].message
+
+
+def test_hvd302_documented_but_never_created(tmp_path):
+    fs = _mini_repo(tmp_path, "x = 1\n", metrics_md=METRICS_MD + """
+| Family | Type |
+|---|---|
+| `hvd_ghost_total` | counter |
+""")
+    assert _codes(fs) == ["HVD302"]
+    assert fs[0].path.endswith("metrics.md")
+
+
+def test_hvd302_clean_when_documented(tmp_path):
+    fs = _mini_repo(tmp_path, """from horovod_tpu import metrics
+c = metrics.registry().counter("hvd_documented_total", "yes")
+""", metrics_md=HIST_DOC)
+    assert fs == []
+
+
+def test_hvd303_same_family_different_edges(tmp_path):
+    fs = _mini_repo(tmp_path, """from horovod_tpu import metrics
+reg = metrics.registry()
+a = reg.histogram("hvd_documented_total", "a")
+b = reg.histogram("hvd_documented_total", "b", lo=-13)
+""", metrics_md=HIST_DOC)
+    assert _codes(fs) == ["HVD303"]
+    msg = fs[0].message
+    assert "lo=-13" in msg and "lo=-17" in msg
+
+
+def test_hvd303_different_families_different_edges_clean(tmp_path):
+    # the PR-15 case: serve-latency uses lo=-13, the default is -17 —
+    # DIFFERENT families with different edges must stay clean
+    fs = _mini_repo(tmp_path, """from horovod_tpu import metrics
+reg = metrics.registry()
+a = reg.histogram("hvd_documented_total", "default edges")
+b = reg.histogram("hvd_serve_like_seconds", "tighter", lo=-13)
+""", metrics_md=HIST_DOC + "| `hvd_serve_like_seconds` | histogram |\n")
+    assert fs == [], [f.format_text() for f in fs]
+
+
+def test_hvd307_label_outside_declaration(tmp_path):
+    fs = _mini_repo(tmp_path, """from horovod_tpu import metrics
+c = metrics.registry().counter("hvd_documented_total", "h",
+                               labels=("kind",))
+def bump():
+    c.inc(kind="x", flavor="y")
+""", metrics_md=HIST_DOC)
+    assert _codes(fs) == ["HVD307"]
+    assert "'flavor'" in fs[0].message
+
+
+def test_hvd307_value_kwargs_and_declared_labels_clean(tmp_path):
+    fs = _mini_repo(tmp_path, """from horovod_tpu import metrics
+c = metrics.registry().counter("hvd_documented_total", "h",
+                               labels=("kind",))
+def bump():
+    c.inc(amount=3, kind="x")
+""", metrics_md=HIST_DOC)
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# HVD304 — RPC method <-> handler-table contract
+# ---------------------------------------------------------------------------
+
+def test_hvd304_client_without_handler(tmp_path):
+    fs = _mini_repo(tmp_path, """from horovod_tpu.runner.rpc import json_request
+json_request("h", 1, "phantom_method", {})
+""")
+    assert _codes(fs) == ["HVD304"]
+    assert "phantom_method" in fs[0].message
+
+
+def test_hvd304_handler_without_client(tmp_path):
+    fs = _mini_repo(tmp_path, """from horovod_tpu.runner.rpc import JsonRpcServer
+srv = JsonRpcServer({"dead_handler": lambda b: {}})
+""")
+    assert _codes(fs) == ["HVD304"]
+    assert "dead_handler" in fs[0].message
+
+
+def test_hvd304_cross_file_resolution_clean(tmp_path):
+    # client in one module, handler table in another — repo-wide merge
+    fs = _mini_repo(tmp_path, """from horovod_tpu.runner.rpc import json_request
+json_request("h", 1, "paired_method", {})
+""", extra={"server.py": """from horovod_tpu.runner.rpc import JsonRpcServer
+srv = JsonRpcServer({"paired_method": lambda b: {}})
+"""})
+    assert fs == []
+
+
+def test_hvd304_handler_factory_return_table_clean(tmp_path):
+    # a `*handlers` factory whose nested per-method defs return payload
+    # dicts: only the factory's OWN return is a handler table
+    fs = _mini_repo(tmp_path, """from horovod_tpu.runner.rpc import json_request
+
+def kv_handlers():
+    def get(body):
+        return {"ok": True, "v": 1}
+    return {"factory_method": get}
+
+json_request("h", 1, "factory_method", {})
+""")
+    assert fs == [], [f.format_text() for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# HVD305 — chaos site contract
+# ---------------------------------------------------------------------------
+
+def test_hvd305_inert_seed(tmp_path):
+    fs = _mini_repo(tmp_path, """from horovod_tpu import chaos
+SEED = "phantom.site nth=1 action=drop"
+act = chaos.fire("collective.dcn")
+""", env_md=CHAOS_ENV_MD)
+    assert _codes(fs) == ["HVD305"]
+    assert "phantom.site" in fs[0].message and "inert" in fs[0].message
+
+
+def test_hvd305_unknown_action(tmp_path):
+    fs = _mini_repo(tmp_path, """from horovod_tpu import chaos
+SEED = "collective.dcn every=1 action=explode"
+act = chaos.fire("collective.dcn")
+""", env_md=CHAOS_ENV_MD)
+    assert _codes(fs) == ["HVD305"]
+    assert "explode" in fs[0].message
+
+
+def test_hvd305_fired_but_undocumented_site(tmp_path):
+    fs = _mini_repo(tmp_path, """from horovod_tpu import chaos
+act = chaos.fire("collective.dcn")
+more = chaos.fire("sneaky.site")
+""", env_md=CHAOS_ENV_MD)
+    assert _codes(fs) == ["HVD305"]
+    assert "sneaky.site" in fs[0].message
+
+
+def test_hvd305_documented_but_never_fired_site(tmp_path):
+    fs = _mini_repo(tmp_path, """from horovod_tpu import chaos
+act = chaos.fire("collective.dcn")
+""", env_md=CHAOS_ENV_MD + "Also the `ghost.site` injection point.\n")
+    assert _codes(fs) == ["HVD305"]
+    assert "ghost.site" in fs[0].message
+    assert fs[0].path.endswith("env.md")
+
+
+def test_hvd305_test_fired_site_keeps_seed_live(tmp_path):
+    # a seed aimed at a site only a TEST fires is live (not inert), but
+    # test-only sites do not join the documented-site contract
+    fs = _mini_repo(tmp_path, """from horovod_tpu import chaos
+SEED = "unit.site every=1 action=delay:0"
+act = chaos.fire("collective.dcn")
+""", env_md=CHAOS_ENV_MD,
+        extra={"tests/test_x.py": """from horovod_tpu import chaos
+act = chaos.fire("unit.site")
+"""})
+    assert fs == [], [f.format_text() for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# HVD306 — negotiation-token / EntrySig schema contract
+# ---------------------------------------------------------------------------
+
+TOKEN_SRC = """def entry_token(entries):
+    rows = [[e.a, e.b, e.c, e.d] for e in entries]
+    return str(rows)
+
+def token_fields(token):
+    return {}
+
+def consume(token):
+    fields = token_fields(token)
+    return fields["s"][0][%d]
+"""
+
+
+def test_hvd306_consumer_past_producer_arity(tmp_path):
+    fs = _mini_repo(tmp_path, TOKEN_SRC % 9)
+    assert _codes(fs) == ["HVD306"]
+    assert "[9]" in fs[0].message and "4 fields" in fs[0].message
+
+
+def test_hvd306_consumer_within_arity_clean(tmp_path):
+    fs = _mini_repo(tmp_path, TOKEN_SRC % 3)
+    assert fs == []
+
+
+def test_hvd306_entry_sig_vs_native_parse_sig(tmp_path):
+    cpp = """static bool parse_sig(PyObject* o, Sig* out) {
+  out->name = get_str_attr(o, "name");
+  out->dtype = get_str_attr(o, "dtype");
+  return true;
+}
+"""
+    fs = _mini_repo(tmp_path, """class EntrySig:
+    name: str
+    dtype: str
+    extra_field: int
+""", extra={"native/core.cpp": cpp})
+    assert _codes(fs) == ["HVD306"]
+    assert "extra_field" in fs[0].message
+
+
+def test_hvd306_native_attr_missing_from_entry_sig(tmp_path):
+    cpp = """static bool parse_sig(PyObject* o, Sig* out) {
+  out->name = get_str_attr(o, "name");
+  out->ghost = get_ll_attr(o, "ghost");
+  return true;
+}
+"""
+    fs = _mini_repo(tmp_path, """class EntrySig:
+    name: str
+""", extra={"native/core.cpp": cpp})
+    assert _codes(fs) == ["HVD306"]
+    assert "ghost" in fs[0].message
+    assert fs[0].path.endswith("core.cpp")
+
+
+# ---------------------------------------------------------------------------
+# registry JSON emission
+# ---------------------------------------------------------------------------
+
+def test_registries_schema(tmp_path):
+    src = """import os
+from horovod_tpu import metrics
+v = os.environ.get("HOROVOD_DOCUMENTED")
+h = metrics.registry().histogram("hvd_documented_total", "d",
+                                 labels=("k",), lo=-13, hi=4)
+"""
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "env.md").write_text(
+        ENV_MD + "\nSet `HOROVOD_DOCUMENTED=1`.\n")
+    (tmp_path / "docs" / "metrics.md").write_text(HIST_DOC)
+    mod = tmp_path / "mod.py"
+    mod.write_text(src)
+    repo = contracts.build_repo([(str(mod), src, None)])
+    reg = contracts.registries(repo)
+    assert sorted(reg) == ["analyzer_version", "chaos", "env", "metrics",
+                           "root", "rpc"]
+    env = {e["name"]: e for e in reg["env"]}
+    assert env["HOROVOD_DOCUMENTED"]["documented"] is True
+    assert env["HOROVOD_DOCUMENTED"]["read_sites"] == 1
+    met = {m["name"]: m for m in reg["metrics"]}
+    assert met["hvd_documented_total"] == {
+        "name": "hvd_documented_total", "type": "histogram",
+        "labels": ["k"], "documented": True, "lo": -13, "hi": 4}
+    # stable: same inputs, same JSON
+    assert json.dumps(reg, sort_keys=True) == json.dumps(
+        contracts.registries(
+            contracts.build_repo([(str(mod), src, None)])),
+        sort_keys=True)
+
+
+def test_contracts_json_cli_emission():
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis", "--contracts-json",
+         "horovod_tpu"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    reg = json.loads(proc.stdout)
+    assert reg["analyzer_version"] >= 4
+    env_names = {e["name"] for e in reg["env"]}
+    assert "HOROVOD_CYCLE_TIME" in env_names
+    fams = {m["name"] for m in reg["metrics"]}
+    assert any(f.startswith("hvd_") for f in fams)
+    assert "collective.dcn" in reg["chaos"]["sites"]
+    # the antipatterns fixture is skip-file'd: its fakes must NOT leak
+    assert "HOROVOD_ANTIPATTERN_PHANTOM_KNOB" not in env_names
+    assert "hvd_antipattern_phantom_total" not in fams
+
+
+# ---------------------------------------------------------------------------
+# framework vs fixture: the real tree is clean, antipatterns convicts
+# ---------------------------------------------------------------------------
+
+def test_contracts_clean_on_framework_and_examples():
+    fs = analyze_paths([os.path.join(REPO, "horovod_tpu"),
+                        os.path.join(REPO, "examples")],
+                       engines=("contracts",))
+    assert fs == [], [f.format_text() for f in fs]
+
+
+def test_antipatterns_fixture_trips_every_contract_rule():
+    path = os.path.join(REPO, "examples", "antipatterns.py")
+    # skip-file honored by default: the fixture's fake registries never
+    # join the real tree's (CI stage 8 stays green) ...
+    assert analyze_paths([path], engines=("contracts",)) == []
+    # ... and under --include-skipped every HVD300s rule fires, every
+    # finding anchored IN the fixture (a fake producer/handler/site must
+    # never convict real framework modules)
+    fs = analyze_paths([path], include_skipped=True,
+                       engines=("contracts",))
+    assert sorted({f.code for f in fs}) == [
+        "HVD300", "HVD301", "HVD302", "HVD303", "HVD304", "HVD305",
+        "HVD306", "HVD307"]
+    for f in fs:
+        assert f.path.endswith("antipatterns.py"), f.format_text()
+
+
+def test_inline_suppression_applies_to_contract_findings(tmp_path):
+    fs = _mini_repo(tmp_path, """import os
+v = os.environ.get("HOROVOD_PHANTOM")  # hvdlint: disable=HVD300
+""")
+    assert fs == []

@@ -148,6 +148,8 @@ def test_unknown_corrupt_action_rejected_at_parse():
     typo'd one still raises at install."""
     chaos.FaultSchedule.parse("collective.corrupt nth=1 action=nan:2")
     with pytest.raises(ValueError, match="unknown action"):
+        # deliberately-unknown action: this IS the negative parse test
+        # hvdlint: disable=HVD305
         chaos.FaultSchedule.parse("collective.corrupt nth=1 action=nans")
 
 

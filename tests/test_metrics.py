@@ -338,6 +338,8 @@ def test_chaos_injections_counted_per_rule():
     import horovod_tpu.chaos as chaos
     from horovod_tpu.chaos import FaultSchedule
     live = "site.a every=1 action=delay:0.001"
+    # deliberately-inert seed: the test asserts it records ZERO injections
+    # hvdlint: disable=HVD305
     inert = "site.never nth=1 action=drop"
     counter = metrics.registry().counter(
         "hvd_chaos_injections_total",

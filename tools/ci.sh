@@ -384,14 +384,23 @@ if [ "${1:-}" != "--quick" ]; then
 fi
 
 echo "== 8/11 hvdlint static analysis =="
-# all four engines (user rules, lock-order, guarded-by race detector,
-# HVD200–HVD205 SPMD divergence dataflow); --baseline: fail only on NEW
-# findings vs the checked-in ratchet (EMPTY by policy, and refused
-# outright if its analyzer_version is stale — docs/analysis.md
-# "Baseline workflow").  One parse per file feeds every engine, keeping
-# the stage well under 30s.
+# all five engines (user rules, lock-order, guarded-by race detector,
+# HVD200–HVD205 SPMD divergence dataflow, HVD300–HVD307 cross-layer
+# contracts); --baseline: fail only on NEW findings vs the checked-in
+# ratchet (EMPTY by policy, and refused outright if its
+# analyzer_version is stale — docs/analysis.md "Baseline workflow").
+# One parse per file feeds every engine (the repo-wide contracts pass
+# rides the same AST cache); the wall-time assert pins the whole run
+# under 14 s = 2x the pre-contracts measurement (~7 s on the CI
+# runner), so engine 5 can never quietly double the lint stage.
+t_lint0=$(date +%s%N)
 python -m horovod_tpu.analysis \
   --baseline tools/hvdlint_baseline.json horovod_tpu/ examples/
+t_lint_ms=$(( ($(date +%s%N) - t_lint0) / 1000000 ))
+echo "hvdlint wall: ${t_lint_ms} ms"
+if [ "${t_lint_ms}" -gt 14000 ]; then
+  echo "FAIL: hvdlint took ${t_lint_ms} ms (> 14000 ms budget)"; exit 1
+fi
 
 echo "== 9/11 chaos smoke: elastic join under fixed fault seeds =="
 python -m pytest tests/test_chaos.py -q \
@@ -485,7 +494,20 @@ echo "== 11/11 hvdsched: collective-schedule snapshots + consistency =="
 # checksum all_gather under its cadence cond, and the fsdp_distopt_step
 # entry whose model-sharded buckets reduce-scatter shard-sized operands
 # over the data axis alone (HVD210 sweeps the data axis: mesh shapes
-# 2x2 and 4x2)
+# 2x2 and 4x2).  The explicit entry-count assertion pins snapshot
+# coverage: a deleted tests/schedules/*.json would otherwise let
+# --check pass vacuously on the entries that remain.
+n_sched=$(ls tests/schedules/*.json | wc -l)
+if [ "${n_sched}" -ne 10 ]; then
+  echo "FAIL: expected 10 schedule snapshots, found ${n_sched}"; exit 1
+fi
+sched_out=$(bash tools/hvdsched --check)
+echo "${sched_out}"
+case "${sched_out}" in
+  *"10 entries clean"*) ;;
+  *) echo "FAIL: hvdsched --check did not trace all 10 pinned entries"
+     exit 1 ;;
+esac
 bash tools/hvdsched --check --consistency
 
 echo "CI matrix: all stages green"
