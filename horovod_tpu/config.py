@@ -212,6 +212,22 @@ class Config:
     # this factor x the median of its peers stops receiving pulls
     # (>= 2 active workers; never the last one).  0 disables.
     serve_straggler_factor: float = 3.0
+    # --- checkpointless recovery (docs/elastic.md "Checkpointless
+    # recovery"; env table in docs/env.md) ---
+    # peer-redundancy mode for the per-worker ZeRO tile snapshots:
+    # "off" (no redundancy), "neighbor" (full frame replicated to the
+    # ring neighbor), "parity" (XOR parity groups — ~1/G the held
+    # bytes; rebuild needs every surviving group member)
+    recovery: str = "off"
+    # snapshot cadence: push every N-th accumulation boundary.  The
+    # staleness/traffic tradeoff — at cadence E a rebuild loses at most
+    # E boundaries of progress while redundancy wire bytes shrink 1/E.
+    recovery_every: int = 1
+    # rebuild pull deadline (seconds): how long a rejoining worker
+    # polls peers for its lost frame before giving up
+    recovery_pull_deadline_s: float = 30.0
+    # XOR parity group size (parity mode only; >= 2)
+    recovery_parity_group: int = 4
 
     @staticmethod
     def from_env() -> "Config":
@@ -394,4 +410,29 @@ class Config:
                 f"HOROVOD_SERVE_STRAGGLER_FACTOR must be 0 (off) or > 1 "
                 f"(a bar at or below the peer median rotates every "
                 f"worker), got {c.serve_straggler_factor}")
+        c.recovery = ((_env_str("HOROVOD_RECOVERY", c.recovery)
+                       or "off").strip().lower())
+        from .elastic.recovery import RECOVERY_MODES
+        if c.recovery not in RECOVERY_MODES:
+            raise ValueError(
+                f"HOROVOD_RECOVERY must be one of "
+                f"{'/'.join(RECOVERY_MODES)}, got {c.recovery!r}")
+        c.recovery_every = _env_int(
+            "HOROVOD_RECOVERY_EVERY", c.recovery_every)
+        if c.recovery_every < 1:
+            raise ValueError(
+                f"HOROVOD_RECOVERY_EVERY must be >= 1, got "
+                f"{c.recovery_every}")
+        c.recovery_pull_deadline_s = _env_float(
+            "HOROVOD_RECOVERY_PULL_DEADLINE_S", c.recovery_pull_deadline_s)
+        if c.recovery_pull_deadline_s <= 0:
+            raise ValueError(
+                f"HOROVOD_RECOVERY_PULL_DEADLINE_S must be positive, "
+                f"got {c.recovery_pull_deadline_s}")
+        c.recovery_parity_group = _env_int(
+            "HOROVOD_RECOVERY_PARITY_GROUP", c.recovery_parity_group)
+        if c.recovery_parity_group < 2:
+            raise ValueError(
+                f"HOROVOD_RECOVERY_PARITY_GROUP must be >= 2, got "
+                f"{c.recovery_parity_group}")
         return c

@@ -151,6 +151,12 @@ class ElasticDriver:
         # requeue its in-flight leases so mid-traffic churn loses zero
         # requests (docs/serving.md)
         self._serving = None
+        # checkpointless-recovery directory: who holds redundancy for
+        # whom, fed by workers' recovery_note RPCs and pruned on
+        # worker_gone / re-form like the serving rotation state
+        # (docs/elastic.md "Checkpointless recovery")
+        from .recovery import RecoveryDirectory
+        self._recovery = RecoveryDirectory()
         self._next_worker_id = 0
         self._hosts: Dict[str, int] = {}
         self._shutdown = False
@@ -214,6 +220,8 @@ class ElasticDriver:
             "register_notification": self._handle_register_notification,
             "request_reform": self._handle_request_reform,
             "straggler": self._handle_straggler,
+            "recovery_plan": self._handle_recovery_plan,
+            "recovery_note": self._handle_recovery_note,
         }, port=self.port, get_routes={
             # job-level view: every registered worker scraped and merged
             # (histograms bucket-wise, gauges per-worker min/max/sum) so
@@ -230,7 +238,14 @@ class ElasticDriver:
             # attribution (docs/observability.md "Training health";
             # tools/hvddoctor prints the table)
             "health/job": self._health_job_route,
+            # who holds redundancy for whom, and every fleet rebuild
+            # (docs/observability.md "Checkpointless recovery stats")
+            "recovery/stats": self._recovery_stats_route,
         })
+
+    def _recovery_stats_route(self):
+        return (200, "application/json",
+                json.dumps(self._recovery.stats(), separators=(",", ":")))
 
     def _metrics_job_route(self):
         with self._lock:
@@ -551,6 +566,41 @@ class ElasticDriver:
                 payload["addr"], int(payload["port"]))
         return {"ok": True}
 
+    def _handle_recovery_plan(self, payload):
+        """Current peer map for the checkpointless-recovery plane: a
+        worker asks where its ring neighbor / parity peers listen
+        (their notification servers double as the tile push/pull
+        endpoints).  Peers missing from the map simply have not
+        registered yet — the agent re-polls under its pull deadline."""
+        with self._lock:
+            peers = {}
+            wids = {}
+            for wid, asg in self._assignment.items():
+                ep = self._notif.get(wid)
+                if ep is None:
+                    continue
+                peers[str(asg["rank"])] = [ep[0], int(ep[1])]
+                wids[str(asg["rank"])] = int(wid)
+            return {"ok": True, "epoch": self._epoch,
+                    "size": len(self._assignment),
+                    "peers": peers, "wids": wids}
+
+    def _handle_recovery_note(self, payload):
+        """A worker reports a delivered redundancy push (or a completed
+        rebuild): the directory is what lets a driver log say how a
+        worker was rebuilt, and what gets pruned on churn."""
+        res = self._recovery.note(payload)
+        if payload.get("kind") == "rebuilt":
+            self._emit("worker_rebuilt",
+                       worker_id=int(payload.get("src_worker", -1)),
+                       rank=int(payload.get("src_rank", -1)),
+                       epoch=int(payload.get("epoch", -1)),
+                       step=int(payload.get("step", -1)),
+                       source=payload.get("source", ""),
+                       seconds=round(float(payload.get("seconds", 0.0)),
+                                     6))
+        return res
+
     # --- assignment / spawn ------------------------------------------------
 
     def _discover(self) -> Dict[str, int]:
@@ -673,6 +723,13 @@ class ElasticDriver:
             self._gate_open = not assigned_wids
             self._gate_deadline = time.monotonic() + self.start_timeout
             self._epoch_formed = False
+            # the straggler debounce is per (host, epoch): entries from
+            # epochs before this re-form can never match again — prune
+            # them (mirroring the serving rotation-state prune) so
+            # periodic churn cannot accrete the set forever
+            self._straggler_counted = {
+                (h, e) for (h, e) in self._straggler_counted
+                if e >= self._epoch}
         # epochs two re-forms back are unreachable: every worker either
         # passed the intervening epoch's release gate (re-namespacing its
         # negotiation keys to the new ``e{N}``) or died.  A crashed
@@ -687,6 +744,10 @@ class ElasticDriver:
             # keep theirs (their processes keep serving through the
             # re-form)
             self._serving.retain_workers(assigned_wids)
+        # recovery directory: drop tile entries whose source OR holder
+        # left the epoch — a departed worker's ghost versions must not
+        # shadow a live peer's fresher push after the re-form
+        self._recovery.retain_workers(assigned_wids)
         if self.verbose:
             print(f"elastic: epoch {epoch} — {np_} slots on "
                   f"{list(hosts)}", file=sys.stderr)
@@ -924,6 +985,10 @@ class ElasticDriver:
                 # the worker's in-flight serving leases back into the
                 # admission queue — zero lost requests under churn
                 self._serving.worker_gone(w.worker_id)
+            # prune the dead worker's recovery-directory entries (as
+            # source and as holder): the replacement's rebuild must see
+            # only redundancy that actually survives on live peers
+            self._recovery.worker_gone(w.worker_id)
             if w.expected_exit:
                 self._emit("worker_exit", worker_id=w.worker_id, rc=rc,
                            kind="expected")

@@ -222,15 +222,22 @@ class WorkerNotificationManager:
     def __init__(self):
         from .. import health as _health
         from .. import tracing as _tracing
+        from . import recovery as _recovery
         self._listeners = []
         # trace_pull: the driver's GET /trace/job scrapes this worker's
         # span buffer (and its clock-offset probes) over the same
         # keep-alive RPC pool every other control-plane call rides.
         # health_pull: the same shape for the training-health verdicts
-        # (GET /health/job merges them into one job verdict)
-        self._server = JsonRpcServer({"hosts_updated": self._on_update,
-                                      "trace_pull": _tracing.pull_handler,
-                                      "health_pull": _health.pull_handler})
+        # (GET /health/job merges them into one job verdict).
+        # recovery_push / recovery_pull: the checkpointless-recovery
+        # plane — peers land redundancy frames here and a rejoining
+        # worker pulls its lost tiles back (docs/elastic.md).
+        self._server = JsonRpcServer(
+            {"hosts_updated": self._on_update,
+             "trace_pull": _tracing.pull_handler,
+             "health_pull": _health.pull_handler,
+             "recovery_push": _recovery.push_handler,
+             "recovery_pull": _recovery.pull_handler})
         self._registered = False
 
     def init(self):

@@ -7,6 +7,7 @@ from .distributed import (  # noqa: F401
     fused_reduce_scatter_tree, fused_tail_reduce_tree,
     all_gather_sharded_tree, shard_tree_like,
     state_partition_specs, broadcast_parameters, broadcast_optimizer_state,
+    recovery_payload, restore_dist_state,
 )
 from .precision import (  # noqa: F401
     adamw_lp, scale_by_adam_lp, tree_nbytes,

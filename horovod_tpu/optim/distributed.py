@@ -29,10 +29,12 @@ drop N×; params stay replicated (ZeRO stage "weight update sharding").
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional, Tuple
+import functools
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from .. import chaos as _chaos
@@ -864,6 +866,77 @@ class _DistState(NamedTuple):
     residual: Any = None
 
 
+def _deliver_recovery_snapshot(names, step, rank, *leaves):
+    """Host side of the recovery snapshot tap (``jax.debug.callback``
+    target): route the boundary payload to the installed
+    :class:`~horovod_tpu.elastic.recovery.RecoveryAgent` (each filters
+    by rank)."""
+    from ..elastic import recovery as _recovery
+    payload = {n: np.asarray(a) for n, a in zip(names, leaves)}
+    _recovery.deliver_boundary(int(step), int(rank), payload)
+
+
+def recovery_payload(state: _DistState) -> Dict[str, np.ndarray]:
+    """The ``{name: array}`` snapshot the recovery tap emits for
+    ``state``: the inner optimizer leaves (this worker's ZeRO tiles
+    under ``sharded_update``), the error-feedback residual, and the
+    step counter.  The accumulator is excluded — it is zero at every
+    boundary by construction.  Host-side twin of the in-jit tap, for
+    tests and direct callers."""
+    out = {"count": np.asarray(state.count)}
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(state.inner)):
+        out[f"inner/{i}"] = np.asarray(leaf)
+    residual = getattr(state, "residual", None)
+    if residual is not None:
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(residual)):
+            out[f"residual/{i}"] = np.asarray(leaf)
+    return out
+
+
+def restore_dist_state(state: _DistState, payload) -> _DistState:
+    """Rebuild a ``_DistState`` from a recovered snapshot payload.
+
+    ``state`` is a freshly initialized state of the SAME transform on
+    the SAME params (the rejoining worker re-runs ``init_fn``); its
+    leaves define the expected shapes/dtypes, and the restore is
+    bit-exact — a shape or dtype mismatch (e.g. a re-form that resized
+    the fleet and changed the tile layout) raises instead of casting.
+    """
+    def _rebuild(tree, prefix):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        out = []
+        for i, leaf in enumerate(leaves):
+            arr = payload.get(f"{prefix}/{i}")
+            if arr is None:
+                raise ValueError(
+                    f"recovered payload is missing {prefix}/{i} — "
+                    f"snapshot taken by a different transform "
+                    f"configuration?")
+            arr = np.asarray(arr)
+            shape = tuple(getattr(leaf, "shape", ()))
+            dtype = np.dtype(getattr(leaf, "dtype", arr.dtype))
+            if tuple(arr.shape) != shape or arr.dtype != dtype:
+                raise ValueError(
+                    f"recovered {prefix}/{i} is {arr.dtype}{arr.shape}, "
+                    f"expected {dtype}{shape} — the tile layout changed "
+                    f"(e.g. the fleet was resized); checkpointless "
+                    f"recovery covers replacement-at-same-size re-forms "
+                    f"only")
+            out.append(jnp.asarray(arr))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    new_inner = _rebuild(state.inner, "inner")
+    residual = getattr(state, "residual", None)
+    new_res = (_rebuild(residual, "residual")
+               if residual is not None else None)
+    if "count" not in payload:
+        raise ValueError("recovered payload is missing the step counter")
+    count = jnp.asarray(np.asarray(payload["count"]),
+                        dtype=jnp.asarray(state.count).dtype)
+    return _DistState(inner=new_inner, acc=state.acc, count=count,
+                      residual=new_res)
+
+
 def DistributedGradientTransform(
         inner: Optional[optax.GradientTransformation] = None,
         op: str = ReduceOp.AVERAGE,
@@ -882,7 +955,8 @@ def DistributedGradientTransform(
         health: Optional[bool] = None,
         health_check_every: Optional[int] = None,
         param_specs=None,
-        model_axes: Optional[Tuple[str, ...]] = None
+        model_axes: Optional[Tuple[str, ...]] = None,
+        recovery=None
         ) -> optax.GradientTransformation:
     """optax transformation that cross-worker-reduces gradients.
 
@@ -980,6 +1054,21 @@ def DistributedGradientTransform(
     opt-state checksum is skipped — the state is 1/N per worker by
     design.  Not supported with ``overlap`` (the in-backward dispatched
     buckets never materialize a boundary buffer to tap).
+
+    ``recovery`` (a
+    :class:`~horovod_tpu.elastic.recovery.RecoveryAgent`; explicit
+    opt-in only — deliberately no env default here, so compiled
+    schedules are untouched unless a caller arms the plane) attaches
+    the **checkpointless-recovery snapshot tap**: at every accumulation
+    boundary whose ordinal lands on the agent's cadence, one
+    ``jax.debug.callback`` delivers this worker's per-worker state (the
+    ZeRO shard tiles or replicated inner state, the error-feedback
+    residual, the step counter) to the agent, which frames and pushes
+    it to its redundancy peer (docs/elastic.md "Checkpointless
+    recovery").  Off-cadence boundaries pay one traced predicate.  The
+    in-flight accumulator is NOT snapshotted — it is zero at every
+    boundary by construction.  Not supported with ``overlap`` (the
+    boundary state never materializes in one place to tap).
     """
     if inner is None:
         inner = optax.identity()
@@ -1111,6 +1200,41 @@ def DistributedGradientTransform(
         if hl_every < 1:
             raise ValueError(
                 f"health_check_every must be >= 1, got {hl_every}")
+
+    if recovery is not None and _ov_plan is not None:
+        raise ValueError(
+            "recovery is not supported with overlap=True: overlapped "
+            "steps dispatch buckets inside the backward scan and never "
+            "materialize the boundary state in one place to snapshot — "
+            "disable one of the two")
+    rc_every = max(int(getattr(recovery, "every", 1)), 1) \
+        if recovery is not None else 1
+
+    def _emit_recovery(boundary_ord, count, new_inner, new_res):
+        """Cadence-gated boundary snapshot tap (HealthTaps pattern):
+        the host transfer happens only inside the cadence branch;
+        off-cadence boundaries pay one predicate."""
+        names = ["count"]
+        leaves = [count]
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(new_inner)):
+            names.append(f"inner/{i}")
+            leaves.append(leaf)
+        if new_res is not None:
+            for i, leaf in enumerate(jax.tree_util.tree_leaves(new_res)):
+                names.append(f"residual/{i}")
+                leaves.append(leaf)
+        rank = (jax.lax.axis_index(axis_name) if axis_name is not None
+                else jnp.int32(0))
+
+        def fire(_):
+            jax.debug.callback(
+                functools.partial(_deliver_recovery_snapshot,
+                                  tuple(names)),
+                boundary_ord, rank, *leaves)
+            return jnp.int32(0)
+
+        jax.lax.cond(boundary_ord % rc_every == 0, fire,
+                     lambda _: jnp.int32(0), jnp.int32(0))
 
     def reduce_grads(grads, health=None):
         if axis_name is not None:
@@ -1394,15 +1518,19 @@ def DistributedGradientTransform(
                                        state.residual)
         residual = getattr(state, "residual", None)
         if k == 1:
-            if hl_enabled:
-                # the sentinel cadence needs a step counter: with taps
-                # armed, count advances every update (k == 1 has no
-                # boundary arithmetic to disturb)
-                from ..health.taps import HealthTaps
+            if hl_enabled or recovery is not None:
+                # the sentinel/recovery cadence needs a step counter:
+                # with either tap armed, count advances every update
+                # (k == 1 has no boundary arithmetic to disturb)
                 count = state.count + 1
-                taps = HealthTaps(axis_name, count, hl_every)
+                taps = None
+                if hl_enabled:
+                    from ..health.taps import HealthTaps
+                    taps = HealthTaps(axis_name, count, hl_every)
                 updates, new_inner, new_res = _step(
                     grads, state.inner, params, residual, taps=taps)
+                if recovery is not None:
+                    _emit_recovery(count, count, new_inner, new_res)
                 return updates, _DistState(new_inner, state.acc, count,
                                            new_res)
             updates, new_inner, new_res = _step(grads, state.inner,
@@ -1443,6 +1571,10 @@ def DistributedGradientTransform(
             updates, new_inner, new_res = _step(mean_acc, inner_state,
                                                 params, residual,
                                                 taps=taps)
+            if recovery is not None:
+                # like the sentinel, the snapshot cadence divides the
+                # BOUNDARY ordinal, not the raw micro-step counter
+                _emit_recovery(count // k, count, new_inner, new_res)
             return (updates, _as_varying(_fresh_zeros(acc)), new_inner,
                     new_res)
 

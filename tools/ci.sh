@@ -328,6 +328,30 @@ splane.close()
 sworker.stop(); sworker.join(10)
 ssrv.close()
 
+# checkpointless recovery (ISSUE 17): one push/rebuild pair over real
+# loopback RPC in the installed process; the rebuilt frame must be
+# bit-identical and the hvd_recovery_* families must carry samples on
+# the same /metrics scrape every other plane rides
+from horovod_tpu.elastic import recovery as hvrec
+rec_a = hvrec.RecoveryAgent(rank=0, size=2, mode="neighbor", every=1,
+                            pull_deadline_s=5.0, register=False)
+rec_b = hvrec.RecoveryAgent(rank=1, size=2, mode="neighbor", every=1,
+                            pull_deadline_s=5.0, register=False)
+rsrvA = JsonRpcServer(rec_a.worker_handlers(), secret=None)
+rsrvB = JsonRpcServer(rec_b.worker_handlers(), secret=None)
+rpeers = {0: ("127.0.0.1", rsrvA.port), 1: ("127.0.0.1", rsrvB.port)}
+rec_a.update_plan(0, rpeers)
+rec_b.update_plan(0, rpeers)
+rstate = np.arange(512, dtype=np.float32)
+assert rec_b.note_boundary(0, {"tiles": rstate})
+# worker 1 'dies'; a fresh agent (empty store) rebuilds from worker 0
+rec_b2 = hvrec.RecoveryAgent(rank=1, size=2, mode="neighbor", every=1,
+                             pull_deadline_s=5.0, register=False)
+rec_b2.update_plan(0, {0: ("127.0.0.1", rsrvA.port)}, size=2)
+rgot = rec_b2.rebuild(min_epoch=0)
+assert rgot["tiles"].tobytes() == rstate.tobytes(), "rebuild not bit-exact"
+rsrvA.close(); rsrvB.close()
+
 fams = aggregate.parse_prometheus(aggregate.scrape("127.0.0.1", srv.port))
 def _family_count(fam, **want):
     return sum(v for _, lbl, v in fams[fam]["samples"]
@@ -344,6 +368,15 @@ tail_rounds = _family_count("hvd_tail_rounds_total", policy="bounded")
 assert tail_rounds >= 1, fams["hvd_tail_rounds_total"]["samples"]
 straggler = _family_count("hvd_straggler_score", process="1")
 assert straggler > 0, fams["hvd_straggler_score"]["samples"]
+rec_rebuilds = _family_count("hvd_recovery_rebuilds_total",
+                             source="neighbor")
+assert rec_rebuilds >= 1, fams["hvd_recovery_rebuilds_total"]["samples"]
+rec_time = sum(v for nm, _, v
+               in fams["hvd_recovery_time_seconds"]["samples"]
+               if nm.endswith("_count"))
+assert rec_time >= 1, fams["hvd_recovery_time_seconds"]["samples"]
+assert _family_count("hvd_recovery_snapshots_total",
+                     mode="neighbor") >= 1
 # eager numerics taps fed the health gauge family on this process
 assert "hvd_health_grad_norm" in fams, sorted(fams)
 srv.close()
@@ -354,7 +387,7 @@ print(f"dist smoke OK (incl. /metrics + /healthz + /trace/job + "
       f"{int(reuse_hits)} keep-alive hits, {int(overlap_buckets)} "
       f"overlap buckets, {len(host_pids)} trace host pids, job health "
       f"{hjob['verdict']}, {int(sreq)} served requests @ p99<="
-      f"{sp99:g}s), imported from",
+      f"{sp99:g}s, {int(rec_rebuilds)} fleet rebuild(s)), imported from",
       os.path.dirname(hvd.__file__))
 PYEOF
   )
@@ -482,6 +515,16 @@ tail -1 /tmp/ci_hvddoctor.log
 python tools/bench_serve.py --smoke > /tmp/ci_bench_serve.log 2>&1 \
   || { tail -30 /tmp/ci_bench_serve.log; exit 1; }
 tail -1 /tmp/ci_bench_serve.log
+# checkpointless recovery: a lost worker's ZeRO frame rebuilt from its
+# surviving replica must be bit-identical AND faster than the pinned
+# blob-store re-read model, steady-state redundancy bytes must stay
+# under the gradient-wire fraction gate, and the pinned recovery.push
+# chaos seed must prove itself live (injections + requeue counters on a
+# driver-shaped GET /metrics/job).  (docs/elastic.md "Checkpointless
+# recovery")
+python tools/bench_recovery.py --smoke > /tmp/ci_bench_recovery.log 2>&1 \
+  || { tail -30 /tmp/ci_bench_recovery.log; exit 1; }
+tail -1 /tmp/ci_bench_recovery.log
 
 echo "== 11/11 hvdsched: collective-schedule snapshots + consistency =="
 # re-trace every builtin step entry to a jaxpr on CPU, diff against the
