@@ -183,6 +183,21 @@ class Config:
     health_grad_factor: float = 10.0
     health_loss_factor: float = 4.0
     health_residual_factor: float = 4.0
+    # time-series sampler (docs/metrics.md "Time series"): a bounded
+    # on-worker ring of per-window metric DELTAS behind GET /timeseries
+    # and the driver's merged /timeseries/job.  0 = one false branch at
+    # every ride-along site, no sampler thread.
+    timeseries: bool = True
+    # window length (seconds): one ring entry per period
+    timeseries_every_s: float = 10.0
+    # ring capacity (windows): at the defaults, 15 minutes of history
+    timeseries_window: int = 90
+    # SLO watchdog rules over the windows, comma-separated
+    # "signal<=value[@Nw]" / "signal>=value[@Nw]" (e.g.
+    # "serve_p99_s<=0.5@3w,cycle_rate>=10@5w"); "" disables.  Breaches
+    # are edge-triggered and ride the health plane (slo_breach
+    # verdicts).
+    slo: str = ""
     # --- serving plane (docs/serving.md; env table in docs/env.md) ---
     # attach a ServingPlane to the elastic driver (run_elastic_launcher)
     serve: bool = False
@@ -367,6 +382,26 @@ class Config:
                 raise ValueError(
                     f"{_name} must be > 1 (a bar at or below the "
                     f"baseline fires on every step), got {_v}")
+        c.timeseries = _env_bool("HOROVOD_TIMESERIES", c.timeseries)
+        c.timeseries_every_s = _env_float(
+            "HOROVOD_TIMESERIES_EVERY_S", c.timeseries_every_s)
+        if c.timeseries_every_s <= 0:
+            raise ValueError(
+                f"HOROVOD_TIMESERIES_EVERY_S must be positive, got "
+                f"{c.timeseries_every_s}")
+        c.timeseries_window = _env_int(
+            "HOROVOD_TIMESERIES_WINDOW", c.timeseries_window)
+        if c.timeseries_window < 2:
+            raise ValueError(
+                f"HOROVOD_TIMESERIES_WINDOW must be >= 2 (one window "
+                f"of history is no trend), got {c.timeseries_window}")
+        c.slo = (_env_str("HOROVOD_SLO", c.slo) or "").strip()
+        if c.slo:
+            from .metrics.slo import parse_rules
+            try:
+                parse_rules(c.slo)
+            except ValueError as e:
+                raise ValueError(f"HOROVOD_SLO invalid: {e}") from None
         c.serve = _env_bool("HOROVOD_SERVE", c.serve)
         c.serve_tick_ms = _env_float(
             "HOROVOD_SERVE_TICK_MS", c.serve_tick_ms)

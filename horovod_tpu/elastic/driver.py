@@ -41,6 +41,7 @@ from typing import Dict, List, Optional
 
 from .. import chaos as _chaos
 from .. import metrics as _metrics
+from ..metrics import jobscrape as _jobscrape
 from ..runner import spawn
 from ..runner import secret as _secret
 from ..runner.hosts import HostInfo, assign_slots
@@ -213,6 +214,13 @@ class ElasticDriver:
             self.extra_env,
             expected_procs=(self.max_np if self.max_np is not None
                             else self.min_np))
+        # every job-level GET view delegates to the unified scraper
+        # (metrics/jobscrape.py): the per-plane merge/degrade semantics
+        # stay in their planes; the driver only supplies the live
+        # endpoint snapshot and the recovery-stats view
+        self._scraper = _jobscrape.JobScraper(
+            self._scrape_endpoints,
+            recovery_stats=lambda: self._recovery.stats())
         self._server = JsonRpcServer({
             "assignment": self._handle_assignment,
             "result": self._handle_result,
@@ -222,53 +230,13 @@ class ElasticDriver:
             "straggler": self._handle_straggler,
             "recovery_plan": self._handle_recovery_plan,
             "recovery_note": self._handle_recovery_note,
-        }, port=self.port, get_routes={
-            # job-level view: every registered worker scraped and merged
-            # (histograms bucket-wise, gauges per-worker min/max/sum) so
-            # one scrape answers "which worker is the straggler"
-            "metrics/job": self._metrics_job_route,
-            # job-wide distributed trace: every worker's span buffer
-            # pulled over the keep-alive pool, clocks aligned via RPC
-            # midpoint offsets, one Chrome-trace JSON with one pid per
-            # host (docs/observability.md "Distributed trace";
-            # tools/hvdtrace analyzes the critical path over it)
-            "trace/job": self._trace_job_route,
-            # job health verdict: every worker's health_pull snapshot
-            # merged into ONE verdict with (worker, bucket, step)
-            # attribution (docs/observability.md "Training health";
-            # tools/hvddoctor prints the table)
-            "health/job": self._health_job_route,
-            # who holds redundancy for whom, and every fleet rebuild
-            # (docs/observability.md "Checkpointless recovery stats")
-            "recovery/stats": self._recovery_stats_route,
-        })
+        }, port=self.port, get_routes=self._scraper.routes())
 
-    def _recovery_stats_route(self):
-        return (200, "application/json",
-                json.dumps(self._recovery.stats(), separators=(",", ":")))
-
-    def _metrics_job_route(self):
+    def _scrape_endpoints(self):
+        # re-snapshotted under the lock on EVERY scrape: a re-form
+        # mid-scrape must see the new fleet, not a stale copy
         with self._lock:
-            endpoints = {str(wid): ep for wid, ep in self._notif.items()}
-        body = _metrics.aggregate.scrape_and_merge(endpoints)
-        return (200, "text/plain; version=0.0.4; charset=utf-8", body)
-
-    def _trace_job_route(self):
-        from .. import tracing as _tracing
-        with self._lock:
-            endpoints = {str(wid): ep for wid, ep in self._notif.items()}
-        trace = _tracing.merge.scrape_job_trace(
-            endpoints, probes=_tracing.probes())
-        return (200, "application/json",
-                json.dumps(trace, separators=(",", ":")))
-
-    def _health_job_route(self):
-        from .. import health as _health
-        with self._lock:
-            endpoints = {str(wid): ep for wid, ep in self._notif.items()}
-        job = _health.scrape_job_health(endpoints)
-        return (200, "application/json",
-                json.dumps(job, separators=(",", ":")))
+            return {str(wid): ep for wid, ep in self._notif.items()}
 
     # --- serving plane -----------------------------------------------------
 
@@ -283,12 +251,9 @@ class ElasticDriver:
         requests instead of dropping them."""
         self._serving = plane
         self._server.add_handlers(plane.rpc_handlers())
-        self._server.add_get_routes({"serve/stats": self._serve_stats_route})
+        self._server.add_get_routes(
+            self._scraper.serving_routes(lambda: self._serving.stats()))
         self._emit("serving_attached")
-
-    def _serve_stats_route(self):
-        return (200, "application/json",
-                json.dumps(self._serving.stats(), separators=(",", ":")))
 
     # --- lifecycle events --------------------------------------------------
 
@@ -444,6 +409,13 @@ class ElasticDriver:
                 logger.warning(
                     "worker %d FAILURE flight recorder (last %d "
                     "events):\n%s", wid, len(flight), tail)
+            windows = payload.get("timeseries") or []
+            if windows:
+                from ..metrics import timeseries as _timeseries
+                logger.warning(
+                    "worker %d FAILURE time-series (last %d "
+                    "window(s)):\n%s", wid, len(windows),
+                    _timeseries.render_windows(windows))
         self.registry.record_result(wid, payload["status"],
                                     payload.get("hostname"))
         if _metrics.ACTIVE:

@@ -203,6 +203,16 @@ def record_result(status: str):
         # the elastic/RPC/chaos events that led there
         payload["flight"] = _metrics.flight_events(
             limit=_metrics.FAILURE_REPORT_EVENTS)
+    if status != "SUCCESS":
+        from ..metrics import timeseries as _timeseries
+        if _timeseries.ACTIVE:
+            # ...and the trend lines: the last few time-series windows
+            # show what the worker's RATES looked like before it died
+            # (report_windows is empty — and the key pruned below —
+            # when the sampler never ran)
+            windows = _timeseries.report_windows()
+            if windows:
+                payload["timeseries"] = windows
     try:
         # idempotent=False: a FAILURE report that is retried (or chaos-
         # duplicated) after reaching the handler once must not count the

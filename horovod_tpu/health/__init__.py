@@ -370,42 +370,22 @@ def scrape_job_health(endpoints: Dict[str, Tuple[str, int]],
                       timeout: float = 2.0, secret=_ENV) -> dict:
     """Scrape every ``{worker: (addr, port)}`` ``health_pull`` endpoint
     in parallel and merge into one job verdict.  Unreachable workers
-    degrade to ``unreachable`` entries, never a failed scrape (same
-    contract, same shared-deadline fan-out as the metrics aggregator's
-    ``scrape_and_merge`` and the tracer's ``scrape_job_trace``)."""
+    degrade to ``unreachable`` entries, never a failed scrape (the
+    shared-deadline fan-out is the unified
+    ``metrics.jobscrape.fan_out`` engine; the healthy→degraded verdict
+    demotion stays in ``merge_job_health``)."""
+    from ..metrics import jobscrape
     from ..runner.rpc import json_request
-    results: Dict[str, object] = {}
     kw = {} if secret is _ENV else {"secret": secret}
 
-    def one(worker, addr, port):
-        try:
-            results[worker] = json_request(addr, port, "health_pull", {},
-                                           timeout=timeout, retries=0,
-                                           **kw)
-        except Exception as e:  # noqa: BLE001 - partial view is useful
-            results[worker] = e
+    def _fetch(worker, addr, port):
+        return json_request(addr, port, "health_pull", {},
+                            timeout=timeout, retries=0, **kw)
 
-    threads = [threading.Thread(target=one, args=(str(w), a, p),
-                                name=f"hvd-health-{w}", daemon=True)
-               for w, (a, p) in endpoints.items()]
-    for t in threads:
-        t.start()
-    # ONE shared deadline (see aggregate.scrape_and_merge: a per-thread
-    # join degrades to N x timeout with several wedged workers)
-    deadline = time.monotonic() + timeout + 1.0
-    for t in threads:
-        t.join(max(deadline - time.monotonic(), 0.0))
-    for w in endpoints:   # a wedged thread still reports as unreachable
-        results.setdefault(str(w), TimeoutError("health scrape timed out"))
-    workers: Dict[str, dict] = {}
-    unreachable: Dict[str, str] = {}
-    for w in sorted(results):
-        got = results[w]
-        if isinstance(got, Exception):
-            unreachable[w] = str(got)
-        else:
-            workers[w] = got
-    return merge_job_health(workers, unreachable=unreachable)
+    workers, failed = jobscrape.fan_out(
+        endpoints, _fetch, budget=timeout + 1.0,
+        wedged="health scrape timed out", name="health")
+    return merge_job_health(workers, unreachable=failed)
 
 
 def render_job_health(job: dict, top: int = 16) -> str:

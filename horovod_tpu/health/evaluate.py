@@ -418,6 +418,26 @@ class HealthEvaluator:
                 self._loss_obs += 1
         self._publish(fired)
 
+    def ingest_slo(self, rule: str, detail: str,
+                   step: Optional[int] = None, clear: bool = False):
+        """Feed one SLO watchdog edge (``metrics.slo`` rides the health
+        plane here so ONE plane owns "is the job OK"): a breach becomes
+        an edge-triggered ``slo_breach`` verdict — visible to
+        ``/health/job``, the flight recorder, and ``on_unhealthy`` like
+        any other condition — and ``clear=True`` re-arms the rule's
+        condition so a later, distinct episode fires a NEW verdict."""
+        fired: List[Verdict] = []
+        with self._lock:
+            step = self._last_step if step is None else int(step)
+            key = ("slo_breach", self.process, None, rule)
+            if clear:
+                self._active.pop(key, None)
+            else:
+                v = self._fire_locked(key, step, detail, rule=rule)
+                if v is not None:
+                    fired.append(v)
+        self._publish(fired)
+
     # -- verdict plumbing ----------------------------------------------------
 
     _UNSET = object()

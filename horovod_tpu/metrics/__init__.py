@@ -225,10 +225,12 @@ def get_routes() -> Dict[str, "callable"]:
     """Default GET routes every JsonRpcServer serves: ``/metrics``
     (Prometheus text format), ``/healthz`` (JSON liveness), ``/trace``
     (this process's span buffer as Chrome-trace JSON — the single-host
-    slice of the driver's merged ``/trace/job``), and ``/health``
+    slice of the driver's merged ``/trace/job``), ``/health``
     (this process's training-health snapshot — the single-worker slice
     of the driver's merged ``/health/job``; NOT ``/healthz``, which is
-    process liveness).  Each route returns
+    process liveness), and ``/timeseries`` (this process's windowed
+    metric-delta ring — the single-worker slice of the driver's merged
+    ``/timeseries/job``).  Each route returns
     ``(status, content_type, body)``."""
     def _metrics_route():
         return (200, "text/plain; version=0.0.4; charset=utf-8",
@@ -247,8 +249,16 @@ def get_routes() -> Dict[str, "callable"]:
         from .. import health  # lazy: health pulls no metrics state
         return (200, "application/json", health.routes_json())
 
+    def _timeseries_route():
+        from . import timeseries  # lazy: avoids an import cycle —
+        # timeseries imports this package at module level
+        return (200, "application/json",
+                json.dumps(timeseries.local_payload(),
+                           separators=(",", ":")))
+
     return {"metrics": _metrics_route, "healthz": _healthz_route,
-            "trace": _trace_route, "health": _health_route}
+            "trace": _trace_route, "health": _health_route,
+            "timeseries": _timeseries_route}
 
 
 def init_from_env(environ=os.environ):
@@ -258,11 +268,15 @@ def init_from_env(environ=os.environ):
     * refresh the ACTIVE / RECORDING flags from the environment,
     * install the SIGUSR1 dump handler (best effort),
     * start the periodic JSON dump thread (``HOROVOD_METRICS_DUMP``),
-    * start a standalone scrape server (``HOROVOD_METRICS_PORT``).
+    * start a standalone scrape server (``HOROVOD_METRICS_PORT``),
+    * start the time-series sampler + SLO watchdog
+      (``HOROVOD_TIMESERIES*`` / ``HOROVOD_SLO``).
     """
     global ACTIVE, RECORDING, _dump_thread, _dump_stop, _http_server
     ACTIVE = _env_on(ENV_ENABLE, environ=environ)
     RECORDING = _env_on(ENV_FLIGHT, environ=environ)
+    from . import timeseries  # lazy: timeseries imports this package
+    timeseries.init_from_env(environ)
     if RECORDING:
         # only claim SIGUSR1 when a dump would actually be written — a
         # disabled recorder must not clobber an app's own handler
@@ -305,9 +319,12 @@ def init_from_env(environ=os.environ):
 
 
 def stop_exposition():
-    """Stop the dump thread (flushing one last snapshot) and the
-    standalone scrape server.  Safe to call repeatedly."""
+    """Stop the dump thread (flushing one last snapshot), the
+    time-series sampler, and the standalone scrape server.  Safe to
+    call repeatedly."""
     global _dump_thread, _dump_stop, _http_server
+    from . import timeseries  # lazy: timeseries imports this package
+    timeseries.stop_sampler()
     if _dump_stop is not None:
         _dump_stop.set()
         if _dump_thread is not None:

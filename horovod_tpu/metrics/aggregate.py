@@ -202,39 +202,19 @@ def scrape_and_merge(endpoints: Dict[str, Tuple[str, int]],
     the route's latency is one timeout, not timeouts × dead workers —
     mid-churn (when half the endpoints are corpses) is exactly when
     this view matters, and a serial scrape would blow the caller's own
-    scrape deadline then."""
-    import threading
+    scrape deadline then.  The fan-out itself (daemon threads, ONE
+    shared deadline, wedged threads degrading to unreachable) is the
+    unified ``jobscrape.fan_out`` engine; only the degrade RENDERING —
+    corpse comment lines in the merged exposition — lives here."""
+    from . import jobscrape
 
-    results: Dict[str, object] = {}
+    def _fetch(worker, addr, port):
+        return parse_prometheus(scrape(addr, port, timeout=timeout))
 
-    def one(worker, addr, port):
-        try:
-            results[worker] = parse_prometheus(
-                scrape(addr, port, timeout=timeout))
-        except Exception as e:  # noqa: BLE001 - partial scrape is useful
-            results[worker] = e
-
-    threads = [threading.Thread(target=one, args=(str(w), a, p),
-                                name=f"hvd-scrape-{w}", daemon=True)
-               for w, (a, p) in endpoints.items()]
-    for t in threads:
-        t.start()
-    # ONE shared deadline: urlopen's timeout does not bound DNS, and a
-    # per-thread join would degrade back to N × timeout with several
-    # wedged workers — the serial bound this fan-out exists to avoid
-    import time as _time
-    deadline = _time.monotonic() + timeout + 1.0
-    for t in threads:
-        t.join(max(deadline - _time.monotonic(), 0.0))
-    for w in endpoints:   # a wedged thread still yields a comment
-        results.setdefault(str(w), TimeoutError("scrape timed out"))
-    per_worker: Dict[str, Dict[str, dict]] = {}
-    comments: List[str] = []
-    for worker in sorted(results):
-        got = results[worker]
-        if isinstance(got, Exception):
-            comments.append(f"worker {worker} unreachable: {got}")
-        else:
-            per_worker[worker] = got
+    per_worker, failed = jobscrape.fan_out(
+        endpoints, _fetch, budget=timeout + 1.0,
+        wedged="scrape timed out", name="scrape")
+    comments: List[str] = [f"worker {w} unreachable: {e}"
+                           for w, e in failed.items()]
     comments.insert(0, f"aggregated over {len(per_worker)} worker(s)")
     return render(merge(per_worker), comments=tuple(comments))

@@ -1,0 +1,185 @@
+"""Unified driver-side job scraper: ONE parallel fan-out engine and ONE
+route table behind every job-level GET view.
+
+PR 13 left a documented deferred cleanup: the driver grew three (then
+five) copy-pasted parallel-scrape fan-outs — ``/metrics/job``,
+``/trace/job``, ``/health/job``, plus the in-process ``/serve/stats``
+and ``/recovery/stats`` JSON routes.  Each fan-out re-implemented the
+same discipline: daemon threads per worker, ONE shared deadline (a
+per-thread join degrades to N x timeout with several wedged workers —
+the serial bound the fan-out exists to avoid), and a wedged thread
+still reported as unreachable instead of hanging the route.
+
+This module owns that discipline once:
+
+* :func:`fan_out` — the parallel-scrape engine, parameterized by the
+  fetch callable (GET text, ``json_request`` RPC, multi-probe pull),
+  the deadline budget (metrics/health: ``timeout + 1``; tracing:
+  ``timeout * (probes + 1) + 1`` for its clock probes), and the wedge
+  message.  The per-plane DEGRADE POLICIES stay in their planes —
+  corpse comment lines in the merged exposition
+  (``aggregate.scrape_and_merge``), ``otherData.unreachable`` in the
+  merged trace, the healthy→degraded verdict demotion
+  (``health.merge_job_health``) — pinned byte-identical by the
+  existing route tests.
+* :class:`JobScraper` — the route table the elastic driver registers:
+  all six job routes (``metrics/job``, ``trace/job``, ``health/job``,
+  ``timeseries/job``, ``recovery/stats``, and ``serve/stats`` once a
+  plane attaches) delegate here instead of living as driver methods.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, Optional, Tuple
+
+_JSON_CT = "application/json"
+_PROM_CT = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def fan_out(endpoints: Dict[str, Tuple[str, int]],
+            fetch: Callable[[str, str, int], object], *,
+            budget: float, wedged: str = "scrape timed out",
+            name: str = "scrape",
+            ) -> Tuple[Dict[str, object], Dict[str, Exception]]:
+    """Scrape every ``{worker: (addr, port)}`` endpoint in parallel.
+
+    ``fetch(worker, addr, port)`` runs on a daemon thread per worker;
+    whatever it raises marks that worker failed, never the whole
+    scrape — mid-churn (when half the endpoints are corpses) is
+    exactly when a job view matters.  ONE shared deadline of
+    ``budget`` seconds bounds the entire fan-out (transport timeouts
+    do not bound DNS, and a per-thread join would degrade back to
+    N x timeout with several wedged workers); a thread still running
+    at the deadline yields ``TimeoutError(wedged)`` for its worker.
+
+    Returns ``(ok, failed)``, both keyed by ``str(worker)`` in sorted
+    order — callers render ``failed`` into their plane's degrade form
+    (comment lines, ``unreachable`` maps, verdict demotion).
+    """
+    results: Dict[str, object] = {}
+
+    def one(worker, addr, port):
+        try:
+            results[worker] = fetch(worker, addr, port)
+        except Exception as e:  # noqa: BLE001 - partial view is useful
+            results[worker] = e
+
+    threads = [threading.Thread(target=one, args=(str(w), a, p),
+                                name=f"hvd-{name}-{w}", daemon=True)
+               for w, (a, p) in endpoints.items()]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + budget
+    for t in threads:
+        t.join(max(deadline - time.monotonic(), 0.0))
+    for w in endpoints:   # a wedged thread still reports as unreachable
+        results.setdefault(str(w), TimeoutError(wedged))
+    ok: Dict[str, object] = {}
+    failed: Dict[str, Exception] = {}
+    for w in sorted(results):
+        got = results[w]
+        if isinstance(got, Exception):
+            failed[w] = got
+        else:
+            ok[w] = got
+    return ok, failed
+
+
+def http_get(addr: str, port: int, route: str,
+             timeout: float = 2.0) -> str:
+    """GET a worker's unauthenticated exposition route (``/metrics``,
+    ``/timeseries``, ...) — exposition is read-only, so it rides plain
+    HTTP rather than the signed RPC path."""
+    with urllib.request.urlopen(
+            f"http://{addr}:{port}/{route}", timeout=timeout) as resp:
+        return resp.read().decode("utf-8", errors="replace")
+
+
+class JobScraper:
+    """The route table behind every job-level GET view on the driver.
+
+    ``endpoints`` is a zero-arg callable returning the CURRENT
+    ``{worker: (addr, port)}`` notification-endpoint snapshot (the
+    driver re-snapshots under its lock on every scrape — a re-form
+    mid-scrape must see the new fleet, not a stale copy);
+    ``recovery_stats`` / the plane passed to :meth:`serving_routes`
+    supply the two in-process JSON stats views.
+    """
+
+    def __init__(self, endpoints: Callable[[], Dict[str, Tuple[str, int]]],
+                 recovery_stats: Optional[Callable[[], dict]] = None):
+        self._endpoints = endpoints
+        self._recovery_stats = recovery_stats
+
+    def routes(self) -> Dict[str, Callable]:
+        """The driver's ``get_routes`` table.  Each route returns the
+        ``(status, content_type, body)`` tuple ``JsonRpcServer``
+        serves; the merge/degrade semantics live in the owning plane
+        (docs/observability.md)."""
+        routes = {
+            # job-level metrics: every registered worker scraped and
+            # merged (histograms bucket-wise, gauges per-worker
+            # min/max/sum) so one scrape answers "which worker is the
+            # straggler"; unreachable workers render as comment lines
+            "metrics/job": self._metrics_job,
+            # job-wide distributed trace: every worker's span buffer
+            # pulled over the keep-alive pool, clocks aligned via RPC
+            # midpoint offsets, one Chrome-trace JSON with one pid per
+            # host (docs/observability.md "Distributed trace";
+            # tools/hvdtrace analyzes the critical path over it)
+            "trace/job": self._trace_job,
+            # job health verdict: every worker's health_pull snapshot
+            # merged into ONE verdict with (worker, bucket, step)
+            # attribution (docs/observability.md "Training health";
+            # tools/hvddoctor prints the table)
+            "health/job": self._health_job,
+            # job time-series: every worker's windowed-delta ring
+            # merged into per-worker rates/percentiles plus job-level
+            # windowed histograms (docs/metrics.md "Time series";
+            # tools/hvdtop renders the table)
+            "timeseries/job": self._timeseries_job,
+        }
+        if self._recovery_stats is not None:
+            # who holds redundancy for whom, and every fleet rebuild
+            # (docs/observability.md "Checkpointless recovery stats")
+            routes["recovery/stats"] = self._recovery_stats_route
+        return routes
+
+    def serving_routes(self, stats: Callable[[], dict]) -> Dict[str, Callable]:
+        """The ``serve/stats`` route a ``ServingPlane`` adds on attach
+        (queue depth, leases, per-worker service EWMAs)."""
+        def _serve_stats():
+            return (200, _JSON_CT,
+                    json.dumps(stats(), separators=(",", ":")))
+        return {"serve/stats": _serve_stats}
+
+    # -- the six delegates ---------------------------------------------------
+
+    def _recovery_stats_route(self):
+        return (200, _JSON_CT,
+                json.dumps(self._recovery_stats(), separators=(",", ":")))
+
+    def _metrics_job(self):
+        from . import aggregate
+        body = aggregate.scrape_and_merge(self._endpoints())
+        return (200, _PROM_CT, body)
+
+    def _trace_job(self):
+        from .. import tracing as _tracing
+        trace = _tracing.merge.scrape_job_trace(
+            self._endpoints(), probes=_tracing.probes())
+        return (200, _JSON_CT, json.dumps(trace, separators=(",", ":")))
+
+    def _health_job(self):
+        from .. import health as _health
+        job = _health.scrape_job_health(self._endpoints())
+        return (200, _JSON_CT, json.dumps(job, separators=(",", ":")))
+
+    def _timeseries_job(self):
+        from . import timeseries as _timeseries
+        job = _timeseries.scrape_job_timeseries(self._endpoints())
+        return (200, _JSON_CT, json.dumps(job, separators=(",", ":")))

@@ -1096,6 +1096,12 @@ class CollectiveEngine:
             # "Training health"): the full snapshot is GET /health /
             # the health_pull RPC; stats() carries the compact verdict
             out["health"] = _health.evaluator().summary()
+        from ..metrics import timeseries as _timeseries
+        if _timeseries.ACTIVE:
+            # time-series sampler summary (docs/observability.md
+            # "Time series"): knobs, ring occupancy, last-window rates;
+            # the full windows are GET /timeseries
+            out["timeseries"] = _timeseries.summary()
         # serving-plane summary (docs/observability.md "Serving"):
         # present only when a ServingPlane or ServingWorker lives in
         # this process.  Lazy import — the serving package is optional

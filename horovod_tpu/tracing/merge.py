@@ -22,7 +22,6 @@ instrumentation args verbatim.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -153,36 +152,18 @@ def scrape_job_trace(endpoints: Dict[str, Tuple[str, int]],
     """Scrape every ``{worker: (addr, port)}`` span buffer in parallel
     and merge into one job trace.  Unreachable workers become entries
     in ``otherData.unreachable``, never a failed scrape — mid-churn is
-    exactly when this view matters (same contract, same shared-deadline
-    fan-out as the metrics aggregator's ``scrape_and_merge``)."""
-    results: Dict[str, object] = {}
+    exactly when this view matters (the shared-deadline fan-out is the
+    unified ``metrics.jobscrape.fan_out`` engine; probes+pull make a
+    few round trips, hence the larger per-worker budget)."""
+    from ..metrics import jobscrape
 
-    def one(worker, addr, port):
-        try:
-            results[worker] = pull_worker(addr, port, probes=probes,
-                                          timeout=timeout, secret=secret)
-        except Exception as e:  # noqa: BLE001 - partial trace is useful
-            results[worker] = e
+    def _fetch(worker, addr, port):
+        return pull_worker(addr, port, probes=probes, timeout=timeout,
+                           secret=secret)
 
-    threads = [threading.Thread(target=one, args=(str(w), a, p),
-                                name=f"hvd-trace-{w}", daemon=True)
-               for w, (a, p) in endpoints.items()]
-    for t in threads:
-        t.start()
-    # ONE shared deadline across workers (see aggregate.scrape_and_merge:
-    # a per-thread join degrades to N x timeout with several wedged
-    # workers); probes+pull make a few round trips, so budget them
-    deadline = time.monotonic() + timeout * (probes + 1) + 1.0
-    for t in threads:
-        t.join(max(deadline - time.monotonic(), 0.0))
-    for w in endpoints:   # a wedged thread still reports as unreachable
-        results.setdefault(str(w), TimeoutError("trace scrape timed out"))
-    workers: Dict[str, Tuple[Dict, float, float]] = {}
-    unreachable: Dict[str, str] = {}
-    for w in sorted(results):
-        got = results[w]
-        if isinstance(got, Exception):
-            unreachable[w] = str(got)
-        else:
-            workers[w] = got
-    return chrome_trace(workers, unreachable=unreachable)
+    workers, failed = jobscrape.fan_out(
+        endpoints, _fetch, budget=timeout * (probes + 1) + 1.0,
+        wedged="trace scrape timed out", name="trace")
+    return chrome_trace(workers,
+                        unreachable={w: str(e)
+                                     for w, e in failed.items()})

@@ -1,0 +1,382 @@
+"""hvdtimeseries: windowed rings, the unified job scraper, SLO rules.
+
+Covers the ISSUE 18 acceptance surface: the on-worker bounded ring of
+per-window metric deltas (eviction at capacity, counter-reset tolerance
+— a worker restart mid-window must never yield a negative rate,
+histogram window merge with the mismatched-edge error, windowed
+percentile pinned against the `aggregate.percentile` oracle), the
+unified `jobscrape.fan_out` engine behind every job-level GET route,
+the merged `GET /timeseries/job` view, the SLO watchdog's parse /
+edge-trigger / re-arm behavior, and its `slo_breach` verdicts riding
+the health plane.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+import horovod_tpu.metrics as metrics
+from horovod_tpu.metrics import aggregate, jobscrape, slo, timeseries
+from horovod_tpu.metrics.registry import MetricRegistry
+from horovod_tpu.runner.rpc import JsonRpcServer
+
+
+def _make_ring(window=4):
+    reg = MetricRegistry()
+    ring = timeseries.TimeSeriesRing(window=window, every_s=1.0,
+                                     registry=reg)
+    return reg, ring
+
+
+# --- windowed deltas ---------------------------------------------------------
+
+def test_window_carries_deltas_not_totals():
+    reg, ring = _make_ring()
+    c = reg.counter("t_total")
+    c.inc(5)
+    w1 = ring.sample()
+    assert w1["counters"]["t_total"][0]["delta"] == 5.0
+    c.inc(2)
+    w2 = ring.sample()
+    assert w2["counters"]["t_total"][0]["delta"] == 2.0
+    # idle families are PRUNED: absence from a window means zero delta
+    w3 = ring.sample()
+    assert "t_total" not in w3["counters"]
+
+
+def test_ring_evicts_at_capacity():
+    reg, ring = _make_ring(window=3)
+    g = reg.gauge("t_gauge")
+    for i in range(5):
+        g.set(i)
+        ring.sample()
+    assert len(ring) == 3
+    assert ring.closed() == 5
+    # the retained windows are the NEWEST three, in order
+    assert [w["n"] for w in ring.windows()] == [2, 3, 4]
+    assert [w["gauges"]["t_gauge"][0]["value"]
+            for w in ring.windows()] == [2, 3, 4]
+
+
+def test_counter_reset_yields_post_restart_delta_never_negative():
+    reg, ring = _make_ring()
+    c = reg.counter("t_total")
+    c.inc(100)
+    ring.sample()
+    # a restarted worker re-registers from zero: simulate by swapping
+    # the registry state underneath the ring
+    reg2 = MetricRegistry()
+    c2 = reg2.counter("t_total")
+    c2.inc(3)
+    ring._registry = reg2
+    w = ring.sample()
+    # the post-restart value IS the delta — never 3 - 100 = -97
+    assert w["counters"]["t_total"][0]["delta"] == 3.0
+    rate = timeseries.counter_rate([w], "t_total")
+    assert rate is not None and rate >= 0.0
+
+
+def test_histogram_reset_tolerated_bucketwise():
+    reg, ring = _make_ring()
+    h = reg.histogram("t_seconds", lo=-3, hi=3)
+    for v in (0.2, 0.2, 1.5):
+        h.observe(v)
+    ring.sample()
+    reg2 = MetricRegistry()
+    h2 = reg2.histogram("t_seconds", lo=-3, hi=3)
+    h2.observe(0.7)
+    ring._registry = reg2
+    w = ring.sample()
+    s = w["histograms"]["t_seconds"]["series"][0]
+    assert s["count"] == 1 and all(b >= 0 for b in s["buckets"])
+
+
+def test_gauges_point_sampled_and_gauge_last():
+    reg, ring = _make_ring()
+    g = reg.gauge("t_depth")
+    g.set(7)
+    ring.sample()
+    g.set(3)
+    ring.sample()
+    assert timeseries.gauge_last(ring.windows(), "t_depth") == 3.0
+
+
+def test_counter_rate_zero_when_idle_none_when_no_windows():
+    reg, ring = _make_ring()
+    assert timeseries.counter_rate([], "t_total") is None
+    ring.sample()   # window with zero activity
+    # an idle engine reads 0.0 — the signal a cycle_rate FLOOR catches
+    assert timeseries.counter_rate(ring.windows(), "t_total") == 0.0
+
+
+# --- windowed percentiles vs the aggregate.percentile oracle -----------------
+
+def test_windowed_percentile_matches_aggregate_oracle():
+    le = [0.25, 0.5, 1.0, 2.0]
+    buckets = [3.0, 0.0, 5.0, 1.0, 2.0]   # last = +Inf overflow
+    # oracle: expand each observation to its bucket's upper edge and
+    # take aggregate.percentile over the sorted multiset — the ONE
+    # nearest-rank definition codebase-wide
+    edges = le + [float("inf")]
+    expanded = sorted(e for e, n in zip(edges, buckets)
+                      for _ in range(int(n)))
+    for q in (0.0, 0.25, 0.5, 0.9, 0.99, 1.0):
+        assert (timeseries.percentile_from_buckets(le, buckets, q)
+                == aggregate.percentile(expanded, q)), q
+
+
+def test_windowed_percentile_empty_is_nan():
+    v = timeseries.percentile_from_buckets([1.0], [0.0, 0.0], 0.99)
+    assert v != v
+
+
+def test_hist_window_merges_across_windows_and_workers():
+    reg, ring = _make_ring()
+    h = reg.histogram("t_seconds", lo=-2, hi=2)
+    h.observe(0.3)
+    ring.sample()
+    h.observe(3.9)
+    ring.sample()
+    merged = timeseries.hist_window(ring.windows(), "t_seconds")
+    assert merged["count"] == 2
+    assert timeseries.percentile_from_buckets(
+        merged["le"], merged["buckets"], 1.0) == 4.0
+
+
+def test_merge_hist_windows_rejects_mismatched_edges():
+    a = {"le": [0.5, 1.0], "buckets": [1, 0, 0], "sum": 0.3, "count": 1}
+    b = {"le": [0.25, 1.0], "buckets": [1, 0, 0], "sum": 0.2, "count": 1}
+    with pytest.raises(ValueError, match="mismatched bucket edges"):
+        timeseries.merge_hist_windows([a, b])
+
+
+# --- the unified fan-out engine ----------------------------------------------
+
+def test_fan_out_splits_ok_failed_and_defaults_wedged():
+    def fetch(worker, addr, port):
+        if worker == "1":
+            raise ConnectionError("boom")
+        return f"{addr}:{port}"
+
+    ok, failed = jobscrape.fan_out(
+        {"0": ("a", 1), "1": ("b", 2)}, fetch, budget=2.0,
+        wedged="x timed out", name="t")
+    assert ok == {"0": "a:1"}
+    assert isinstance(failed["1"], ConnectionError)
+
+    import threading
+    release = threading.Event()
+
+    def wedge(worker, addr, port):
+        release.wait(10.0)   # far past the budget
+        return "late"
+
+    try:
+        ok, failed = jobscrape.fan_out(
+            {"0": ("a", 1)}, wedge, budget=0.2, wedged="x timed out")
+        assert not ok
+        assert isinstance(failed["0"], TimeoutError)
+        assert str(failed["0"]) == "x timed out"
+    finally:
+        release.set()
+
+
+def test_job_scraper_route_table():
+    scraper = jobscrape.JobScraper(lambda: {})
+    assert set(scraper.routes()) == {"metrics/job", "trace/job",
+                                     "health/job", "timeseries/job"}
+    scraper = jobscrape.JobScraper(lambda: {},
+                                   recovery_stats=lambda: {"x": 1})
+    routes = scraper.routes()
+    assert "recovery/stats" in routes
+    status, ct, body = routes["recovery/stats"]()
+    assert (status, json.loads(body)) == (200, {"x": 1})
+    status, ct, body = scraper.serving_routes(
+        lambda: {"depth": 0})["serve/stats"]()
+    assert json.loads(body) == {"depth": 0}
+
+
+def test_timeseries_job_scrape_merges_two_workers(monkeypatch):
+    # module-level ring OFF so the driver pseudo-worker stays out
+    monkeypatch.setattr(timeseries, "_RING", None)
+    reg_a, ring_a = _make_ring()
+    reg_b, ring_b = _make_ring()
+    for reg, ring, n in ((reg_a, ring_a, 4), (reg_b, ring_b, 2)):
+        c = reg.counter("hvd_engine_cycles_total")
+        h = reg.histogram("hvd_serve_request_latency_seconds",
+                          lo=-3, hi=3)
+        c.inc(n)
+        h.observe(0.4)
+        ring.sample()
+
+    def payload(ring):
+        def route():
+            return (200, "application/json", json.dumps(
+                {"enabled": True, "windows": ring.windows()}))
+        return route
+
+    srv_a = JsonRpcServer({}, secret=None,
+                          get_routes={"timeseries": payload(ring_a)})
+    srv_b = JsonRpcServer({}, secret=None,
+                          get_routes={"timeseries": payload(ring_b)})
+    try:
+        job = timeseries.scrape_job_timeseries(
+            {"0": ("127.0.0.1", srv_a.port),
+             "1": ("127.0.0.1", srv_b.port),
+             "9": ("127.0.0.1", 1)})   # nobody listening
+    finally:
+        srv_a.close()
+        srv_b.close()
+    assert job["scraped"] == 2
+    assert set(job["unreachable"]) == {"9"}
+    assert job["workers"]["0"]["cycle_rate"] > 0
+    # job-level windowed histogram: both workers' deltas, one p99
+    merged = job["merged"]["histograms"][
+        "hvd_serve_request_latency_seconds"]
+    assert merged["count"] == 2 and merged["p99"] == 0.5
+    # throughputs ADD across workers
+    assert job["merged"]["rates"]["cycle_rate"] == pytest.approx(
+        timeseries.counter_rate(ring_a.windows(),
+                                "hvd_engine_cycles_total")
+        + timeseries.counter_rate(ring_b.windows(),
+                                  "hvd_engine_cycles_total"))
+
+
+def test_default_get_routes_include_timeseries():
+    srv = JsonRpcServer({}, secret=None)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/timeseries",
+                timeout=5.0) as resp:
+            body = json.loads(resp.read().decode())
+    finally:
+        srv.close()
+    assert "enabled" in body and "windows" in body
+
+
+# --- SLO watchdog ------------------------------------------------------------
+
+def test_parse_rules_grammar_and_errors():
+    rules = slo.parse_rules(
+        "serve_p99_s<=0.5@3w, cycle_rate>=10@5w ,recovery_time_s<=30")
+    assert [(r.name, r.op, r.threshold, r.nw) for r in rules] == [
+        ("serve_p99_s", "<=", 0.5, 3), ("cycle_rate", ">=", 10.0, 5),
+        ("recovery_time_s", "<=", 30.0, 1)]
+    with pytest.raises(ValueError, match="unknown signal"):
+        slo.parse_rules("nope<=1")
+    with pytest.raises(ValueError, match="does not match"):
+        slo.parse_rules("serve_p99_s=0.5")
+    with pytest.raises(ValueError, match="does not match"):
+        slo.parse_rules("cycle_rate>=10@w")
+
+
+def test_watchdog_edge_triggered_and_rearms():
+    reg, ring = _make_ring()
+    c = reg.counter("hvd_engine_cycles_total")
+    wd = slo.Watchdog(slo.parse_rules("cycle_rate>=1"))
+    c.inc(1000)
+    ring.sample()
+    assert wd.observe(ring) == []          # fast enough: no breach
+    ring.sample()                          # idle window: rate 0.0
+    fired = wd.observe(ring)
+    assert [b["rule"] for b in fired] == ["cycle_rate>=1"]
+    ring.sample()                          # STILL idle: same episode,
+    assert wd.observe(ring) == []          # no second verdict
+    c.inc(1000)
+    ring.sample()                          # recovered: re-armed...
+    assert wd.observe(ring) == []
+    assert wd.snapshot()["active"] == []
+    ring.sample()                          # ...so a NEW episode fires
+    assert len(wd.observe(ring)) == 1
+
+
+def test_watchdog_skips_without_data_or_history():
+    reg, ring = _make_ring()
+    wd = slo.Watchdog(slo.parse_rules("serve_p99_s<=0.1@2w"))
+    ring.sample()
+    assert wd.observe(ring) == []   # only 1 of the 2 required windows
+    ring.sample()
+    # enough windows but the latency family never observed: skip —
+    # absence of traffic is not a latency breach
+    assert wd.observe(ring) == []
+
+
+def test_slo_breach_rides_health_plane():
+    from horovod_tpu import health
+    from horovod_tpu.health.evaluate import HealthEvaluator
+
+    reg, ring = _make_ring()
+    c = reg.counter("hvd_engine_cycles_total")
+    wd = slo.Watchdog(slo.parse_rules("cycle_rate>=1"))
+    ev = HealthEvaluator()
+    seen = []
+    ev.on_unhealthy = seen.append
+    old_ev = health.swap_evaluator(ev)
+    old_active = health.ACTIVE
+    health.ACTIVE = True
+    try:
+        c.inc(10)
+        ring.sample()
+        wd.observe(ring)
+        ring.sample()               # idle: breach
+        fired = wd.observe(ring)
+        assert fired
+        verdicts = ev.verdicts()
+        assert [v["kind"] for v in verdicts] == ["slo_breach"]
+        assert verdicts[0]["rule"] == "cycle_rate>=1"
+        assert seen and seen[0]["kind"] == "slo_breach"
+        assert not ev.healthy
+        c.inc(10)
+        ring.sample()               # recovered: condition cleared
+        wd.observe(ring)
+        assert ev.healthy
+    finally:
+        health.ACTIVE = old_active
+        health.swap_evaluator(old_ev)
+
+
+# --- flight-recorder ride-along ----------------------------------------------
+
+def test_failure_report_carries_timeseries_windows(monkeypatch):
+    from horovod_tpu.elastic import worker as eworker
+
+    reg, ring = _make_ring()
+    reg.counter("hvd_engine_cycles_total").inc(4)
+    ring.sample()
+    monkeypatch.setattr(timeseries, "_RING", ring)
+    monkeypatch.setattr(timeseries, "ACTIVE", True)
+
+    sent = {}
+
+    def capture(addr, port, method, payload, **kw):
+        sent.update(payload)
+
+    monkeypatch.setenv("HOROVOD_ELASTIC_DRIVER_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HOROVOD_ELASTIC_DRIVER_PORT", "1")
+    monkeypatch.setenv("HOROVOD_ELASTIC_WORKER_ID", "0")
+    monkeypatch.setattr(eworker, "json_request", capture)
+    eworker.record_result("FAILURE")
+    assert sent["timeseries"] == ring.windows(
+        timeseries.FAILURE_REPORT_WINDOWS)
+    # pruned when the plane is off
+    sent.clear()
+    monkeypatch.setattr(timeseries, "ACTIVE", False)
+    eworker.record_result("FAILURE")
+    assert "timeseries" not in sent
+    # the driver-side renderer digests the ride-along without raising
+    text = timeseries.render_windows(ring.windows())
+    assert "cycles/s=" in text
+
+
+def test_render_windows_and_summary_shapes(monkeypatch):
+    monkeypatch.setattr(timeseries, "_RING", None)
+    s = timeseries.summary()
+    assert s["windows"] == 0 and s["sampling"] is False
+    reg, ring = _make_ring()
+    monkeypatch.setattr(timeseries, "_RING", ring)
+    reg.counter("hvd_engine_cycles_total").inc(2)
+    ring.sample()
+    s = timeseries.summary()
+    assert s["windows"] == 1 and s["closed"] == 1
+    assert s["last"]["cycle_rate"] > 0
