@@ -37,6 +37,7 @@ and the comment shows the corrected form.  The bugs:
            the real registries.
 """
 
+import queue
 import socket
 import threading
 import time
@@ -341,6 +342,155 @@ def read_past_token_arity(token):
     # and every consumer in lockstep (append-only fields).
     fields = token_fields(token)
     return fields["s"][0][9]
+
+
+# ---------------------------------------------------------------------------
+# lifecycle antipatterns (HVD400-HVD407): the defect classes that recur
+# in background-thread machines — blocking under a contended lock,
+# job-lifetime growth, clock mixing, shutdown hygiene.
+# ---------------------------------------------------------------------------
+
+class AntipatternBlockingEngine:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._pending = 0
+
+    def stats(self):
+        # the quick path that stalls behind the blocking one — with a
+        # second acquisition site the lock is a data guard, not a
+        # single-site serialization mutex (which would be exempt)
+        with self._state_lock:
+            return self._pending
+
+    def flush(self):
+        # HVD400: a blocking RPC reached while self._state_lock is held
+        # (interprocedurally — the sleep/RPC live in a helper).  Every
+        # stats() call stalls for the full network round trip: a
+        # self-inflicted tail no deadline knob can fix.
+        with self._state_lock:
+            self._pending = 0
+            self._push_upstream()
+
+    def _push_upstream(self):
+        time.sleep(0.2)
+        json_request("127.0.0.1", 1, "antipattern_flush", {})
+
+
+class AntipatternBareWait:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.ready = False
+
+    def await_ready(self):
+        # HVD401: Condition.wait() outside a while-predicate loop — a
+        # spurious wakeup (or a notification meant for another waiter)
+        # returns with self.ready still False and the caller proceeds
+        # on a state that never happened.  Fix: while not self.ready:
+        with self._cond:
+            self._cond.wait()
+            return self.ready
+
+
+class AntipatternRequestLog:
+    def __init__(self):
+        self._seen_ids = set()       # grows per request, forever
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _serve_loop(self):
+        while True:
+            self._handle(object())
+
+    def _handle(self, request):
+        # HVD402: a per-request add into a job-lifetime set with no
+        # eviction/maxlen/prune anywhere in the class — the serving
+        # dedup-id leak (PR 15).  Fix: an LRU bound keyed on what
+        # retires the entries.
+        self._seen_ids.add(id(request))
+
+
+class AntipatternOrphanThread:
+    def start(self):
+        # HVD403: a non-daemon thread started and never joined by any
+        # method of the class — interpreter shutdown blocks on it
+        # forever.  Fix: join it in a close()/stop() method, or pass
+        # daemon=True if it holds no state worth flushing.
+        self._pump = threading.Thread(target=self._pump_loop)
+        self._pump.start()
+
+    def _pump_loop(self):
+        while True:
+            time.sleep(1.0)
+
+
+class AntipatternClockMix:
+    def __init__(self):
+        self._started_wall = time.time()     # wall clock: steps under NTP
+
+    def uptime(self):
+        # HVD404: monotonic minus wall — an NTP step makes this span
+        # jump backwards or by hours (the PR-12 buffer-clock incident).
+        # Fix: derive both ends from time.monotonic().
+        return time.monotonic() - self._started_wall
+
+
+class AntipatternHookUnderLock:
+    def __init__(self, on_drop):
+        self._lock = threading.Lock()
+        self._dropped = 0
+        self.on_drop = on_drop               # user-supplied callback
+
+    def dropped(self):
+        with self._lock:
+            return self._dropped
+
+    def drop(self, item):
+        # HVD405: a user callback invoked while holding the internal
+        # lock — user code that re-enters the API (drop(), dropped())
+        # deadlocks on the very lock the framework still holds.  Fix:
+        # snapshot under the lock, invoke after releasing it.
+        with self._lock:
+            self._dropped += 1
+            self.on_drop(item)
+
+
+class AntipatternUnwakeableLoop:
+    def __init__(self):
+        self._inbox = queue.Queue()
+        self._running = True
+
+    def _drain_loop(self):
+        # HVD406: the loop parks on a timeout-less Queue.get, but
+        # stop() only flips the flag — nothing ever wakes the get, so
+        # the loop never observes the stop and shutdown hangs.  Fix:
+        # stop() must also put a sentinel (or the get needs a timeout).
+        while self._running:
+            self._process(self._inbox.get())
+
+    def stop(self):
+        self._running = False
+
+    def _process(self, item):
+        del item
+
+
+class AntipatternStuckVerdict:
+    def __init__(self):
+        self._fired_slos = set()
+
+    def evaluate(self, slo, breached):
+        # HVD407: edge-trigger armed on fire, never cleared — after the
+        # first breach this SLO can never page again for the life of
+        # the process (the PR-13 stuck-verdict class), and the set is a
+        # leak besides.  Fix: discard the key when the SLO recovers.
+        if breached and slo not in self._fired_slos:
+            self._page_oncall(slo)
+            self._fired_slos.add(slo)
+
+    def _page_oncall(self, slo):
+        del slo
 
 
 def main():

@@ -7,7 +7,7 @@ rank-conditional branches, missing initial-state broadcast, mismatched
 submission order — are statically detectable in user scripts, so this
 package catches them in CI instead of on a TPU reservation.
 
-Three engines:
+Six engines:
 
 * **user-script rules** (``user_rules.py``): HVD001–HVD006, AST checks
   over training scripts for the deadlock/divergence hazard taxonomy —
@@ -22,6 +22,18 @@ Three engines:
   reads / init-time publication races are reported.  A findings
   baseline (``tools/hvdlint_baseline.json``, ``--baseline`` /
   ``--update-baseline``) lets CI fail only on NEW findings.
+* **SPMD divergence dataflow** (``divergence.py``): HVD200–HVD211,
+  rank-divergent control flow / operand shapes / collective parameters,
+  plus the committed collective-schedule snapshot checks.
+* **cross-artifact contracts** (``contracts.py``): HVD300–HVD307, the
+  repo-wide pass keeping config rows, docs tables, metric families,
+  RPC handler tables, chaos sites and the negotiation token schema in
+  lockstep.
+* **concurrency lifecycle** (``lifecycle.py``): HVD400–HVD407,
+  blocking-under-lock (interprocedural over the call graph), unbounded
+  job-lifetime growth, wall/monotonic clock mixing, and shutdown
+  hygiene (unjoined threads, unwakeable stop loops, stuck
+  edge-triggers).
 
 CLI::
 

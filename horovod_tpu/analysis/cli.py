@@ -12,7 +12,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from . import baseline as baseline_mod
 from . import contracts as contracts_mod
-from . import divergence, guarded_by, lock_order, user_rules
+from . import divergence, guarded_by, lifecycle, lock_order, user_rules
 from .report import (Finding, RULES, apply_suppressions,
                      file_skipped, iter_suppressions)
 
@@ -21,14 +21,18 @@ _SKIP_DIRS = {"__pycache__", ".git", "build", "dist", "node_modules",
 
 #: All engines, in run order.  "guards" is the HVD110–115 guarded-by
 #: race detector (guarded_by.py); "divergence" is the HVD200–HVD205
-#: SPMD rank-divergence dataflow engine (divergence.py); "contracts"
-#: is the HVD300–HVD307 cross-artifact contract checker
-#: (contracts.py) — the only engine that reasons repo-wide instead of
-#: per-module, so it runs once per analyze_files() call, not per file.
-ENGINES = ("user", "locks", "guards", "divergence", "contracts")
+#: SPMD rank-divergence dataflow engine (divergence.py); "lifecycle"
+#: is the HVD400–HVD407 concurrency-lifecycle engine (lifecycle.py:
+#: blocking-under-lock, unbounded growth, clock mixing, shutdown
+#: hygiene); "contracts" is the HVD300–HVD307 cross-artifact contract
+#: checker (contracts.py) — the only engine that reasons repo-wide
+#: instead of per-module, so it runs once per analyze_files() call,
+#: not per file.
+ENGINES = ("user", "locks", "guards", "divergence", "lifecycle",
+           "contracts")
 
 #: The per-module engines (everything except the repo-wide pass).
-_MODULE_ENGINES = ("user", "locks", "guards", "divergence")
+_MODULE_ENGINES = ("user", "locks", "guards", "divergence", "lifecycle")
 
 #: Parsed-AST cache keyed by absolute path: every pass (user rules,
 #: lock-order, guarded-by, divergence) and every re-run in one process
@@ -122,6 +126,8 @@ def analyze_source(source: str, path: str = "<string>",
         findings.extend(guarded_by.check_module(tree, path))
     if "divergence" in engines:
         findings.extend(divergence.check_module(tree, path))
+    if "lifecycle" in engines:
+        findings.extend(lifecycle.check_module(tree, path))
     findings = _dedupe_generalized(findings)
     findings = apply_suppressions(findings, iter_suppressions(source))
     findings.sort(key=lambda f: (f.line, f.col, f.code))
@@ -241,6 +247,63 @@ def expand_select(spec: str) -> Tuple[List[str], List[str]]:
     return codes, unknown
 
 
+def to_sarif(findings: Sequence[Finding]) -> dict:
+    """SARIF 2.1.0 log for one run — the interchange format CI systems
+    (GitHub code scanning, Gerrit checks) ingest to annotate diffs.
+
+    One run, one driver ("hvdlint"), the full six-engine rule catalog in
+    ``tool.driver.rules`` (so viewers can render titles/help for codes
+    with zero results), one ``result`` per finding.  Columns are
+    0-based internally; SARIF wants 1-based ``startColumn``.  Absolute
+    finding paths are rewritten relative to the repo root (same walk-up
+    the contracts engine uses), so a run over ``/abs/path/to/repo/...``
+    emits the same SRCROOT-relative URIs as an in-repo run."""
+    from .report import ANALYZER_VERSION
+    root = contracts_mod.find_repo_root([f.path for f in findings])
+    rules = [{
+        "id": code,
+        "shortDescription": {"text": title},
+        "help": {"text": fixit},
+    } for code, (title, fixit) in sorted(RULES.items())]
+    index = {r["id"]: i for i, r in enumerate(rules)}
+    results = []
+    for f in findings:
+        uri = f.path
+        if root and os.path.isabs(uri):
+            ap = os.path.abspath(uri)
+            if ap == root or ap.startswith(root + os.sep):
+                uri = os.path.relpath(ap, root)
+        results.append({
+            "ruleId": f.code,
+            "ruleIndex": index.get(f.code, -1),
+            "level": "error",
+            "message": {"text": f"{f.message}\nfix: {f.fixit}"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": uri.replace(os.sep, "/"),
+                        "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                }}],
+        })
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "hvdlint",
+                "version": str(ANALYZER_VERSION),
+                "informationUri": "docs/analysis.md",
+                "rules": rules,
+            }},
+            "columnKind": "utf16CodeUnits",
+            "results": results,
+        }],
+    }
+
+
 def _list_rules() -> str:
     lines = ["hvdlint rules:"]
     for code, (title, fixit) in sorted(RULES.items()):
@@ -291,18 +354,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="files or directories to analyze")
     parser.add_argument("--format", choices=("text", "json"),
                         default="text")
+    parser.add_argument("--sarif", metavar="OUT.json",
+                        help="also write the findings as a SARIF 2.1.0 "
+                             "log to this file (what CI code-scanning "
+                             "ingests to annotate diffs); '-' writes to "
+                             "stdout instead of the text report")
     parser.add_argument("--select", metavar="CODES",
                         help="comma-separated rule codes to report; "
                              "ranges allowed (HVD110-HVD115)")
     parser.add_argument("--engine",
                         choices=("user", "locks", "guards", "divergence",
-                                 "contracts", "all"),
+                                 "lifecycle", "contracts", "all"),
                         default="all",
                         help="user-script rules, the lock-order "
                              "self-check, the guarded-by race detector, "
                              "the SPMD divergence dataflow engine, the "
+                             "concurrency-lifecycle engine, the "
                              "cross-artifact contract checker, or all "
-                             "five (default)")
+                             "six (default)")
     parser.add_argument("--include-skipped", action="store_true",
                         help="analyze files marked '# hvdlint: skip-file' "
                              "(for linting the lint fixtures themselves)")
@@ -397,6 +466,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         except (ValueError, KeyError) as exc:
             parser.error(f"malformed baseline {args.baseline}: {exc}")
         findings, baselined = baseline_mod.apply(findings, allowed)
+
+    if args.sarif:
+        sarif = to_sarif(findings)
+        if args.sarif == "-":
+            print(json.dumps(sarif, indent=2, sort_keys=True))
+            return 1 if findings else 0
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            json.dump(sarif, f, indent=2, sort_keys=True)
+            f.write("\n")
 
     if args.format == "json":
         print(json.dumps({"findings": [f.as_dict() for f in findings],

@@ -8,17 +8,23 @@ guard** is inferred from the lock held at the majority of its access
 sites; the lock held at every *write* site is the fallback when no lock
 reaches a majority.  Accesses are tracked through ``with self._lock:``
 blocks, ``acquire()``/``release()`` spans, ``Condition(self._lock)``
-underlying-lock aliasing, and one level of intra-class calls: a private
-method only ever called with a lock held analyzes as if it held that
-lock (the *ambient* set), so ``caller must hold self._lock`` helpers do
-not false-positive.  Non-escaping nested defs (only ever called
-directly, never passed as a value) analyze under the locks provably
-held at BOTH their definition site and every direct call site — the
-``while not changed(): cv.wait()`` wait-predicate idiom defines and
-calls its predicate inside ``with self._cond:``, so the predicate and
-the private helpers it calls resolve the Condition's underlying lock
-one call level deeper, while a def merely *defined* under a lock but
-called after its release still analyzes bare.
+underlying-lock aliasing, and intra-class calls **to a fixed point**: a
+private method only ever called with a lock held analyzes as if it held
+that lock (the *ambient* set — the intersection over its call sites,
+each call site contributing its syntactic held set plus its own
+caller's ambient, iterated until stable), so ``caller must hold
+self._lock`` helper chains of any depth do not false-positive.
+Non-escaping nested defs (only ever called directly, never passed as a
+value) analyze under the locks provably held at BOTH their definition
+site and every direct call site, plus the enclosing method's ambient
+set — the ``while not changed(): cv.wait()`` wait-predicate idiom
+defines and calls its predicate inside ``with self._cond:``, so the
+predicate and the private helpers it calls resolve the Condition's
+underlying lock any number of call levels deeper, while a def merely
+*defined* under a lock but called after its release still analyzes
+bare, and a def that ESCAPES as a value (thread target, callback)
+runs on an unknown thread: neither the syntactic nor the ambient held
+set applies inside it.
 
 Findings:
 
@@ -133,6 +139,12 @@ class _Access:
     line: int
     in_init: bool
     escape_to: Optional[str] = None   # HVD112: "" = returned, else attr name
+    #: may this site inherit the enclosing method's ambient held set?
+    #: True for the method body and non-escaping nested defs (they run
+    #: on the defining thread, inside the method's dynamic extent);
+    #: False inside an escaping nested def — it runs later, on an
+    #: unknown thread, where the caller's ambient locks are NOT held.
+    ambient_ok: bool = True
 
 
 @dataclasses.dataclass
@@ -145,8 +157,8 @@ class _MergedClass:
     methods: Dict[str, Tuple[str, ast.AST]] = \
         dataclasses.field(default_factory=dict)
     accesses: List[_Access] = dataclasses.field(default_factory=list)
-    #: (caller method, held set, callee method name, line)
-    calls: List[Tuple[str, FrozenSet[str], str, int]] = \
+    #: (caller method, held set, callee method name, line, ambient_ok)
+    calls: List[Tuple[str, FrozenSet[str], str, int, bool]] = \
         dataclasses.field(default_factory=list)
     #: attr -> first __init__ assignment line
     init_assign_line: Dict[str, int] = dataclasses.field(default_factory=dict)
@@ -163,17 +175,23 @@ class _MethodWalker:
 
     def __init__(self, cls: _MergedClass, method: str, in_init: bool,
                  root: Optional[ast.AST] = None,
-                 shared: Optional[dict] = None):
+                 shared: Optional[dict] = None,
+                 ambient_ok: bool = True):
         self.cls = cls
         self.method = method
         self.in_init = in_init
         #: the outermost method node — nested walkers share it so escape
         #: analysis for a nested def sees every use site in the method
         self.root = root
+        #: False once inside an escaping nested def (and everything
+        #: below it): those statements run on an unknown thread, so the
+        #: enclosing method's ambient held set must not apply to them
+        self.ambient_ok = ambient_ok
         #: method-scope state shared with nested walkers: deferred
-        #: non-escaping nested defs ("defs": [(stmt, def_held, label)])
-        #: and the running INTERSECTION of the held set at each direct
-        #: call site of a nested name ("call_held": name -> fset|None)
+        #: non-escaping nested defs ("defs": [(stmt, def_held, label,
+        #: ambient_ok)]) and the running INTERSECTION of the held set at
+        #: each direct call site of a nested name ("call_held":
+        #: name -> fset|None)
         self.shared = shared if shared is not None \
             else {"defs": [], "call_held": {}}
 
@@ -189,7 +207,8 @@ class _MethodWalker:
             return
         self.cls.accesses.append(_Access(
             attr=attr, kind=kind, held=held, method=self.method,
-            line=line, in_init=self.in_init, escape_to=escape_to))
+            line=line, in_init=self.in_init, escape_to=escape_to,
+            ambient_ok=self.ambient_ok))
         if self.in_init and kind in ("write", "rmw") \
                 and attr not in self.cls.init_assign_line:
             self.cls.init_assign_line[attr] = line
@@ -234,12 +253,14 @@ class _MethodWalker:
                         and not _nested_escapes(self.root, stmt.name))
             if inherits:
                 self.shared["defs"].append(
-                    (stmt, held, f"{self.method}.<{stmt.name}>"))
+                    (stmt, held, f"{self.method}.<{stmt.name}>",
+                     self.ambient_ok))
                 self.shared["call_held"].setdefault(stmt.name, None)
             else:
                 nested = _MethodWalker(
                     self.cls, f"{self.method}.<{stmt.name}>",
-                    in_init=False, root=self.root, shared=self.shared)
+                    in_init=False, root=self.root, shared=self.shared,
+                    ambient_ok=False)
                 nested.walk(stmt.body, frozenset())
             return held
         if isinstance(stmt, ast.ClassDef):
@@ -407,19 +428,20 @@ class _MethodWalker:
         while done < len(defs):
             remaining = defs[done:]
             pick = 0
-            for j, (stmt_j, _, _) in enumerate(remaining):
+            for j, (stmt_j, _, _, _) in enumerate(remaining):
                 if not any(k != j and _calls_name(stmt_k, stmt_j.name)
-                           for k, (stmt_k, _, _) in enumerate(remaining)):
+                           for k, (stmt_k, _, _, _) in enumerate(remaining)):
                     pick = j
                     break
             defs[done], defs[done + pick] = defs[done + pick], defs[done]
-            stmt, def_held, label = defs[done]
+            stmt, def_held, label, amb_ok = defs[done]
             done += 1
             call_held = self.shared["call_held"].get(stmt.name)
             effective = def_held & call_held if call_held is not None \
                 else frozenset()
             nested = _MethodWalker(self.cls, label, in_init=False,
-                                   root=self.root, shared=self.shared)
+                                   root=self.root, shared=self.shared,
+                                   ambient_ok=amb_ok)
             nested.walk(stmt.body, effective)
 
     def _scan_expr(self, node: ast.expr, held: FrozenSet[str],
@@ -441,7 +463,8 @@ class _MethodWalker:
                         pass
                     elif fn.attr in self.cls.methods:
                         self.cls.calls.append(
-                            (self.method, held, fn.attr, node.lineno))
+                            (self.method, held, fn.attr, node.lineno,
+                             self.ambient_ok))
                     else:
                         self._access(fn.attr, "read", held, node.lineno)
                 else:
@@ -559,9 +582,14 @@ def _ambient_held(merged: _MergedClass, root_methods: Set[str]
     thread entry point runs with no lock held no matter who else calls
     it intra-class)."""
     all_locks = frozenset(d.underlying for d in merged.locks.values())
-    callers: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
-    for caller, held, callee, _line in merged.calls:
-        callers.setdefault(callee, []).append((caller.split(".")[0], held))
+    callers: Dict[str, List[Tuple[Optional[str], FrozenSet[str]]]] = {}
+    for caller, held, callee, _line, amb_ok in merged.calls:
+        # a call made inside an ESCAPING nested def runs on an unknown
+        # thread: the enclosing method's ambient locks are not held
+        # there, so that call site contributes only its syntactic held
+        # set (caller recorded as None — no ambient lookup)
+        base = caller.split(".")[0] if amb_ok else None
+        callers.setdefault(callee, []).append((base, held))
     ambient: Dict[str, FrozenSet[str]] = {}
     for m in merged.methods:
         private = m.startswith("_") and not m.startswith("__")
@@ -575,7 +603,8 @@ def _ambient_held(merged: _MergedClass, root_methods: Set[str]
                 continue
             acc = None
             for caller, held in callers.get(m, ()):
-                eff = held | ambient.get(caller, frozenset())
+                eff = held | (ambient.get(caller, frozenset())
+                              if caller is not None else frozenset())
                 acc = eff if acc is None else (acc & eff)
             acc = acc if acc is not None else frozenset()
             if acc != ambient[m]:
@@ -634,9 +663,16 @@ class _ClassCheck:
         root_methods = {r.qname.split(".", 1)[1] for r in roots
                         if r.cls is not None and "." in r.qname}
         ambient = _ambient_held(merged, root_methods)
+        # the ambient set applies to the method body AND its
+        # non-escaping nested defs (they run inside the method's
+        # dynamic extent on the same thread — the second call level of
+        # the ``while not pred(): cv.wait()`` idiom, where the
+        # predicate lives in a helper whose callers hold the lock);
+        # sites inside an ESCAPING nested def run on an unknown thread
+        # and stay bare (ambient_ok=False)
         for a in merged.accesses:
             base = a.method.split(".")[0]
-            if "<" not in a.method:
+            if a.ambient_ok:
                 a.held = a.held | ambient.get(base, frozenset())
 
         by_attr: Dict[str, List[_Access]] = {}

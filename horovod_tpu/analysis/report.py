@@ -13,8 +13,10 @@ from typing import Dict, Iterable, List, Set, Tuple
 #: baseline can never silently mask (or resurrect) findings across an
 #: analyzer upgrade.  v3 = schedule extractor + divergence dataflow
 #: engine (HVD200–HVD215) + nested-def held-set inheritance.  v4 =
-#: cross-artifact contract engine (HVD300–HVD307, contracts.py).
-ANALYZER_VERSION = 4
+#: cross-artifact contract engine (HVD300–HVD307, contracts.py).  v5 =
+#: concurrency-lifecycle engine (HVD400–HVD407, lifecycle.py) + ambient
+#: held sets reaching nested defs in guarded_by.
+ANALYZER_VERSION = 5
 
 # code -> (title, default fix-it).  The fix-it is the actionable half of
 # every message: what to change so the job cannot deadlock/diverge.
@@ -184,6 +186,49 @@ RULES: Dict[str, Tuple[str, str]] = {
         "pass only the labels the family declared (or extend the "
         "declaration) — the registry silently drops unknown labels, so "
         "the series you meant to split never materializes"),
+    "HVD400": (
+        "blocking call reached while a lock is held",
+        "move the RPC/sleep/join/get outside the critical section "
+        "(snapshot what you need under the lock, block after releasing "
+        "it) — every other thread needing the lock stalls for the full "
+        "wait, a self-inflicted tail no deadline knob can fix"),
+    "HVD401": (
+        "Condition.wait() outside a while-predicate loop",
+        "wrap the wait in `while not predicate(): cv.wait()` — spurious "
+        "wakeups and stolen notifications make a bare wait return with "
+        "the predicate still false"),
+    "HVD402": (
+        "job-lifetime container grows with no eviction or bound",
+        "add a maxlen/LRU bound or a prune pass keyed on what retires "
+        "the entries (request done, worker dead, epoch rolled) — a "
+        "per-request append into a long-lived container is a leak that "
+        "kills the job at day, not minute, timescales"),
+    "HVD403": (
+        "non-daemon thread started but never joined",
+        "join the thread on the close/stop/__exit__ path (or pass "
+        "daemon=True if it holds no state worth flushing) — interpreter "
+        "shutdown blocks on every live non-daemon thread"),
+    "HVD404": (
+        "wall-clock value mixed with monotonic-clock value",
+        "derive both sides of the comparison/subtraction from the same "
+        "clock — time.time() steps under NTP, so a span against "
+        "time.monotonic() can go negative or jump by hours; use "
+        "monotonic for durations, wall time for display only"),
+    "HVD405": (
+        "user callback invoked while holding an internal lock",
+        "snapshot the callback list under the lock, call it after "
+        "releasing — user code that re-enters the API deadlocks on the "
+        "very lock the framework still holds"),
+    "HVD406": (
+        "shutdown flag cannot wake the loop it stops",
+        "make the stop path signal the primitive the loop parks on "
+        "(put a sentinel, set the event, or wait with a timeout) — "
+        "flipping the flag alone leaves the loop parked forever"),
+    "HVD407": (
+        "edge-trigger state set on fire but never cleared",
+        "clear the key when the condition recovers (or bound the set "
+        "with an LRU) — a once-set membership test fires at most once "
+        "per process lifetime and the set leaks besides"),
 }
 
 

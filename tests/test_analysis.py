@@ -356,7 +356,9 @@ class Engine:
             with self._cv:
                 self._cv.wait()
 """
-    assert codes(src) == ["HVD102"]
+    # the timeout-less wait outside a while loop is also a bare wait
+    # (HVD401, engine 6) — both convictions are correct here
+    assert codes(src) == ["HVD102", "HVD401"]
 
 
 def test_hvd102_wait_on_own_lock_is_clean():
@@ -482,15 +484,18 @@ def test_antipatterns_fixture_trips_every_user_rule():
     assert analyze_paths([path]) == []
     # ... and every documented antipattern fires under --include-skipped,
     # including the RacyMetricsSink guarded-by fixture, the HVD200–HVD205
-    # divergence dataflow fixtures, and the HVD300–HVD307 cross-layer
-    # contract-drift fixtures (engine 5)
+    # divergence dataflow fixtures, the HVD300–HVD307 cross-layer
+    # contract-drift fixtures (engine 5), and the HVD400–HVD407
+    # concurrency-lifecycle fixtures (engine 6)
     found = [f.code for f in analyze_paths([path], include_skipped=True)]
     assert sorted(set(found)) == [
         "HVD001", "HVD002", "HVD003", "HVD004", "HVD005", "HVD006",
         "HVD110", "HVD111", "HVD113", "HVD114",
         "HVD200", "HVD201", "HVD202", "HVD203", "HVD204", "HVD205",
         "HVD300", "HVD301", "HVD302", "HVD303", "HVD304", "HVD305",
-        "HVD306", "HVD307"]
+        "HVD306", "HVD307",
+        "HVD400", "HVD401", "HVD402", "HVD403", "HVD404", "HVD405",
+        "HVD406", "HVD407"]
 
 
 def test_cli_json_output_and_exit_codes():

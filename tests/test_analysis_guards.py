@@ -765,9 +765,13 @@ def test_expand_select_ranges():
     codes, unknown = expand_select("HVD300-HVD307")
     assert codes == ["HVD300", "HVD301", "HVD302", "HVD303", "HVD304",
                      "HVD305", "HVD306", "HVD307"] and not unknown
+    # the lifecycle family (engine 6) is selectable as a band too
+    codes, unknown = expand_select("HVD400-HVD407")
+    assert codes == ["HVD400", "HVD401", "HVD402", "HVD403", "HVD404",
+                     "HVD405", "HVD406", "HVD407"] and not unknown
     # ... but a range selecting NOTHING is a typo, not a filter
-    _, unknown = expand_select("HVD400-HVD999")
-    assert unknown == ["HVD400-HVD999"]
+    _, unknown = expand_select("HVD500-HVD999")
+    assert unknown == ["HVD500-HVD999"]
     _, unknown = expand_select("HVD115-HVD110")
     assert unknown == ["HVD115-HVD110"]
 
@@ -1016,3 +1020,56 @@ def test_nested_sibling_predicate_chain_is_order_independent():
         "                def a():\n                    return self._ver > since")
     assert swapped != chain
     assert guard_findings(swapped) == []
+
+
+# ---------------------------------------------------------------------------
+# hvdlint v5: ambient held sets propagate to the fixed point (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+TWO_LEVEL_HELPER = """
+import threading
+class Nest:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._x = 0
+    def poke_a(self):
+        with self._lock:
+            self._x += 1
+    def poke_b(self):
+        with self._lock:
+            self._x += 1
+    def poke_c(self):
+        with self._lock:
+            self._x += 1
+    def run(self):
+        with self._lock:
+            self._helper()
+    def _helper(self):
+        # caller holds self._lock; the nested def runs inside that
+        # dynamic extent and must inherit the ambient held set too
+        def bump():
+            self._x += 1
+        bump()
+"""
+
+
+def test_ambient_held_set_reaches_nested_def_in_helper():
+    # pre-fix shape: ambient propagation stopped one call level short of
+    # nested defs — `Nest._helper.<bump>` analyzed bare and produced a
+    # false HVD111 ("held at 3/4 access sites") even though every dynamic
+    # path to bump() holds self._lock
+    findings = guard_findings(TWO_LEVEL_HELPER)
+    assert findings == [], [f.format_text() for f in findings]
+
+
+def test_escaping_nested_def_in_helper_gets_no_ambient():
+    # soundness direction of the same fix: hand the SAME nested def to a
+    # thread instead of calling it — it now runs outside the helper's
+    # dynamic extent, must NOT inherit the caller-held lock, and the
+    # bare mutation is convicted
+    escaped = textwrap.dedent(TWO_LEVEL_HELPER).replace(
+        "        bump()\n",
+        "        threading.Thread(target=bump).start()\n")
+    findings = analyze_source(escaped, "nest_escape.py", engines=("guards",))
+    assert any(f.code == "HVD111" and "_x" in f.message
+               for f in findings), [f.format_text() for f in findings]

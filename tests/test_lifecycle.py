@@ -1,0 +1,582 @@
+"""hvdlint v5 tests: the concurrency-lifecycle engine (HVD400-HVD407).
+
+Per-rule convict/near-miss pairs (the test_contracts.py pattern, inlined
+as source pairs since this engine is per-module), the framework-clean
+vs. fixture-convicts pins, the two rule-refinement pins landed while
+running the engine over the real tree (the controller's single-site
+round lock, first-write-wins memoization), and the SARIF 2.1.0 output
+satellite."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from horovod_tpu.analysis import RULES, analyze_source
+from horovod_tpu.analysis.cli import ENGINES, _MODULE_ENGINES, to_sarif
+from horovod_tpu.analysis.report import ANALYZER_VERSION, Finding
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(src, **kw):
+    return [f.code for f in findings(src, **kw)]
+
+
+def findings(src, **kw):
+    return analyze_source(textwrap.dedent(src), "fixture.py",
+                          engines=("lifecycle",), **kw)
+
+
+def analyze_file(relpath):
+    with open(os.path.join(REPO, relpath)) as f:
+        return analyze_source(f.read(), relpath, engines=("lifecycle",))
+
+
+# ---------------------------------------------------------------------------
+# wiring
+# ---------------------------------------------------------------------------
+
+def test_engine_is_wired():
+    assert "lifecycle" in ENGINES
+    assert "lifecycle" in _MODULE_ENGINES
+    for n in range(400, 408):
+        assert f"HVD{n}" in RULES
+
+
+def test_analyzer_version_bumped_for_engine_six():
+    # the baseline fingerprints and stale-baseline refusal key on this
+    assert ANALYZER_VERSION >= 5
+
+
+# ---------------------------------------------------------------------------
+# HVD400: blocking call while a lock is held (interprocedural)
+# ---------------------------------------------------------------------------
+
+BLOCKING_ENGINE = """
+import threading, time
+class Eng:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+    def stats(self):
+        with self._lock:
+            return self._n
+    def step(self):
+        with self._lock:
+            self._n += 1
+            self._push()
+    def _push(self):
+        time.sleep(1.0)
+"""
+
+
+def test_hvd400_blocking_reached_through_helper():
+    found = findings(BLOCKING_ENGINE)
+    assert [f.code for f in found] == ["HVD400"], \
+        [f.format_text() for f in found]
+    # the message names the lock and the interprocedural witness
+    assert "_lock" in found[0].message
+    assert "reached from" in found[0].message
+
+
+def test_hvd400_blocking_after_release_is_clean():
+    clean = BLOCKING_ENGINE.replace(
+        "            self._n += 1\n"
+        "            self._push()\n",
+        "            self._n += 1\n"
+        "        self._push()\n")
+    assert codes(clean) == []
+
+
+def test_hvd400_single_site_serialization_mutex_is_exempt():
+    # the controller's _round_lock pattern: ONE acquisition site means
+    # only identical operations queue behind it — that stall is the
+    # design, and there is no quick path to protect
+    assert codes("""
+    import threading, time
+    class Ctl:
+        def __init__(self):
+            self._round_lock = threading.Lock()
+        def negotiate(self):
+            with self._round_lock:
+                time.sleep(0.5)
+    """) == []
+
+
+def test_hvd400_rpc_and_timeoutless_get_convict():
+    found = codes("""
+    import threading, queue
+    class Pump:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = queue.Queue()
+            self._n = 0
+        def poke(self):
+            with self._lock:
+                self._n += 1
+        def bad_rpc(self):
+            with self._lock:
+                json_request("h", 1, "m", {})
+        def bad_get(self):
+            with self._lock:
+                return self._q.get()
+        def ok_bounded_get(self):
+            with self._lock:
+                return self._q.get(timeout=0.1)
+    """)
+    assert found == ["HVD400", "HVD400"], found
+
+
+def test_hvd400_condition_wait_is_not_blocking():
+    # cv.wait() RELEASES the lock it waits on — HVD401/HVD102 govern
+    # it; convicting it here would flag every correct wait-predicate
+    assert codes("""
+    import threading
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+            self.ready = False
+        def poke(self):
+            with self._lock:
+                self.ready = True
+                self._cond.notify_all()
+        def await_ready(self):
+            with self._cond:
+                while not self.ready:
+                    self._cond.wait()
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD401: Condition.wait outside a while-predicate loop
+# ---------------------------------------------------------------------------
+
+def test_hvd401_bare_wait_convicts_looped_wait_does_not():
+    bad = """
+    import threading
+    class W:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self.ready = False
+        def await_ready(self):
+            with self._cond:
+                self._cond.wait()
+    """
+    assert codes(bad) == ["HVD401"]
+    good = bad.replace(
+        "                self._cond.wait()",
+        "                while not self.ready:\n"
+        "                    self._cond.wait()")
+    assert good != bad
+    assert codes(good) == []
+
+
+def test_hvd401_timeout_wait_is_an_interruptible_sleep():
+    # wait(timeout) used as a poll-interval sleep is an idiom, not a
+    # lost-wakeup hazard — bounded by construction
+    assert codes("""
+    import threading
+    class W:
+        def __init__(self):
+            self._cond = threading.Condition()
+        def nap(self):
+            with self._cond:
+                self._cond.wait(0.5)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD402: job-lifetime growth with no eviction
+# ---------------------------------------------------------------------------
+
+REQUEST_LOG = """
+import threading
+class Srv:
+    def __init__(self):
+        self._seen = set()
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+    def _loop(self):
+        while True:
+            self._handle(object())
+    def _handle(self, req):
+        self._seen.add(id(req))
+"""
+
+
+def test_hvd402_per_request_growth_convicts():
+    assert codes(REQUEST_LOG) == ["HVD402"]
+
+
+def test_hvd402_prune_or_reset_is_clean():
+    pruned = REQUEST_LOG.replace(
+        "    def _handle(self, req):",
+        "    def _gc(self):\n"
+        "        while len(self._seen) > 1024:\n"
+        "            self._seen.pop()\n"
+        "    def _handle(self, req):")
+    assert codes(pruned) == []
+    reset = REQUEST_LOG.replace(
+        "    def _handle(self, req):",
+        "    def roll(self):\n"
+        "        self._seen = set()\n"
+        "    def _handle(self, req):")
+    assert codes(reset) == []
+
+
+def test_hvd402_bounded_deque_and_threadless_class_are_clean():
+    # deque(maxlen=) is bounded by construction
+    assert codes("""
+    import threading
+    from collections import deque
+    class Ring:
+        def __init__(self):
+            self._buf = deque(maxlen=128)
+            self._t = threading.Thread(target=self._loop, daemon=True)
+        def _loop(self):
+            while True:
+                self._buf.append(1)
+    """) == []
+    # a class with no thread root / handler table in this module is not
+    # provably long-lived — the safe under-approximation
+    assert codes("""
+    class Batch:
+        def __init__(self):
+            self._items = []
+        def add(self, x):
+            self._items.append(x)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD403: non-daemon thread never joined
+# ---------------------------------------------------------------------------
+
+ORPHAN = """
+import threading
+class D:
+    def start(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+    def _run(self):
+        pass
+"""
+
+
+def test_hvd403_unjoined_nondaemon_convicts():
+    assert codes(ORPHAN) == ["HVD403"]
+
+
+def test_hvd403_daemon_or_joined_is_clean():
+    assert codes(ORPHAN.replace("target=self._run",
+                                "target=self._run, daemon=True")) == []
+    joined = ORPHAN.replace(
+        "    def _run(self):",
+        "    def close(self):\n"
+        "        self._t.join()\n"
+        "    def _run(self):")
+    assert codes(joined) == []
+
+
+def test_hvd403_inline_fire_and_forget():
+    assert codes("""
+    import threading
+    def kick(fn):
+        threading.Thread(target=fn).start()
+    """) == ["HVD403"]
+    assert codes("""
+    import threading
+    def kick(fn):
+        threading.Thread(target=fn, daemon=True).start()
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD404: wall/monotonic clock mixing
+# ---------------------------------------------------------------------------
+
+def test_hvd404_mixed_span_convicts_via_attr_dataflow():
+    assert codes("""
+    import time
+    class T:
+        def __init__(self):
+            self._t0 = time.time()
+        def span(self):
+            return time.monotonic() - self._t0
+    """) == ["HVD404"]
+
+
+def test_hvd404_mixed_compare_convicts_via_locals():
+    assert codes("""
+    import time
+    def expired(deadline_wall):
+        t0 = time.time()
+        deadline = t0 + 5.0
+        now = time.monotonic()
+        return now > deadline
+    """) == ["HVD404"]
+
+
+def test_hvd404_same_domain_spans_are_clean():
+    assert codes("""
+    import time
+    class T:
+        def __init__(self):
+            self._t0 = time.monotonic()
+            self._wall0 = time.time()
+        def span(self):
+            return time.monotonic() - self._t0
+        def wall_span(self):
+            return time.time() - self._wall0
+        def deadline_ok(self):
+            return time.monotonic() < self._t0 + 30.0
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD405: user callback under an internal lock
+# ---------------------------------------------------------------------------
+
+HOOK_UNDER_LOCK = """
+import threading
+class H:
+    def __init__(self, on_drop):
+        self._lock = threading.Lock()
+        self._n = 0
+        self.on_drop = on_drop
+    def count(self):
+        with self._lock:
+            return self._n
+    def drop(self, x):
+        with self._lock:
+            self._n += 1
+            self.on_drop(x)
+"""
+
+
+def test_hvd405_hook_under_lock_convicts():
+    assert codes(HOOK_UNDER_LOCK) == ["HVD405"]
+
+
+def test_hvd405_hook_after_release_is_clean():
+    moved = HOOK_UNDER_LOCK.replace(
+        "            self._n += 1\n"
+        "            self.on_drop(x)\n",
+        "            self._n += 1\n"
+        "        self.on_drop(x)\n")
+    assert codes(moved) == []
+
+
+def test_hvd405_own_method_named_on_x_is_internal():
+    # a method the class DEFINES is framework code, not a user hook
+    assert codes("""
+    import threading
+    class H:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+        def on_tick(self):
+            self._n += 1
+        def tick(self):
+            with self._lock:
+                self.on_tick()
+    """) == []
+
+
+def test_hvd405_handler_table_and_loop_var():
+    found = codes("""
+    import threading
+    class Bus:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._hooks = []
+        def add(self, h):
+            with self._lock:
+                self._hooks.append(h)
+        def fire(self, ev):
+            with self._lock:
+                for cb in self._hooks:
+                    cb(ev)
+    """)
+    assert found == ["HVD405"], found
+
+
+# ---------------------------------------------------------------------------
+# HVD406: shutdown flag cannot wake the parked loop
+# ---------------------------------------------------------------------------
+
+UNWAKEABLE = """
+import threading, queue
+class L:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._running = True
+    def _loop(self):
+        while self._running:
+            item = self._q.get()
+    def stop(self):
+        self._running = False
+"""
+
+
+def test_hvd406_flag_only_stop_convicts():
+    assert codes(UNWAKEABLE) == ["HVD406"]
+
+
+def test_hvd406_sentinel_put_or_timeout_is_clean():
+    sentinel = UNWAKEABLE.replace(
+        "        self._running = False",
+        "        self._running = False\n"
+        "        self._q.put(None)")
+    assert codes(sentinel) == []
+    bounded = UNWAKEABLE.replace("self._q.get()",
+                                 "self._q.get(timeout=0.5)")
+    assert codes(bounded) == []
+
+
+def test_hvd406_parking_on_the_flag_event_itself_is_clean():
+    # the flag IS the primitive: setting it wakes the wait
+    assert codes("""
+    import threading
+    class L:
+        def __init__(self):
+            self._stop = threading.Event()
+        def _loop(self):
+            while not self._stop.is_set():
+                self._stop.wait()
+        def stop(self):
+            self._stop.set()
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD407: edge-trigger armed on fire, never cleared
+# ---------------------------------------------------------------------------
+
+STUCK_VERDICT = """
+class V:
+    def __init__(self):
+        self._fired = set()
+    def evaluate(self, slo, breached):
+        if breached and slo not in self._fired:
+            self._page(slo)
+            self._fired.add(slo)
+    def _page(self, slo):
+        pass
+"""
+
+
+def test_hvd407_stuck_verdict_convicts():
+    assert codes(STUCK_VERDICT) == ["HVD407"]
+
+
+def test_hvd407_clearing_rearm_is_clean():
+    rearmed = STUCK_VERDICT.replace(
+        "    def _page(self, slo):",
+        "    def recover(self, slo):\n"
+        "        self._fired.discard(slo)\n"
+        "    def _page(self, slo):")
+    assert codes(rearmed) == []
+
+
+def test_hvd407_memoization_guard_is_not_an_edge_trigger():
+    # first-write-wins caching has no "fire" action — idempotent, and
+    # bounded by the key population (the _ClassFacts.threads shape the
+    # engine initially false-positived on over its own source)
+    assert codes("""
+    class M:
+        def __init__(self):
+            self._cache = {}
+        def get(self, k):
+            if k not in self._cache:
+                self._cache[k] = object()
+            return self._cache[k]
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# framework-clean vs fixture-convicts pins
+# ---------------------------------------------------------------------------
+
+def test_threaded_core_modules_are_clean_under_lifecycle():
+    # the modules with the busiest thread/lock traffic — including
+    # ops/controller.py, whose single-site _round_lock deliberately
+    # serializes whole negotiation rounds (the HVD400 exemption pin)
+    for rel in ("horovod_tpu/ops/controller.py",
+                "horovod_tpu/ops/engine.py",
+                "horovod_tpu/elastic/driver.py",
+                "horovod_tpu/serving/plane.py",
+                "horovod_tpu/metrics/timeseries.py",
+                "horovod_tpu/runner/kv.py",
+                "horovod_tpu/analysis/lifecycle.py"):
+        found = analyze_file(rel)
+        assert found == [], (rel, [f.format_text() for f in found])
+
+
+def test_antipatterns_fixture_trips_every_lifecycle_rule():
+    path = os.path.join(REPO, "examples", "antipatterns.py")
+    with open(path) as f:
+        found = analyze_source(f.read(), path, engines=("lifecycle",),
+                               include_skipped=True)
+    hit = {f.code for f in found}
+    want = {f"HVD{n}" for n in range(400, 408)}
+    assert want <= hit, f"missing fixtures for: {sorted(want - hit)}"
+
+
+# ---------------------------------------------------------------------------
+# SARIF 2.1.0 output (satellite)
+# ---------------------------------------------------------------------------
+
+def test_sarif_schema_shape():
+    log = to_sarif([Finding("HVD400", "horovod_tpu/x.py", 12, 4, "msg")])
+    assert log["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in log["$schema"]
+    (run,) = log["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "hvdlint"
+    assert driver["version"] == str(ANALYZER_VERSION)
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert rule_ids == sorted(RULES)          # full catalog, all engines
+    (res,) = run["results"]
+    assert res["ruleId"] == "HVD400"
+    assert res["level"] == "error"
+    assert driver["rules"][res["ruleIndex"]]["id"] == "HVD400"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "horovod_tpu/x.py"
+    assert loc["region"] == {"startLine": 12, "startColumn": 5}  # 1-based
+
+
+def test_sarif_absolute_paths_become_srcroot_relative():
+    # driving hvdlint from outside the repo with absolute inputs must
+    # emit the same SRCROOT-relative URIs as an in-repo run — CI diff
+    # annotators key artifacts on the relative path
+    abspath = os.path.join(REPO, "horovod_tpu", "x.py")
+    log = to_sarif([Finding("HVD400", abspath, 12, 4, "msg")])
+    loc = log["runs"][0]["results"][0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "horovod_tpu/x.py"
+    assert loc["artifactLocation"]["uriBaseId"] == "SRCROOT"
+
+
+def test_sarif_empty_run_still_carries_catalog():
+    log = to_sarif([])
+    assert log["runs"][0]["results"] == []
+    assert log["runs"][0]["tool"]["driver"]["rules"]
+
+
+def test_sarif_cli_end_to_end(tmp_path):
+    out = tmp_path / "lint.sarif"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis",
+         "--engine", "lifecycle", "--include-skipped",
+         "--sarif", str(out),
+         os.path.join("examples", "antipatterns.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    log = json.loads(out.read_text())
+    assert log["version"] == "2.1.0"
+    got = {r["ruleId"] for r in log["runs"][0]["results"]}
+    assert {f"HVD{n}" for n in range(400, 408)} <= got
