@@ -649,6 +649,61 @@ def _entry_serve_forward_step():
     return step, (sds((2, 16), jnp.int32), sds((2,), jnp.int32))
 
 
+#: fixed model axis of the mesh-sliced serving entry: the consistency
+#: check varies the worker axis through ``_AXIS`` (unused by the step,
+#: like serve_forward_step) while the shard degree stays 2.
+_SERVE_MP = 2
+
+
+def _entry_serve_mp_forward_step():
+    """The model-parallel serving data path (ISSUE 20): the same
+    batched ragged decode as ``serve_forward_step``, but the weights
+    arrive as mesh-slice local shards and are ``spec_all_gather``ed
+    over the model axis inside the step (serving/worker.py
+    MeshSlicedForward).  The pinned schedule contains ONLY the spec
+    gather hops — weight movement, never gradient movement.  The
+    ``serve_forward_step`` empty-schedule pin generalizes: a gradient
+    collective appearing here (a stray psum from a reused training
+    step, a health tap riding the serving mesh) changes the record set
+    and fails HVD211 structurally, exactly like a non-empty schedule
+    would fail the DP entry.  Specs come from ``fsdp_param_specs`` —
+    serving shards the same way training's FSDP path does, so the
+    snapshot also pins that the two planes agree on what a shard is."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ..models import llama
+    from ..models.generate import batched_greedy_decode
+    from ..training import fsdp_param_specs, spec_all_gather
+
+    cfg = llama.tiny(vocab=64, seq=32)
+    shapes = jax.eval_shape(
+        lambda: llama.init_params(cfg, jax.random.PRNGKey(0)))
+    specs = fsdp_param_specs(shapes, _SERVE_MP, axis="hvd_serve_mp")
+
+    def local_sds(spec, leaf):
+        dims = list(leaf.shape)
+        for dim, entry in enumerate(spec):
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            if "hvd_serve_mp" in axes:
+                dims[dim] //= _SERVE_MP
+                break
+        return jax.ShapeDtypeStruct(tuple(dims), leaf.dtype)
+
+    shards = jax.tree_util.tree_map(local_sds, specs, shapes,
+                                    is_leaf=lambda x: isinstance(x, P))
+
+    def step(shards, tokens, lengths):
+        full = spec_all_gather(shards, specs, "hvd_serve_mp")
+        return batched_greedy_decode(full, cfg, tokens, lengths,
+                                     max_new_tokens=4, max_len=20)
+
+    sds = jax.ShapeDtypeStruct
+    return (step,
+            (shards, sds((2, 16), jnp.int32), sds((2,), jnp.int32)),
+            (("hvd_serve_mp", _SERVE_MP),))
+
+
 #: entry name -> builder returning (fn, example_args) or
 #: (fn, example_args, extra_axes): ``extra_axes`` extends the trace's
 #: axis_env past the varied ``_AXIS`` (hierarchical entries need a
@@ -664,6 +719,7 @@ BUILTIN_ENTRIES = {
     "health_distopt_step": _entry_health_distopt_step,
     "fsdp_distopt_step": _entry_fsdp_distopt_step,
     "serve_forward_step": _entry_serve_forward_step,
+    "serve_mp_forward_step": _entry_serve_mp_forward_step,
 }
 
 #: Mesh sizes the consistency check traces every entry at (HVD210).

@@ -227,6 +227,16 @@ class Config:
     # this factor x the median of its peers stops receiving pulls
     # (>= 2 active workers; never the last one).  0 disables.
     serve_straggler_factor: float = 3.0
+    # paged KV cache: tokens per pool block (serving/paging.py).  The
+    # granularity knob: smaller blocks waste fewer slots per row but
+    # deepen the block table; per-row cost is ceil((len+new)/block)
+    # blocks instead of bucket-max.
+    serve_kv_block: int = 16
+    # model-parallel serving mesh: "" = DP-only (every worker a full
+    # replica), or "name:degree" (e.g. "model:2") — the worker group
+    # serves as one mesh slice with params sharded degree-ways
+    # (serving/worker.py MeshSlicedForward).  Single axis for now.
+    serve_mp_axes: str = ""
     # --- checkpointless recovery (docs/elastic.md "Checkpointless
     # recovery"; env table in docs/env.md) ---
     # peer-redundancy mode for the per-worker ZeRO tile snapshots:
@@ -445,6 +455,16 @@ class Config:
                 f"HOROVOD_SERVE_STRAGGLER_FACTOR must be 0 (off) or > 1 "
                 f"(a bar at or below the peer median rotates every "
                 f"worker), got {c.serve_straggler_factor}")
+        c.serve_kv_block = _env_int(
+            "HOROVOD_SERVE_KV_BLOCK", c.serve_kv_block)
+        if c.serve_kv_block < 1:
+            raise ValueError(
+                f"HOROVOD_SERVE_KV_BLOCK must be >= 1, got "
+                f"{c.serve_kv_block}")
+        c.serve_mp_axes = (_env_str(
+            "HOROVOD_SERVE_MP_AXES", c.serve_mp_axes) or "").strip()
+        from .serving.shapes import parse_mp_axes
+        parse_mp_axes(c.serve_mp_axes)   # validate at config time
         c.recovery = ((_env_str("HOROVOD_RECOVERY", c.recovery)
                        or "off").strip().lower())
         from .elastic.recovery import RECOVERY_MODES

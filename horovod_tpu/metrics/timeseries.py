@@ -206,11 +206,19 @@ def hist_quantile(windows: List[dict], family: str, q: float) -> float:
     return percentile_from_buckets(merged["le"], merged["buckets"], q)
 
 
-def gauge_last(windows: List[dict], family: str) -> Optional[float]:
+def gauge_last(windows: List[dict], family: str,
+               labels: Optional[Dict[str, str]] = None) -> Optional[float]:
     """The most recent sample of a gauge family (max across series —
-    'worst' for depth/backlog-shaped gauges), or None if unseen."""
+    'worst' for depth/backlog-shaped gauges), or None if unseen.  With
+    ``labels``, only series whose labels include every given pair count
+    (e.g. ``hvd_serve_kv_bytes`` wants kind=allocated, not the max over
+    allocated AND capacity)."""
     for w in reversed(windows):
         series = w.get("gauges", {}).get(family)
+        if labels and series:
+            series = [s for s in series
+                      if all(s.get("labels", {}).get(k) == v
+                             for k, v in labels.items())]
         if series:
             return max(s["value"] for s in series)
     return None
@@ -566,6 +574,17 @@ def merge_job_timeseries(workers: Dict[str, dict],
             depth = gauge_last(wins, "hvd_serve_queue_depth")
             if depth is not None:
                 info["queue_depth"] = depth
+            # paged-KV residency (ISSUE 20): the allocator's live ledger
+            # gauges — bytes actually allocated (kind=allocated, NOT the
+            # capacity series) and blocks in flight (state=allocated)
+            kvb = gauge_last(wins, "hvd_serve_kv_bytes",
+                             labels={"kind": "allocated"})
+            if kvb is not None:
+                info["kv_bytes"] = kvb
+            kvn = gauge_last(wins, "hvd_serve_kv_blocks",
+                             labels={"state": "allocated"})
+            if kvn is not None:
+                info["kv_blocks"] = kvn
         if "straggler" in p:
             info["straggler"] = p["straggler"]
         breaches = (p.get("slo") or {}).get("active") or []

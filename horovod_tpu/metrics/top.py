@@ -56,10 +56,23 @@ def _fmt(v, unit="", nd=2) -> str:
     return f"{v:.{nd}f}{unit}" if isinstance(v, float) else f"{v}{unit}"
 
 
+def _fmt_bytes(v) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    if v >= (1 << 30):
+        return f"{v / (1 << 30):.1f}G"
+    if v >= (1 << 20):
+        return f"{v / (1 << 20):.1f}M"
+    if v >= (1 << 10):
+        return f"{v / (1 << 10):.1f}K"
+    return f"{int(v)}B"
+
+
 def render_job_timeseries(job: dict) -> str:
     """The hvdtop table over a merged ``GET /timeseries/job`` body."""
     cols = ("worker", "win", "cyc/s", "rpc/s", "srv/s", "p99", "queue",
-            "strag", "breach")
+            "kv", "strag", "breach")
     rows = [cols]
     for w in sorted(job.get("workers", {})):
         info = job["workers"][w]
@@ -69,11 +82,12 @@ def render_job_timeseries(job: dict) -> str:
             _fmt(info.get("serve_rate")),
             _fmt(info.get("serve_p99_s"), "s", 3),
             _fmt(info.get("queue_depth"), "", 0),
+            _fmt_bytes(info.get("kv_bytes")),
             _fmt(info.get("straggler"), "", 3),
             ",".join(info.get("breaches", [])) or "-",
         ))
     for w, err in sorted(job.get("unreachable", {}).items()):
-        rows.append((w, "-", "-", "-", "-", "-", "-", "-",
+        rows.append((w, "-", "-", "-", "-", "-", "-", "-", "-",
                      f"unreachable: {err}"))
     widths = [max(len(r[i]) for r in rows) for i in range(len(cols))]
     lines = ["  ".join(c.ljust(widths[i]) for i, c in enumerate(r))

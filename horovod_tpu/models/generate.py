@@ -46,6 +46,37 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,
                    jnp.zeros((), jnp.int32))
 
 
+class PagedKVCache(NamedTuple):
+    """A POOL of fixed-size cache blocks shared by all rows.
+
+    Instead of one dense ``[B, max_len]`` buffer per batch, rows own
+    logical sequences of pool blocks through a per-row block-index
+    table ``[B, M]`` (``serving/paging.py``'s allocator hands the ids
+    out); the device-side table indirection keeps every shape static,
+    so the paged path compiles once per bucket exactly like the dense
+    one.  Row ``b``'s logical position ``p`` lives in physical slot
+    ``tables[b, p // block] * block + p % block``.
+    """
+    k: jnp.ndarray        # [L, n_blocks, block, Hkv, D]
+    v: jnp.ndarray        # [L, n_blocks, block, Hkv, D]
+
+    @property
+    def n_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+
+def init_paged_kv_cache(cfg: LlamaConfig, n_blocks: int, block_size: int,
+                        dtype=None) -> PagedKVCache:
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    return PagedKVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
 def _cached_attention(x, lp, cfg: LlamaConfig, k_cache, v_cache,
                       positions):
     """Attention of x's tokens against the cache prefix + x itself.
@@ -116,8 +147,49 @@ def _write_kv_rows(x, lp, cfg: LlamaConfig, k_cache, v_cache, positions):
     return k_cache, v_cache
 
 
-def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: KVCache,
-                       row_starts=None):
+def _write_kv_paged(x, lp, cfg: LlamaConfig, kc, vc, positions, tables):
+    """Project x to k/v, rope k, scatter through the block-index table.
+
+    ``kc/vc``: [n_blocks, block, Hkv, D] — one layer's slice of the
+    pool; ``positions`` [B, T] absolute; ``tables`` [B, M].  Token
+    ``(b, t)`` lands in flat physical slot
+    ``tables[b, positions[b,t] // block] * block + positions % block``.
+    The scatter is an ``.at[...].set`` — like ``_write_kv_rows``'s
+    one-hot ``where`` it SELECTS values, never blends, so the written
+    bits equal the dense path's.  Rows sharing a prefix block scatter
+    identical values into it (same tokens, same absolute positions,
+    same weights) — the duplicate-index write is value-idempotent.
+    """
+    B, T, _ = x.shape
+    Dh = cfg.head_dim
+    Hkv = cfg.n_kv_heads
+    k = (x @ lp["wk"].astype(x.dtype)).reshape(B, T, Hkv, Dh)
+    v = (x @ lp["wv"].astype(x.dtype)).reshape(B, T, Hkv, Dh)
+    k = _rope(k, positions, cfg.rope_theta)
+    nb, bs = kc.shape[0], kc.shape[1]
+    phys = jnp.take_along_axis(tables, positions // bs, axis=1)  # [B, T]
+    slots = (phys * bs + positions % bs).reshape(-1)
+    kc = kc.reshape(nb * bs, Hkv, Dh).at[slots].set(
+        k.astype(kc.dtype).reshape(-1, Hkv, Dh)).reshape(kc.shape)
+    vc = vc.reshape(nb * bs, Hkv, Dh).at[slots].set(
+        v.astype(vc.dtype).reshape(-1, Hkv, Dh)).reshape(vc.shape)
+    return kc, vc
+
+
+def _gather_block_view(kc, vc, tables):
+    """Each row's logical cache view through its block table:
+    ``[n_blocks, block, Hkv, D]`` + ``[B, M]`` → two
+    ``[B, M*block, Hkv, D]`` arrays where view position ``s`` is the
+    row's absolute position ``s`` — the dense-cache layout
+    ``_cached_attention`` already speaks, materialized by gather."""
+    nb, bs, Hkv, Dh = kc.shape
+    B, M = tables.shape
+    return (kc[tables].reshape(B, M * bs, Hkv, Dh),
+            vc[tables].reshape(B, M * bs, Hkv, Dh))
+
+
+def forward_with_cache(params, tokens, cfg: LlamaConfig, cache,
+                       row_starts=None, block_tables=None):
     """Run ``tokens`` [B, T] through the model, extending ``cache``.
 
     Returns ``(logits [B, T, V], new_cache)``.  Serves both phases:
@@ -133,10 +205,28 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: KVCache,
     batch uses the default path (positions 0..T-1 are correct for every
     row; pad rows write garbage k/v beyond their length, which decode
     overwrites slot by slot and the position mask hides meanwhile).
+
+    ``block_tables`` [B, M] int32 switches the cache to the PAGED
+    layout: ``cache`` must be a :class:`PagedKVCache` pool and every
+    read/write goes through the per-row block-index table instead of a
+    dense ``[batch, bucket_max]`` buffer.  Paged prefill always starts
+    at position 0 (the pool has no scalar length — the allocator owns
+    row lifecycles); paged decode takes ``row_starts`` exactly like the
+    dense ragged path.  The logical view a row attends is
+    ``M * block_size`` slots — parity with the dense path is bitwise at
+    ``max_len == M * block_size`` (extra tail slots are masked to
+    -1e30, whose probs underflow to exact zeros).
     """
     par = ParallelSpec()  # decode path is single-shard per replica
     B, T = tokens.shape
-    start = cache.length
+    paged = block_tables is not None
+    if paged != isinstance(cache, PagedKVCache):
+        raise TypeError(
+            "block_tables and PagedKVCache come together: got "
+            f"tables={'yes' if block_tables is not None else 'no'} with "
+            f"{type(cache).__name__} (dense KVCache takes no table; the "
+            f"paged pool is unusable without one)")
+    start = jnp.zeros((), jnp.int32) if paged else cache.length
     if row_starts is None:
         positions = (jnp.arange(T)[None, :] + start) * jnp.ones_like(tokens)
     else:
@@ -145,6 +235,11 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: KVCache,
                 f"row_starts is decode-only (T == 1), got T={T}: ragged "
                 f"prefill right-pads and uses the default path")
         positions = row_starts[:, None] * jnp.ones_like(tokens)
+    if paged:
+        block_tables = jnp.asarray(block_tables, jnp.int32)
+        if block_tables.shape[0] != B:
+            raise ValueError(
+                f"block_tables rows {block_tables.shape[0]} != batch {B}")
     h = params["embed"].astype(cfg.dtype)[tokens]
 
     layers = jax.tree_util.tree_map(
@@ -154,11 +249,18 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: KVCache,
     def scan_body(h, layer_io):
         lp, kc, vc = layer_io
         attn_in = _rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
-        if row_starts is None:
+        if paged:
+            kc, vc = _write_kv_paged(attn_in, lp, cfg, kc, vc, positions,
+                                     block_tables)
+            k_view, v_view = _gather_block_view(kc, vc, block_tables)
+        elif row_starts is None:
             kc, vc = _write_kv(attn_in, lp, cfg, kc, vc, positions, start)
+            k_view, v_view = kc, vc
         else:
             kc, vc = _write_kv_rows(attn_in, lp, cfg, kc, vc, positions)
-        h = h + _cached_attention(attn_in, lp, cfg, kc, vc, positions)
+            k_view, v_view = kc, vc
+        h = h + _cached_attention(attn_in, lp, cfg, k_view, v_view,
+                                  positions)
         pre = _rmsnorm(h, lp["mlp_norm"], cfg.norm_eps)
         y, _aux = ffn(pre, lp, cfg, par)  # local routing (no ep axis)
         h = h + y
@@ -168,6 +270,8 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: KVCache,
                                  (layers, cache.k, cache.v))
     h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
     logits = h @ params["embed"].T.astype(h.dtype)
+    if paged:
+        return logits, PagedKVCache(k_new, v_new)
     return logits, KVCache(
         k_new, v_new, start + T if row_starts is None else start)
 
@@ -278,3 +382,55 @@ def batched_greedy_decode(params, cfg: LlamaConfig, prompts, lengths,
                             jnp.arange(max_new_tokens - 1))
     return jnp.concatenate(
         [next_tok[:, None], jnp.moveaxis(toks, 0, 1)], axis=1)
+
+
+def paged_greedy_decode(params, cfg: LlamaConfig, prompts, lengths,
+                        block_tables, cache: PagedKVCache,
+                        max_new_tokens: int):
+    """:func:`batched_greedy_decode` over a PAGED cache pool.
+
+    ``block_tables`` [B, M] int32 maps each row's logical block ``j``
+    (positions ``[j*block, (j+1)*block)``) to a pool block id; rows
+    need REAL blocks only up to ``ceil((lengths[b] + max_new_tokens) /
+    block)`` — table entries past that may point at a shared trash
+    block (their logical positions exceed every query position the row
+    ever attends, so the mask hides whatever lands there).  That per-row
+    tail is the memory paging buys: a dense cache pays
+    ``batch x bucket_max`` regardless of actual lengths.
+
+    Returns ``(tokens [B, max_new_tokens], updated pool)`` — the pool
+    threads through so a persistent serving pool accumulates writes
+    across calls.  Correctness floor: every row is bit-identical to
+    sequential :func:`greedy_generate` on that row alone with
+    ``max_len == M * block_size`` (pinned in tests/test_generate.py;
+    equal logical width means equal reduction shapes — the masked tail
+    contributes exact zeros either way).
+    """
+    B, T = prompts.shape
+    M = block_tables.shape[1]
+    bs = cache.block_size
+    if T + max_new_tokens > M * bs:
+        raise ValueError(
+            f"block table covers {M}x{bs}={M * bs} slots < padded "
+            f"prompt {T} + new {max_new_tokens}")
+    if max_new_tokens <= 0:
+        return jnp.zeros((B, 0), jnp.int32), cache
+    lengths = jnp.asarray(lengths, jnp.int32)
+    logits, cache = forward_with_cache(params, prompts, cfg, cache,
+                                       block_tables=block_tables)
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None], axis=1)[:, 0, :]
+    next_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+    def step(carry, i):
+        cache, tok = carry
+        logits, cache = forward_with_cache(
+            params, tok[:, None], cfg, cache, row_starts=lengths + i,
+            block_tables=block_tables)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        return (cache, nxt), nxt
+
+    (cache, _), toks = lax.scan(step, (cache, next_tok),
+                                jnp.arange(max_new_tokens - 1))
+    return jnp.concatenate(
+        [next_tok[:, None], jnp.moveaxis(toks, 0, 1)], axis=1), cache

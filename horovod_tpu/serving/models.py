@@ -47,6 +47,36 @@ def llama_decode_forward(params, cfg, max_new_tokens: int,
     return BucketedForward(fn, buckets)
 
 
+def paged_llama_decode_forward(params, cfg, max_new_tokens: int,
+                               buckets: ShapeBuckets,
+                               block_size: int = 16,
+                               n_blocks=None):
+    """:func:`llama_decode_forward` through the paged KV pool: same
+    signature and per-row bit-parity, but cache bytes are priced per
+    row (``ceil((len+new)/block)`` blocks) instead of bucket-max, with
+    prompt-head blocks shared across requests (serving/paging.py)."""
+    from .paging import PagedDecodeForward
+    return PagedDecodeForward(params, cfg, max_new_tokens, buckets,
+                              block_size=block_size, n_blocks=n_blocks)
+
+
+def mp_llama_decode_forward(params, cfg, max_new_tokens: int,
+                            buckets: ShapeBuckets, mp: int = 2,
+                            axis: str = "hvd_serve_mp", devices=None):
+    """:func:`llama_decode_forward` over a model-parallel mesh slice:
+    params rest sharded ``mp``-ways across local devices and are
+    spec-gathered inside the forward (serving/worker.py
+    MeshSlicedForward) — for models whose replica exceeds one chip."""
+    from .worker import MeshSlicedForward
+    if buckets.max_seq + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"largest seq bucket {buckets.max_seq} + max_new_tokens "
+            f"{max_new_tokens} exceeds the model's max_seq_len "
+            f"{cfg.max_seq_len}")
+    return MeshSlicedForward(params, cfg, max_new_tokens, buckets,
+                             mp=mp, axis=axis, devices=devices)
+
+
 def classifier_forward(forward: Callable, params,
                        buckets: ShapeBuckets,
                        preprocess: Callable = None) -> BucketedForward:

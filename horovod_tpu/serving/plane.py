@@ -104,7 +104,7 @@ class _Lease:
 
 class _WorkerState:
     __slots__ = ("ewma", "observations", "rotated", "rotated_at",
-                 "metrics_port", "last_pull")
+                 "metrics_port", "last_pull", "kv")
 
     def __init__(self):
         self.ewma = 0.0
@@ -113,6 +113,9 @@ class _WorkerState:
         self.rotated_at: Optional[float] = None
         self.metrics_port: Optional[int] = None
         self.last_pull = 0.0
+        # last paged-KV ledger the worker rode along on serve_push
+        # (None for dense-cache workers)
+        self.kv: Optional[dict] = None
 
 
 #: Rotation noise floor (seconds): a worker is never rotated while its
@@ -141,7 +144,7 @@ class ServingPlane:
                  lease_s: Optional[float] = None,
                  straggler_factor: Optional[float] = None):
         cfg = cfg or Config.from_env()
-        from .shapes import parse_buckets
+        from .shapes import parse_buckets, parse_mp_axes
         seq = parse_buckets(seq_buckets or cfg.serve_seq_buckets,
                             "HOROVOD_SERVE_SEQ_BUCKETS")
         cap = int(max_batch if max_batch is not None
@@ -154,7 +157,10 @@ class ServingPlane:
             raise ValueError(
                 f"largest batch bucket {batches[-1]} < batch cap {cap}: "
                 f"the cap must be a servable shape")
-        self.buckets = ShapeBuckets(batches, seq)
+        self.mp_axis, mp_degree = parse_mp_axes(cfg.serve_mp_axes)
+        self.buckets = ShapeBuckets(
+            batches, seq,
+            mp_degrees=(1,) if mp_degree == 1 else (1, mp_degree))
         self.deadline_s = (deadline_ms if deadline_ms is not None
                            else cfg.serve_deadline_ms) / 1000.0
         self.lease_s = float(lease_s if lease_s is not None
@@ -295,13 +301,17 @@ class ServingPlane:
         }
 
     def push(self, worker: str, batch_id: int, outputs: List,
-             service_s: float = 0.0) -> dict:
+             service_s: float = 0.0, kv: Optional[dict] = None) -> dict:
         """Worker batch completion.  A push for an unknown lease (the
         batch was requeued after this worker was declared gone, and a
         sibling already served it) is acknowledged and dropped —
         first completion wins."""
         with self._cv:
             lease = self._leases.pop(int(batch_id), None)
+            if kv is not None and worker in self._workers:
+                # KV ledger ride-along: stored even on a stale push
+                # (the residency snapshot is real either way)
+                self._workers[worker].kv = dict(kv)
         if lease is None:
             return {"ok": True, "stale": True}
         now = time.monotonic()
@@ -510,7 +520,8 @@ class ServingPlane:
                              int(payload["batch_id"]),
                              payload.get("outputs") or [],
                              service_s=float(
-                                 payload.get("service_s", 0.0)))
+                                 payload.get("service_s", 0.0)),
+                             kv=payload.get("kv"))
 
         def serve_result(payload):
             return self.result(str(payload["id"]),
@@ -531,8 +542,16 @@ class ServingPlane:
                 wid: {"ewma_s": round(w.ewma, 6),
                       "observations": w.observations,
                       "rotated": w.rotated,
-                      "rotated_at": w.rotated_at}
+                      "rotated_at": w.rotated_at,
+                      "kv": w.kv}
                 for wid, w in sorted(self._workers.items())}
+            kv_totals = None
+            ledgers = [w.kv for w in self._workers.values() if w.kv]
+            if ledgers:
+                kv_totals = {
+                    k: sum(int(led.get(k, 0)) for led in ledgers)
+                    for k in ("in_use", "cached", "free", "reuse_hits",
+                              "bytes_in_use", "bytes_capacity")}
             return {
                 "queue": q,
                 "completed": self.completed,
@@ -543,9 +562,11 @@ class ServingPlane:
                                           in self._leases.values()}),
                 "rotations": self.rotations,
                 "workers": workers,
+                "kv": kv_totals,
                 "buckets": {
                     "batch": list(self.buckets.batch_buckets),
-                    "seq": list(self.buckets.seq_buckets)},
+                    "seq": list(self.buckets.seq_buckets),
+                    "mp": list(getattr(self.buckets, "mp_degrees", (1,)))},
             }
 
 

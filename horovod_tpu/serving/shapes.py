@@ -50,27 +50,72 @@ def parse_buckets(spec: str, name: str) -> Tuple[int, ...]:
     return tuple(out)
 
 
+def parse_mp_axes(spec: str) -> Tuple[str, int]:
+    """Parse the ``HOROVOD_SERVE_MP_AXES`` grammar: ``""`` (DP-only,
+    returns ``("", 1)``) or ``name:degree`` with degree >= 2 — e.g.
+    ``model:2``.  One axis for now; the grammar leaves room for a
+    comma list when serving grows a second mesh dimension."""
+    spec = (spec or "").strip()
+    if not spec:
+        return "", 1
+    if "," in spec:
+        raise ValueError(
+            f"HOROVOD_SERVE_MP_AXES supports a single axis for now, "
+            f"got {spec!r}")
+    name, sep, degree = spec.partition(":")
+    name = name.strip()
+    if not sep or not name:
+        raise ValueError(
+            f"HOROVOD_SERVE_MP_AXES must be 'name:degree' (e.g. "
+            f"'model:2') or empty, got {spec!r}")
+    try:
+        d = int(degree.strip())
+    except ValueError:
+        raise ValueError(
+            f"HOROVOD_SERVE_MP_AXES degree must be an integer, got "
+            f"{spec!r}") from None
+    if d < 2:
+        raise ValueError(
+            f"HOROVOD_SERVE_MP_AXES degree must be >= 2 (omit the "
+            f"variable for DP-only serving), got {spec!r}")
+    return name, d
+
+
 @dataclasses.dataclass(frozen=True)
 class ShapeBucket:
-    """One compiled shape: ``batch`` padded rows of ``seq`` tokens."""
+    """One compiled shape: ``batch`` padded rows of ``seq`` tokens,
+    served over an ``mp``-way model-parallel mesh slice (``mp=1`` is
+    the single-chip/DP-only case — the default everywhere)."""
     batch: int
     seq: int
+    mp: int = 1
 
     @property
     def key(self) -> str:
-        """Bounded metric-label form (``b4xs64``)."""
-        return f"b{self.batch}xs{self.seq}"
+        """Bounded metric-label form (``b4xs64``; ``b4xs64xm2`` when
+        model-parallel — the unsliced form stays byte-stable so
+        existing dashboards keep their labels)."""
+        base = f"b{self.batch}xs{self.seq}"
+        return base if self.mp == 1 else f"{base}xm{self.mp}"
 
 
 class ShapeBuckets:
-    """The admitted shape set: ``batch_buckets`` x ``seq_buckets``."""
+    """The admitted shape set: ``batch_buckets`` x ``seq_buckets``,
+    optionally x ``mp_degrees`` — the mesh dimension of the bucket
+    table.  The mesh degree is a COMPILE-TIME shape exactly like batch
+    and seq: a pmap over a different device count is a different
+    executable, so admitting it must be as deliberate as admitting a
+    new sequence bucket (``HOROVOD_SERVE_MP_AXES`` — docs/env.md)."""
 
     def __init__(self, batch_buckets: Sequence[int] = (1, 2, 4, 8),
-                 seq_buckets: Sequence[int] = (32, 64, 128)):
+                 seq_buckets: Sequence[int] = (32, 64, 128),
+                 mp_degrees: Sequence[int] = (1,)):
         self.batch_buckets = parse_buckets(
             ",".join(str(b) for b in batch_buckets), "batch buckets")
         self.seq_buckets = parse_buckets(
             ",".join(str(s) for s in seq_buckets), "seq buckets")
+        self.mp_degrees = parse_buckets(
+            ",".join(str(m) for m in mp_degrees), "mp degrees")
 
     @property
     def max_batch(self) -> int:
@@ -80,8 +125,13 @@ class ShapeBuckets:
     def max_seq(self) -> int:
         return self.seq_buckets[-1]
 
+    @property
+    def max_mp(self) -> int:
+        return self.mp_degrees[-1]
+
     def __len__(self) -> int:
-        return len(self.batch_buckets) * len(self.seq_buckets)
+        return (len(self.batch_buckets) * len(self.seq_buckets)
+                * len(self.mp_degrees))
 
     def seq_bucket(self, seq_len: int) -> int:
         """Smallest seq bucket holding ``seq_len`` tokens.  Raises on
@@ -106,9 +156,14 @@ class ShapeBuckets:
             f"batch of {n_rows} exceeds the largest batch bucket "
             f"{self.batch_buckets[-1]} (admission cap bug)")
 
-    def bucket(self, n_rows: int, seq_len: int) -> ShapeBucket:
+    def bucket(self, n_rows: int, seq_len: int,
+               mp: int = 1) -> ShapeBucket:
+        if mp not in self.mp_degrees:
+            raise ValueError(
+                f"mp degree {mp} not in the admitted mesh dimension "
+                f"{self.mp_degrees}; widen HOROVOD_SERVE_MP_AXES")
         return ShapeBucket(self.batch_bucket(n_rows),
-                           self.seq_bucket(seq_len))
+                           self.seq_bucket(seq_len), mp)
 
     def pad_batch(self, rows: Sequence[np.ndarray], seq: int,
                   pad_id: int = 0) -> Tuple[np.ndarray, np.ndarray]:

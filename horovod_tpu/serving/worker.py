@@ -62,9 +62,14 @@ class BucketedForward:
     ``hvd_serve_recompiles_total``, the gated steady-state invariant.
     """
 
-    def __init__(self, fn: Callable, buckets=None):
+    def __init__(self, fn: Callable, buckets=None, donate_argnums=(),
+                 compiled: bool = False):
         import jax
-        self._jit = jax.jit(fn)
+        # compiled=True: fn is already a staged callable (the
+        # mesh-sliced subclass hands in a pmap — jit-of-pmap would
+        # add a dispatch layer and hide the pmap's cache)
+        self._jit = (fn if compiled
+                     else jax.jit(fn, donate_argnums=tuple(donate_argnums)))
         self._buckets = buckets
         self._seen: set = set()
         self.calls = 0
@@ -78,22 +83,23 @@ class BucketedForward:
         except Exception:  # noqa: BLE001 - jax-version dependent
             return None
 
-    def __call__(self, tokens: np.ndarray, lengths: np.ndarray):
-        import jax.numpy as jnp
-        shape = tuple(tokens.shape)
-        if self._buckets is not None:
-            b, s = shape
-            if (b not in self._buckets.batch_buckets
-                    or s not in self._buckets.seq_buckets):
-                raise ValueError(
-                    f"forward called outside the shape buckets: {shape} "
-                    f"not in {self._buckets.batch_buckets} x "
-                    f"{self._buckets.seq_buckets} (every recompile is a "
-                    f"p99 outlier)")
+    def _check_bucket(self, shape):
+        if self._buckets is None:
+            return
+        b, s = shape
+        if (b not in self._buckets.batch_buckets
+                or s not in self._buckets.seq_buckets):
+            raise ValueError(
+                f"forward called outside the shape buckets: {shape} "
+                f"not in {self._buckets.batch_buckets} x "
+                f"{self._buckets.seq_buckets} (every recompile is a "
+                f"p99 outlier)")
+
+    def _run(self, shape, *jit_args):
+        """The jit call wrapped in compile bookkeeping (shared by the
+        paged and mesh-sliced subclasses, whose signatures differ)."""
         before = self._cache_size()
-        out = self._jit(jnp.asarray(tokens, jnp.int32),
-                        jnp.asarray(lengths, jnp.int32))
-        out = np.asarray(out)
+        out = self._jit(*jit_args)
         after = self._cache_size()
         self.calls += 1
         if after is None:
@@ -102,8 +108,6 @@ class BucketedForward:
             compiled = shape not in self._seen
         else:
             compiled = after > (before or 0)
-            if _metrics.ACTIVE:
-                _m_cache_size.set(after)
         if compiled:
             self.compiles += 1
             if shape in self._seen:
@@ -113,7 +117,21 @@ class BucketedForward:
                 logger.warning("serving: recompiled already-seen shape "
                                "%s", shape)
         self._seen.add(shape)
+        if _metrics.ACTIVE:
+            # distinct-shapes fallback when introspection is absent:
+            # the gauge must move on EVERY jax, or the zero-recompile
+            # gate goes blind exactly where it cannot introspect
+            _m_cache_size.set(after if after is not None
+                              else len(self._seen))
         return out
+
+    def __call__(self, tokens: np.ndarray, lengths: np.ndarray):
+        import jax.numpy as jnp
+        shape = tuple(tokens.shape)
+        self._check_bucket(shape)
+        return np.asarray(self._run(shape,
+                                    jnp.asarray(tokens, jnp.int32),
+                                    jnp.asarray(lengths, jnp.int32)))
 
     def warmup(self) -> int:
         """Compile every admitted shape bucket up front (the deploy-time
@@ -135,6 +153,117 @@ class BucketedForward:
         return {"calls": self.calls, "compiles": self.compiles,
                 "recompiles": self.recompiles,
                 "shapes_seen": len(self._seen)}
+
+
+class MeshSlicedForward(BucketedForward):
+    """Llama decode over a model-parallel mesh slice: params that don't
+    fit one chip live SHARDED across ``mp`` local devices.
+
+    Storage is the point: each device holds ``1/mp`` of every
+    mp-divisible parameter (``fsdp_param_specs`` picks the axis — the
+    same planner training's FSDP path uses, so serving and training
+    agree on what "a shard" is) and only the small norms replicated.
+    The forward is a ``pmap`` over the model axis that
+    ``spec_all_gather``s the shards leaf-by-leaf and runs the standard
+    batched decode on the gathered weights — the fused
+    computation-collective shape from PR 14, applied to serving.  The
+    collective schedule of this step is pinned by the
+    ``serve_mp_forward_step`` hvdsched entry: ONLY the spec gather hops
+    may appear (a gradient collective is an HVD211 failure — the
+    ``serve_forward_step`` empty-schedule pin, generalized).
+
+    Gather-per-call trades bandwidth for HBM: transient full weights
+    during the forward, ``1/mp`` at rest — the resident footprint is
+    what caps how many models a serving chip can hold, and
+    ``per_chip_param_nbytes`` prices it exactly (gated against the live
+    buffers by ``tools/bench_serve.py --mp``).
+    """
+
+    def __init__(self, params, cfg, max_new_tokens: int, buckets,
+                 mp: int = 2, axis: str = "hvd_serve_mp", devices=None):
+        import jax
+        import jax.numpy as jnp
+        from ..models.generate import batched_greedy_decode
+        from ..training import fsdp_param_specs, spec_all_gather
+        if mp < 2:
+            raise ValueError(f"mp must be >= 2 (use BucketedForward for "
+                             f"single-chip serving), got {mp}")
+        devices = list(devices if devices is not None
+                       else jax.local_devices())
+        if len(devices) < mp:
+            raise ValueError(f"mp={mp} needs {mp} local devices, have "
+                             f"{len(devices)}")
+        devices = devices[:mp]
+        self.mp = mp
+        self.axis = axis
+        shapes = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), params)
+        specs = fsdp_param_specs(shapes, mp, axis=axis)
+
+        def _sharded_dim(spec):
+            for dim, entry in enumerate(spec):
+                axes = entry if isinstance(entry, tuple) else (entry,)
+                if axis in axes:
+                    return dim
+            return None
+
+        from jax.sharding import PartitionSpec as _P
+        _is_spec = lambda x: isinstance(x, _P)  # noqa: E731
+
+        def shard_for(d):
+            def pick(spec, leaf):
+                dim = _sharded_dim(spec)
+                leaf = np.asarray(leaf)
+                if dim is None:
+                    return leaf           # replicated (norms)
+                size = leaf.shape[dim] // mp
+                idx = [slice(None)] * leaf.ndim
+                idx[dim] = slice(d * size, (d + 1) * size)
+                return leaf[tuple(idx)]
+            return jax.tree_util.tree_map(pick, specs, params,
+                                          is_leaf=_is_spec)
+
+        # per-chip residency, priced exactly from the specs (replicated
+        # leaves count whole, sharded leaves 1/mp — the ZeRO fractional
+        # accounting precedent, applied to serving weights)
+        spec_leaves = jax.tree_util.tree_leaves(specs, is_leaf=_is_spec)
+        param_leaves = jax.tree_util.tree_leaves(params)
+        self.replica_param_nbytes = 0
+        self.per_chip_param_nbytes = 0
+        for spec, leaf in zip(spec_leaves, param_leaves):
+            n = int(np.asarray(leaf).nbytes)
+            self.replica_param_nbytes += n
+            self.per_chip_param_nbytes += (n if _sharded_dim(spec) is None
+                                           else n // mp)
+        self._shards = jax.device_put_sharded(
+            [shard_for(d) for d in range(mp)], devices)
+
+        def fn(shards, tokens, lengths):
+            full = spec_all_gather(shards, specs, axis)
+            return batched_greedy_decode(full, cfg, tokens, lengths,
+                                         max_new_tokens)
+
+        super().__init__(
+            jax.pmap(fn, axis_name=axis, in_axes=(0, None, None),
+                     devices=devices),
+            buckets, compiled=True)
+
+    def __call__(self, tokens: np.ndarray, lengths: np.ndarray):
+        import jax.numpy as jnp
+        shape = tuple(tokens.shape)
+        self._check_bucket(shape)
+        out = self._run(shape, self._shards,
+                        jnp.asarray(tokens, jnp.int32),
+                        jnp.asarray(lengths, jnp.int32))
+        # every mesh slice computes the same replicated output
+        return np.asarray(out[0])
+
+    def stats(self) -> Dict[str, int]:
+        out = super().stats()
+        out["mp"] = self.mp
+        out["per_chip_param_nbytes"] = self.per_chip_param_nbytes
+        out["replica_param_nbytes"] = self.replica_param_nbytes
+        return out
 
 
 class ServingWorker:
@@ -238,7 +367,11 @@ class ServingWorker:
             # straggler score, exactly like a real one
             _chaos.fire("serve.batch", worker=self.worker_id,
                         batch=batch["batch_id"], rows=n_rows)
-        out = self.forward(tokens, lengths)
+        if getattr(self.forward, "wants_rows", False):
+            # paged forward: pad rows must not allocate KV blocks
+            out = self.forward(tokens, lengths, n_rows=n_rows)
+        else:
+            out = self.forward(tokens, lengths)
         service = time.monotonic() - t0
         self.batches += 1
         self.rows += n_rows
@@ -247,13 +380,19 @@ class ServingWorker:
             for age in batch["age_s"][:n_rows]:
                 _m_latency.observe(float(age) + service)
         outputs = np.asarray(out)[:n_rows].tolist()
+        push = {"worker": self.worker_id,
+                "batch_id": batch["batch_id"],
+                "outputs": outputs,
+                "service_s": round(service, 6)}
+        kv = getattr(self.forward, "kv_summary", None)
+        if callable(kv):
+            # paged-KV ledger rides the push: the plane's
+            # GET /serve/stats shows per-worker block residency without
+            # a second scrape path
+            push["kv"] = kv()
         try:
             json_request(
-                self.addr, self.port, "serve_push",
-                {"worker": self.worker_id,
-                 "batch_id": batch["batch_id"],
-                 "outputs": outputs,
-                 "service_s": round(service, 6)},
+                self.addr, self.port, "serve_push", push,
                 timeout=10.0, secret=self._secret, idempotent=False)
         except Exception:  # noqa: BLE001 - lease reaper covers the loss
             logger.warning("serve_push failed; plane will requeue the "

@@ -179,3 +179,85 @@ def test_row_starts_is_decode_only(hvd):
         assert "decode-only" in str(e)
     else:
         raise AssertionError("expected ValueError")
+
+
+def test_paged_decode_bit_identical_per_row(hvd):
+    """ISSUE 20 correctness floor: paged_greedy_decode through a block
+    pool — ragged lengths, block tables with trash-block tails — must
+    be BIT-identical per row to sequential greedy_generate on that row
+    alone at max_len == M * block_size (equal logical width, equal
+    reduction shapes)."""
+    params = _params()
+    rng = np.random.RandomState(11)
+    lens = [3, 5, 9, 16]
+    T, n_new, bs = max(lens), 8, 4
+    M = -(-(T + n_new) // bs)            # 6 blocks x 4 = 24 slots
+    prompts = np.zeros((len(lens), T), np.int32)
+    rows = []
+    for b, L in enumerate(lens):
+        row = rng.randint(0, 64, (L,)).astype(np.int32)
+        rows.append(row)
+        prompts[b, :L] = row
+    # private tables: row b's real blocks, then the trash block (0)
+    pool = generate.init_paged_kv_cache(CFG, 1 + len(lens) * M, bs)
+    tables = np.zeros((len(lens), M), np.int32)
+    for b, L in enumerate(lens):
+        need = -(-(L + n_new) // bs)
+        tables[b, :need] = 1 + b * M + np.arange(need)
+
+    out, pool = jax.jit(
+        lambda p, t, n, tb, k, v: generate.paged_greedy_decode(
+            p, CFG, t, n, tb, generate.PagedKVCache(k, v), n_new))(
+        params, jnp.asarray(prompts), jnp.asarray(lens, jnp.int32),
+        jnp.asarray(tables), pool.k, pool.v)
+    out = np.asarray(out)
+    for b, row in enumerate(rows):
+        seq = np.asarray(generate.greedy_generate(
+            params, CFG, jnp.asarray(row[None, :]), n_new,
+            max_len=M * bs))
+        np.testing.assert_array_equal(out[b], seq[0])
+
+
+def test_paged_shared_prefix_blocks_and_trash_isolation(hvd):
+    """Two rows with the same prompt HEAD may share physical prefix
+    blocks (full prompt-covered blocks only): outputs must equal the
+    fully-private run bit-for-bit — the duplicate prefill writes are
+    value-identical, and decode never writes a shared block.  Garbage
+    pre-seeded in the trash block must not perturb any row."""
+    params = _params()
+    rng = np.random.RandomState(13)
+    head = rng.randint(0, 64, (8,)).astype(np.int32)   # 2 full blocks
+    tails = [rng.randint(0, 64, (n,)).astype(np.int32) for n in (3, 6)]
+    lens = [8 + t.size for t in tails]
+    T, n_new, bs = max(lens), 6, 4
+    M = -(-(T + n_new) // bs)
+    prompts = np.zeros((2, T), np.int32)
+    for b, t in enumerate(tails):
+        prompts[b] = np.concatenate([head, t, np.zeros(T - lens[b],
+                                                       np.int32)])
+
+    def run(shared):
+        pool = generate.init_paged_kv_cache(CFG, 1 + 2 * M, bs)
+        # non-zero garbage in the trash block: masked reads must not
+        # let it reach any logit
+        k = pool.k.at[:, 0].set(7.0)
+        v = pool.v.at[:, 0].set(-7.0)
+        tables = np.zeros((2, M), np.int32)
+        nxt = 1
+        for b, L in enumerate(lens):
+            need = -(-(L + n_new) // bs)
+            for j in range(need):
+                if shared and j < 2 and b > 0:
+                    tables[b, j] = tables[0, j]   # share the head
+                else:
+                    tables[b, j] = nxt
+                    nxt += 1
+        out, _ = jax.jit(
+            lambda p, t, n, tb, kk, vv: generate.paged_greedy_decode(
+                p, CFG, t, n, tb, generate.PagedKVCache(kk, vv),
+                n_new))(
+            params, jnp.asarray(prompts), jnp.asarray(lens, jnp.int32),
+            jnp.asarray(tables), k, v)
+        return np.asarray(out)
+
+    np.testing.assert_array_equal(run(shared=True), run(shared=False))

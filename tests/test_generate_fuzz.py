@@ -56,3 +56,41 @@ def test_fuzz_cached_forward_matches_full(hvd, seed):
         np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
                                    np.asarray(full_logits[:, t]), atol=3e-4)
     assert int(cache.length) == T
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_paged_decode_matches_sequential(hvd, seed):
+    """ISSUE 20: at random geometry, random ragged lengths and a random
+    block size, paged decode through a pool must match sequential
+    greedy_generate BIT-for-bit per row (max_len == M * block_size on
+    both sides — the parity precondition)."""
+    rng = np.random.RandomState(100 + seed)
+    cfg = _draw_cfg(rng)
+    params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    B = int(rng.randint(2, 5))
+    bs = int(rng.choice([2, 4]))
+    n_new = int(rng.randint(2, 6))
+    lens = [int(rng.randint(1, 13)) for _ in range(B)]
+    T = max(lens)
+    M = -(-(T + n_new) // bs)
+    prompts = np.zeros((B, T), np.int32)
+    rows = []
+    for b, L in enumerate(lens):
+        row = rng.randint(0, cfg.vocab_size, (L,)).astype(np.int32)
+        rows.append(row)
+        prompts[b, :L] = row
+    pool = generate.init_paged_kv_cache(cfg, 1 + B * M, bs)
+    tables = np.zeros((B, M), np.int32)
+    for b, L in enumerate(lens):
+        need = -(-(L + n_new) // bs)
+        tables[b, :need] = 1 + b * M + np.arange(need)
+
+    out, _ = generate.paged_greedy_decode(
+        params, cfg, jnp.asarray(prompts), jnp.asarray(lens, jnp.int32),
+        jnp.asarray(tables), pool, n_new)
+    out = np.asarray(out)
+    for b, row in enumerate(rows):
+        seq = np.asarray(generate.greedy_generate(
+            params, cfg, jnp.asarray(row[None, :]), n_new,
+            max_len=M * bs))
+        np.testing.assert_array_equal(out[b], seq[0])

@@ -347,6 +347,17 @@ def test_llama3_8b_aot_rehearsal_subprocess():
     # reading it must eventually replace
     assert spec["per_chip_gib"] <= \
         r["per_chip_gib"]["opt_moments_bf16_zero1"] * 1.25 + 0.01
+    # ISSUE 20: serving-side KV residency beside the training state —
+    # paged bytes are exact block arithmetic: strictly under dense at
+    # short true lengths, and exactly dense at bucket-max (16 divides
+    # both the bucket and max_new, so there is no rounding slack)
+    skv = r["serving_kv"]
+    assert skv["dense_gib"] > 1.0       # bucket-max is real HBM at 8B
+    fr = skv["paged_fraction_at_len"]
+    assert fr["1024"] < 0.5
+    assert fr[str(r["seq"])] == 1.0
+    assert all(fr[a] <= fr[b] for a, b in zip(sorted(fr, key=int),
+                                              sorted(fr, key=int)[1:]))
 
 
 def test_bench_llama8b_dp_mode_forced_measurement():

@@ -675,3 +675,74 @@ def test_two_process_scrape_and_merge():
             v for rank in per_worker
             for n, _, v in per_worker[rank][fam]["samples"]
             if n.endswith("_count"))
+
+
+# --- ISSUE 20: paged-KV families in the job merge ----------------------------
+
+def test_job_merge_serve_kv_families_pick_labeled_series():
+    """The job view's per-worker summaries read the paged-KV ledger
+    gauges BY LABEL: ``kv_bytes`` is the kind=allocated series (never
+    the kind=capacity max), ``kv_blocks`` the state=allocated series
+    (never cached/free) — and a worker without a paged forward simply
+    has no kv fields, not zeros."""
+    from horovod_tpu.metrics import timeseries
+    from horovod_tpu.metrics.registry import MetricRegistry
+
+    reg = MetricRegistry()
+    ring = timeseries.TimeSeriesRing(window=4, every_s=1.0, registry=reg)
+    gb = reg.gauge("hvd_serve_kv_bytes", labels=("kind",))
+    gn = reg.gauge("hvd_serve_kv_blocks", labels=("state",))
+    gb.set(4096.0, kind="allocated")
+    gb.set(65536.0, kind="capacity")       # bigger — must NOT win
+    gn.set(2.0, state="allocated")
+    gn.set(7.0, state="cached")            # bigger — must NOT win
+    gn.set(9.0, state="free")
+    reg.counter("hvd_serve_kv_reuse_total").inc(3)
+    ring.sample()
+
+    quiet = MetricRegistry()
+    qring = timeseries.TimeSeriesRing(window=4, every_s=1.0,
+                                      registry=quiet)
+    quiet.counter("hvd_engine_cycles_total").inc(1)
+    qring.sample()
+
+    job = timeseries.merge_job_timeseries(
+        {"0": {"enabled": True, "windows": ring.windows()},
+         "1": {"enabled": True, "windows": qring.windows()}}, {})
+    assert job["workers"]["0"]["kv_bytes"] == 4096.0
+    assert job["workers"]["0"]["kv_blocks"] == 2.0
+    assert "kv_bytes" not in job["workers"]["1"]
+    assert "kv_blocks" not in job["workers"]["1"]
+
+    # hvdtop renders the kv column: 4096 B formats as 4.0K, and the
+    # kv-less worker shows the dash
+    from horovod_tpu.metrics.top import render_job_timeseries
+    table = render_job_timeseries(job)
+    header, w0, w1 = table.splitlines()[:3]
+    cols = header.split()
+    assert "kv" in cols
+    assert w0.split()[cols.index("kv")] == "4.0K"
+    assert w1.split()[cols.index("kv")] == "-"
+
+
+def test_gauge_last_label_filter():
+    """`gauge_last(labels=...)` matches a SUBSET of each series' labels
+    and still takes the freshest window; no match → None (not 0)."""
+    from horovod_tpu.metrics import timeseries
+    from horovod_tpu.metrics.registry import MetricRegistry
+
+    reg = MetricRegistry()
+    ring = timeseries.TimeSeriesRing(window=4, every_s=1.0, registry=reg)
+    g = reg.gauge("hvd_serve_kv_bytes", labels=("kind",))
+    g.set(10.0, kind="allocated")
+    ring.sample()
+    g.set(30.0, kind="allocated")
+    g.set(99.0, kind="capacity")
+    ring.sample()
+    wins = ring.windows()
+    assert timeseries.gauge_last(
+        wins, "hvd_serve_kv_bytes", labels={"kind": "allocated"}) == 30.0
+    assert timeseries.gauge_last(
+        wins, "hvd_serve_kv_bytes", labels={"kind": "nope"}) is None
+    # unlabeled call keeps the old worst-across-series contract
+    assert timeseries.gauge_last(wins, "hvd_serve_kv_bytes") == 99.0

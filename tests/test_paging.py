@@ -1,0 +1,277 @@
+"""Paged KV serving memory (ISSUE 20): the host-side block allocator —
+refcounts, chained-digest prefix cache, COW divergence, LRU eviction,
+admission-time exhaustion with atomic rollback — and the
+PagedDecodeForward / MeshSlicedForward serving adapters: bit-parity
+with the dense bucketed decode, exact byte ledgers, pad rows never
+allocating, and the KV summary riding ``serve_push`` onto the plane's
+``GET /serve/stats``."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from horovod_tpu.models import llama
+from horovod_tpu.serving.paging import (BlockAllocator, BlocksExhausted,
+                                        dense_kv_nbytes, kv_block_nbytes,
+                                        row_blocks)
+from horovod_tpu.serving.shapes import ShapeBuckets
+
+CFG = llama.tiny(vocab=64, seq=64)
+
+
+def _params():
+    return llama.init_params(CFG, jax.random.PRNGKey(3))
+
+
+def _toks(seed, n):
+    return np.random.RandomState(seed).randint(0, 64, (n,)).astype(
+        np.int32)
+
+
+# -- allocator ----------------------------------------------------------------
+
+def test_row_blocks_and_byte_helpers_exact():
+    assert row_blocks(5, 4, 4) == 3          # ceil(9/4)
+    assert row_blocks(8, 4, 4) == 3          # ceil(12/4)
+    assert row_blocks(1, 1, 16) == 1
+    blk = kv_block_nbytes(CFG, 4)
+    # 2 (k+v) x layers x block x kv_heads x head_dim x itemsize
+    assert blk == 2 * CFG.n_layers * 4 * CFG.n_kv_heads * CFG.head_dim * 4
+    dense = dense_kv_nbytes(CFG, 3, 20)
+    assert dense == 2 * CFG.n_layers * 3 * 20 * CFG.n_kv_heads \
+        * CFG.head_dim * 4
+    # a fully-occupied paged batch prices exactly the dense buffer
+    assert 3 * row_blocks(16, 4, 4) * blk == dense
+
+
+def test_allocator_alloc_release_and_reuse_across_requests():
+    """Full prompt-head blocks are content-addressed: an identical
+    prompt AFTER the first request completed reuses the SAME physical
+    blocks (cached, not freed); a different prompt allocates fresh."""
+    a = BlockAllocator(n_blocks=12, block_size=4, block_nbytes=10)
+    toks = _toks(0, 10)                       # 2 full blocks + tail
+    h1 = a.assign(toks, row_blocks(10, 4, 4))  # 4 blocks
+    assert len(h1.blocks) == 4 and h1.shared == 0
+    assert 0 not in h1.blocks                 # trash never granted
+    st = a.stats()
+    assert st["in_use"] == 4 and st["fresh"] == 4
+    assert st["bytes_in_use"] == 40
+    a.release(h1)
+    st = a.stats()
+    # the 2 digest'd prompt blocks stay cached; private tail blocks free
+    assert st["in_use"] == 0 and st["cached"] == 2
+    assert st["free"] == a.capacity - 2
+
+    h2 = a.assign(toks, 4)                    # same prompt again
+    assert h2.shared == 2
+    assert h2.blocks[:2] == h1.blocks[:2]     # SAME physical blocks
+    assert a.stats()["reuse_hits"] == 2
+    a.release(h2)
+
+    h3 = a.assign(_toks(1, 10), 4)            # different prompt
+    assert h3.shared == 0
+    a.release(h3)
+
+
+def test_allocator_cow_divergence_shares_head_only():
+    """Two prompts sharing one full block then diverging: the second
+    assign shares block 0 and gets a FRESH private block at the first
+    divergent position (refcounted, so neither release corrupts the
+    other)."""
+    a = BlockAllocator(n_blocks=12, block_size=4)
+    head = _toks(2, 4)
+    p1 = np.concatenate([head, _toks(3, 4)])
+    p2 = np.concatenate([head, _toks(4, 4)])  # diverges at block 1
+    h1 = a.assign(p1, 3)
+    h2 = a.assign(p2, 3)
+    assert h2.shared == 1
+    assert h2.blocks[0] == h1.blocks[0]       # shared head
+    assert h2.blocks[1] != h1.blocks[1]       # COW: private divergence
+    a.release(h1)
+    # h1's release must NOT free the still-referenced shared block
+    assert a.stats()["in_use"] == 3           # h2's three blocks
+    h3 = a.assign(p1, 3)                      # p1 again: head + cached
+    assert h3.shared == 2                     # both of p1's full blocks
+    a.release(h2)
+    a.release(h3)
+
+
+def test_allocator_exhaustion_rejects_and_rolls_back_atomically():
+    """A grant the pool cannot cover raises BlocksExhausted and returns
+    every block taken so far — allocator state is EXACTLY as before
+    (admission rejects; a later smaller request still succeeds)."""
+    a = BlockAllocator(n_blocks=5, block_size=4)   # 4 grantable
+    assert a.can_admit(4) and not a.can_admit(5)
+    before = a.stats()
+    with pytest.raises(BlocksExhausted):
+        a.assign(_toks(5, 20), 6)
+    after = a.stats()
+    assert after["in_use"] == before["in_use"] == 0
+    assert after["free"] == before["free"] == 4
+    # the failed grant's blocks were never prefilled: none of their
+    # digests may survive in the prefix cache, and the fresh counter
+    # only counts delivered grants
+    assert after["cached"] == 0 and after["fresh"] == 0
+    retry = a.assign(_toks(5, 20)[:16], 4)    # same head, feasible now
+    assert retry.shared == 0                  # nothing garbage-cached
+    a.release(retry)
+    h = a.assign(_toks(6, 4), 4)              # pool still fully usable
+    assert len(h.blocks) == 4
+    with pytest.raises(BlocksExhausted):
+        a.assign(_toks(7, 4), 1)              # all live now
+    a.release(h)
+
+
+def test_allocator_lru_eviction_under_pressure():
+    """Zero-ref cached prefix blocks are the eviction pool: allocation
+    pressure evicts LEAST-recently-released digests first, and an
+    evicted digest no longer hits the cache."""
+    a = BlockAllocator(n_blocks=5, block_size=4)   # 4 grantable
+    pa, pb = _toks(8, 4), _toks(9, 4)
+    ha = a.assign(pa, 1)
+    hb = a.assign(pb, 1)
+    a.release(ha)                              # cached: a (older)
+    a.release(hb)                              # cached: a, b
+    assert a.stats()["cached"] == 2 and a.stats()["free"] == 2
+    # demand 4 blocks: 2 free + both cached evicted (a first)
+    h = a.assign(_toks(10, 20), 4)
+    assert a.stats()["evictions"] == 2
+    a.release(h)
+    hb2 = a.assign(pb, 1)
+    assert hb2.shared == 0                     # b's digest was evicted
+    a.release(hb2)
+
+
+# -- PagedDecodeForward -------------------------------------------------------
+
+def test_paged_forward_parity_ledger_and_pad_rows(hvd):
+    """The paged serving adapter matches the dense one bit-for-bit on a
+    ragged batch (bs=4, new=4 → equal logical width), pad rows point at
+    trash and allocate nothing, and the ledger prices the batch at the
+    exact per-row block count — strictly under the dense equivalent."""
+    from horovod_tpu.serving.models import (llama_decode_forward,
+                                            paged_llama_decode_forward)
+    params = _params()
+    b = ShapeBuckets(batch_buckets=(1, 2, 4), seq_buckets=(8, 16))
+    dense = llama_decode_forward(params, CFG, 4, b)
+    paged = paged_llama_decode_forward(params, CFG, 4, b, block_size=4)
+    assert paged.wants_rows
+
+    rng = np.random.RandomState(21)
+    lens = [3, 7, 11]                          # 3 real rows + 1 pad
+    tokens = np.zeros((4, 16), np.int32)
+    for i, L in enumerate(lens):
+        tokens[i, :L] = rng.randint(0, 64, (L,))
+    lengths = np.asarray(lens + [1], np.int32)
+
+    out_d = dense(tokens, lengths)
+    out_p = paged(tokens, lengths, n_rows=3)
+    np.testing.assert_array_equal(np.asarray(out_d)[:3],
+                                  np.asarray(out_p)[:3])
+
+    last = paged.stats()["kv"]["last"]
+    exp_blocks = sum(row_blocks(L, 4, 4) for L in lens)
+    assert last["rows"] == 3 and last["blocks"] == exp_blocks
+    blk = paged.allocator.block_nbytes
+    assert last["bytes_in_use"] == exp_blocks * blk
+    assert last["bytes_in_use"] < dense_kv_nbytes(CFG, 4, 16 + 4)
+    # completed batch released every ref; prompt heads stay cached
+    st = paged.allocator.stats()
+    assert st["in_use"] == 0
+    assert st["cached"] == sum(L // 4 for L in lens)
+
+    # identical prompts next batch: the heads come from the cache
+    paged(tokens, lengths, n_rows=3)
+    assert paged.allocator.reuse_hits == sum(L // 4 for L in lens)
+
+
+def test_paged_forward_sizing_guard_rejects_undersized_pool(hvd):
+    """A pool that cannot cover the worst admitted batch is a
+    constructor error (exhaustion must be an admission-time event,
+    never a dispatched batch's)."""
+    from horovod_tpu.serving.models import paged_llama_decode_forward
+    params = _params()
+    b = ShapeBuckets(batch_buckets=(1, 2), seq_buckets=(8, 16))
+    worst = 2 * row_blocks(16, 4, 4)
+    with pytest.raises(ValueError, match="worst admitted batch"):
+        paged_llama_decode_forward(params, CFG, 4, b, block_size=4,
+                                   n_blocks=worst)      # missing trash
+    fwd = paged_llama_decode_forward(params, CFG, 4, b, block_size=4,
+                                     n_blocks=1 + worst)
+    assert fwd.allocator.capacity == worst
+
+
+# -- MeshSlicedForward --------------------------------------------------------
+
+def test_mp_forward_parity_and_per_chip_bytes(hvd):
+    """Model-parallel serving (conftest's 8 virtual devices): params
+    sharded 2-ways and spec-gathered inside the forward must match the
+    single-chip dense decode bit-for-bit, and the per-chip param bytes
+    are exactly the sharded-leaf halves plus replicated leaves."""
+    from horovod_tpu.serving.models import (llama_decode_forward,
+                                            mp_llama_decode_forward)
+    from horovod_tpu.training import fsdp_param_specs
+    params = _params()
+    b = ShapeBuckets(batch_buckets=(1, 2), seq_buckets=(8,))
+    dense = llama_decode_forward(params, CFG, 4, b)
+    mp = mp_llama_decode_forward(params, CFG, 4, b, mp=2)
+
+    rng = np.random.RandomState(31)
+    tokens = np.zeros((2, 8), np.int32)
+    lens = [5, 8]
+    for i, L in enumerate(lens):
+        tokens[i, :L] = rng.randint(0, 64, (L,))
+    lengths = np.asarray(lens, np.int32)
+    np.testing.assert_array_equal(np.asarray(dense(tokens, lengths)),
+                                  np.asarray(mp(tokens, lengths)))
+
+    st = mp.stats()
+    shapes = jax.eval_shape(lambda: params)
+    specs = fsdp_param_specs(shapes, 2, axis="hvd_serve_mp")
+    exp = 0
+    for sh, spec in zip(jax.tree_util.tree_leaves(shapes),
+                        jax.tree_util.tree_leaves(
+                            specs, is_leaf=lambda x: hasattr(x, "index"))):
+        n = sh.size * sh.dtype.itemsize
+        exp += n // 2 if any(ax is not None for ax in spec) else n
+    assert st["mp"] == 2
+    assert st["per_chip_param_nbytes"] == exp
+    assert st["per_chip_param_nbytes"] < st["replica_param_nbytes"]
+
+
+# -- the plane's KV ride-along ------------------------------------------------
+
+def test_plane_serve_stats_carry_worker_kv_ledger(hvd):
+    """A paged worker's kv_summary rides serve_push: GET /serve/stats
+    grows per-worker ``kv`` ledgers and a job-level ``kv`` total."""
+    from horovod_tpu.runner.rpc import JsonRpcServer, json_request
+    from horovod_tpu.serving.models import paged_llama_decode_forward
+    from horovod_tpu.serving.plane import ServingPlane
+    from horovod_tpu.serving.worker import ServingWorker
+    params = _params()
+    plane = ServingPlane(tick_ms=1.0, max_batch=2, seq_buckets="8",
+                         deadline_ms=0)
+    srv = JsonRpcServer(plane.rpc_handlers(), secret=None)
+    fwd = paged_llama_decode_forward(params, CFG, 4, plane.buckets,
+                                     block_size=4)
+    w = ServingWorker("127.0.0.1", srv.port, fwd, worker_id="0",
+                      wait_s=1.0, secret=None, warmup=False)
+    w.start()
+    try:
+        json_request("127.0.0.1", srv.port, "serve_submit",
+                     {"id": "r0", "tokens": [3, 5, 7]}, secret=None)
+        res = json_request("127.0.0.1", srv.port, "serve_result",
+                           {"id": "r0", "wait_s": 30.0}, secret=None)
+        assert res.get("done"), res
+        st = plane.stats()
+        kv = st["workers"]["0"]["kv"]
+        assert kv["block_size"] == 4
+        assert kv["bytes_capacity"] == \
+            fwd.allocator.capacity * fwd.allocator.block_nbytes
+        assert st["kv"]["bytes_capacity"] == kv["bytes_capacity"]
+    finally:
+        plane.close()
+        w.stop()
+        w.join(10)
+        srv.close()

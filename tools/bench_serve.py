@@ -50,6 +50,31 @@ CHAOS_SEED = 7
 SEQ_BUCKETS = "8,16,32"
 MAX_BATCH = 8
 
+# -- llama phases (--paged / --mp): tiny llama, reduced bucket table --
+# (6 compiled shapes per worker, not 12 — decode compiles dominate the
+# phase wall on CPU and the gates need shapes, not scale)
+LLAMA_SEQ = "8,16"
+LLAMA_CAP = 4
+LLAMA_NEW = 4
+#: KV block size: 4 divides every seq bucket AND max_new_tokens, so
+#: the paged logical width (blocks x 4) equals the dense max_len
+#: (bucket + new) exactly — the bit-parity precondition.
+LLAMA_BLOCK = 4
+#: Shared prompt head of the reuse mix: exactly 2 full blocks.
+LLAMA_HEAD = [7] * (2 * LLAMA_BLOCK)
+
+#: Deterministic parity probes: the driver decodes these sequentially
+#: (greedy_generate) and every serving path — paged, mesh-sliced —
+#: must return bit-identical rows THROUGH the plane.  Lengths sweep
+#: both seq buckets; first tokens are unique across the bench so no
+#: probe shares a prefix block with the reuse mix.
+VERIFY_PROMPTS = [
+    [31, 5, 9, 2, 7],
+    [37, 1, 8, 3, 6, 4, 2, 9],
+    [41, 2, 2, 7, 5, 9, 1, 3, 8, 6, 4, 2],
+    [43, 9, 4, 4, 1, 6, 2, 8, 5, 3, 7, 1, 9, 2, 6, 4],
+]
+
 
 def _percentile(sorted_vals, q):
     # lazy: sys.path gains the repo inside worker/_Phase setup
@@ -68,22 +93,57 @@ def run_worker(args) -> int:
     from horovod_tpu.serving.shapes import ShapeBuckets
     from horovod_tpu.serving.worker import ServingWorker
 
-    buckets = ShapeBuckets(
-        batch_buckets=tuple(1 << i for i in range(MAX_BATCH.bit_length())
-                            if (1 << i) <= MAX_BATCH),
-        seq_buckets=tuple(int(s) for s in SEQ_BUCKETS.split(",")))
-    fwd = toy_echo_forward(buckets)
+    kv_post_warmup = None
+    if args.model == "toy":
+        buckets = ShapeBuckets(
+            batch_buckets=tuple(
+                1 << i for i in range(MAX_BATCH.bit_length())
+                if (1 << i) <= MAX_BATCH),
+            seq_buckets=tuple(int(s) for s in SEQ_BUCKETS.split(",")))
+        fwd = toy_echo_forward(buckets)
+    else:
+        from horovod_tpu.models import llama
+        cfg = llama.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        buckets = ShapeBuckets(
+            batch_buckets=tuple(
+                1 << i for i in range(LLAMA_CAP.bit_length())
+                if (1 << i) <= LLAMA_CAP),
+            seq_buckets=tuple(int(s) for s in LLAMA_SEQ.split(",")))
+        if args.model == "paged":
+            from horovod_tpu.serving.models import \
+                paged_llama_decode_forward
+            fwd = paged_llama_decode_forward(
+                params, cfg, LLAMA_NEW, buckets,
+                block_size=LLAMA_BLOCK)
+        elif args.model == "mp":
+            from horovod_tpu.serving.models import mp_llama_decode_forward
+            fwd = mp_llama_decode_forward(params, cfg, LLAMA_NEW,
+                                          buckets, mp=2)
+        else:
+            raise SystemExit(f"unknown bench model {args.model!r}")
     # per-worker metrics exposition: the plane learns the port from the
     # pull payload, so the driver can scrape-and-merge /metrics across
     # workers exactly like the elastic driver's /metrics/job
     msrv = JsonRpcServer({}, secret=None)
+    if args.model == "paged":
+        # warm here (not in the worker loop) so the driver's exact
+        # fresh/reuse block expectations can start from a post-warmup
+        # allocator snapshot
+        fwd.warmup()
+        kv_post_warmup = fwd.allocator.stats()
     worker = ServingWorker(args.addr, args.port, fwd,
                            worker_id=str(args.id), wait_s=2.0,
                            secret=None, metrics_port=msrv.port,
-                           warmup=True)
+                           warmup=args.model != "paged")
     worker.run()   # returns on the plane's {"stop"} after close()
+    stats = worker.stats()
+    if kv_post_warmup is not None:
+        stats["kv_post_warmup"] = kv_post_warmup
+        stats["pool_nbytes"] = fwd.pool_nbytes
+        stats["n_blocks"] = fwd.allocator.n_blocks
     with open(args.out, "w") as f:
-        json.dump(worker.stats(), f)
+        json.dump(stats, f)
     msrv.close()
     return 0
 
@@ -95,7 +155,9 @@ class _Phase:
 
     def __init__(self, n_workers: int, max_batch: int,
                  chaos: str = "", lease_s: float = 10.0,
-                 straggler_factor: float = 0.0, tmp: str = "."):
+                 straggler_factor: float = 0.0, tmp: str = ".",
+                 model: str = "toy", seq_buckets: str = SEQ_BUCKETS,
+                 cap: int = MAX_BATCH):
         if REPO not in sys.path:
             sys.path.insert(0, REPO)
         from horovod_tpu.runner.rpc import JsonRpcServer
@@ -104,10 +166,10 @@ class _Phase:
         # moves the ADMISSION cap (cap 1 = the sequential baseline —
         # same plane, same workers, one request per forward)
         self.plane = ServingPlane(
-            tick_ms=2.0, max_batch=MAX_BATCH, seq_buckets=SEQ_BUCKETS,
+            tick_ms=2.0, max_batch=cap, seq_buckets=seq_buckets,
             deadline_ms=0, lease_s=lease_s,
             straggler_factor=straggler_factor)
-        if max_batch != MAX_BATCH:
+        if max_batch != cap:
             self.plane.set_max_batch(max_batch)
         self.srv = JsonRpcServer(self.plane.rpc_handlers(), secret=None)
         self.tmp = tmp
@@ -119,6 +181,12 @@ class _Phase:
                         "PYTHONPATH": REPO + os.pathsep
                         + env.get("PYTHONPATH", "")})
             env.pop("HOROVOD_SECRET_KEY", None)
+            if model == "mp":
+                # the mesh slice: 2 virtual CPU devices per worker
+                # process (x 2 worker processes = the 2x2 bench mesh)
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "")
+                    + " --xla_force_host_platform_device_count=2").strip()
             if chaos:
                 env["HVD_CHAOS"] = chaos
                 env["HVD_CHAOS_SEED"] = str(CHAOS_SEED)
@@ -127,7 +195,8 @@ class _Phase:
             out = os.path.join(tmp, f"w{len(self.procs)}_{wid}.json")
             cmd = [sys.executable, os.path.abspath(__file__), "--worker",
                    "--id", str(wid), "--addr", "127.0.0.1",
-                   "--port", str(self.srv.port), "--out", out]
+                   "--port", str(self.srv.port), "--out", out,
+                   "--model", model]
             self.procs.append((subprocess.Popen(cmd, env=env), out, wid))
 
     def wait_ready(self, timeout: float = 180.0):
@@ -292,10 +361,66 @@ def _gate(report, name, ok, detail):
         report["failed"] = True
 
 
+def _submit_collect(phase: _Phase, reqs, tag: str,
+                    stagger: float = 0.0) -> list:
+    """Submit ``reqs`` (token lists), wait for every result, return the
+    outputs in request order.  Deterministic closed-loop driver for the
+    llama phases — the exact block-count gates need a known request
+    set, not a Poisson sample."""
+    for i, toks in enumerate(reqs):
+        phase.submit(f"{tag}{i}", toks)
+        if stagger:
+            time.sleep(stagger)
+    outs: dict = {}
+    deadline = time.monotonic() + 120
+    while len(outs) < len(reqs) and time.monotonic() < deadline:
+        reply = phase.drain(wait_s=1.0)
+        for rid, res in reply.get("results", {}).items():
+            if not rid.startswith(tag):
+                continue
+            assert res.get("done") and not res.get("expired"), (rid, res)
+            outs[rid] = res.get("output")
+    assert len(outs) == len(reqs), \
+        f"{tag}: {len(outs)}/{len(reqs)} requests completed"
+    return [outs[f"{tag}{i}"] for i in range(len(reqs))]
+
+
+def _verify_reference():
+    """Driver-side sequential decode of VERIFY_PROMPTS — the
+    bit-parity reference every serving path must match exactly
+    (greedy_generate at the same max_len the bucketed forward uses)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from horovod_tpu.models import llama
+    from horovod_tpu.models.generate import greedy_generate
+    from horovod_tpu.serving.shapes import ShapeBuckets
+    cfg = llama.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    buckets = ShapeBuckets(
+        (1,), tuple(int(s) for s in LLAMA_SEQ.split(",")))
+    ref = []
+    for toks in VERIFY_PROMPTS:
+        s = buckets.seq_bucket(len(toks))
+        out = greedy_generate(params, cfg,
+                              np.asarray([toks], np.int32), LLAMA_NEW,
+                              max_len=s + LLAMA_NEW)
+        ref.append([int(t) for t in np.asarray(out)[0]])
+    return ref
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--smoke", action="store_true",
                    help="CI run: small request counts, all four gates")
+    p.add_argument("--paged", action="store_true",
+                   help="paged-KV phase: tiny-llama worker through the "
+                        "block allocator; exact byte/block gates + "
+                        "prefix-reuse gate + bit-parity probes")
+    p.add_argument("--mp", action="store_true",
+                   help="model-parallel phase: 2 workers x mp=2 (the "
+                        "2x2 CPU mesh); exact per-chip param-byte gate "
+                        "+ bit-parity probes")
     p.add_argument("--seed", type=int, default=5)
     p.add_argument("--n-seq", type=int, default=150)
     p.add_argument("--n-batched", type=int, default=400)
@@ -303,6 +428,7 @@ def main(argv=None) -> int:
     p.add_argument("--n-kill", type=int, default=200)
     # internal: worker mode
     p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--model", default="toy", help=argparse.SUPPRESS)
     p.add_argument("--id", type=int, default=0, help=argparse.SUPPRESS)
     p.add_argument("--addr", default="127.0.0.1", help=argparse.SUPPRESS)
     p.add_argument("--port", type=int, default=0, help=argparse.SUPPRESS)
@@ -457,6 +583,206 @@ def main(argv=None) -> int:
                "completed": kstats["completed"], "expected": args.n_kill,
                "requeued": requeued,
                "p99_ms": round(_percentile(lats_k, 0.99) * 1e3, 2)})
+
+        # ---- paged-KV phase (--paged): exact bytes, reuse, parity ----
+        verify_ref = None
+        if args.paged or args.mp:
+            verify_ref = _verify_reference()
+        if args.paged:
+            from horovod_tpu.models import llama as _llama
+            from horovod_tpu.serving.paging import (dense_kv_nbytes,
+                                                    kv_block_nbytes,
+                                                    row_blocks)
+            _cfg = _llama.tiny()
+            bs, new = LLAMA_BLOCK, LLAMA_NEW
+            phase = _Phase(n_workers=1, max_batch=LLAMA_CAP,
+                           model="paged", seq_buckets=LLAMA_SEQ,
+                           cap=LLAMA_CAP, tmp=tmp)
+            try:
+                phase.wait_ready()
+                rngp = random.Random(args.seed + 7)
+                # unique mix: first token unique per request, so no two
+                # prompts share a prefix block — every block is fresh
+                lens_a = [5, 8, 11, 16, 3, 13] * 4
+                reqs_a = [[100 + i] + [rngp.randrange(0, 256)
+                                       for _ in range(ln - 1)]
+                          for i, ln in enumerate(lens_a)]
+                _submit_collect(phase, reqs_a, "pgA", stagger=0.002)
+                # shared-head mix: every prompt opens with the same 2
+                # full blocks — request 0 allocates them, every later
+                # request must reuse both
+                n_b = 12
+                reqs_b = [LLAMA_HEAD + [rngp.randrange(0, 256)
+                                        for _ in range(3 + (i % 5))]
+                          for i in range(n_b)]
+                _submit_collect(phase, reqs_b, "pgB", stagger=0.002)
+                # parity probes THROUGH the plane (padded, batched,
+                # paged) vs the driver's sequential greedy_generate
+                outs_v = _submit_collect(phase, VERIFY_PROMPTS, "pgV")
+                # final probe burst: equal-length rows (one seq class,
+                # distinct heads — no sharing), so whatever batch split
+                # admission picks, the last batch's ledger must price
+                # every real row at exactly row_blocks(9) blocks while
+                # the dense cache would pay bucket-max for the whole
+                # batch bucket, pad rows included
+                probe_len = 9
+                probes = [[60 + i] + [9] * (probe_len - 1)
+                          for i in range(4)]
+                _submit_collect(phase, probes, "pgP")
+                plane_kv = phase.plane.stats()["kv"]
+            finally:
+                pstats = phase.close()
+            all_worker_stats += pstats
+            kv0 = pstats[0]["kv_post_warmup"]
+            kv1 = pstats[0]["forward"]["kv"]
+            pool_nbytes = pstats[0]["pool_nbytes"]
+            n_blocks = pstats[0]["n_blocks"]
+            blk = kv_block_nbytes(_cfg, bs)
+            # exact accounting: the allocator's per-block price times
+            # the pool size must equal tree_nbytes of the LIVE pool
+            # arrays — priced, not estimated (the sharded_tile_layout
+            # precedent)
+            _gate(report, "paged_bytes_exact_vs_tree_nbytes",
+                  kv1["block_nbytes"] == blk
+                  and pool_nbytes == n_blocks * blk
+                  and kv1["bytes_capacity"] == (n_blocks - 1) * blk,
+                  {"block_nbytes": kv1["block_nbytes"],
+                   "expected_block_nbytes": blk,
+                   "pool_tree_nbytes": pool_nbytes,
+                   "n_blocks": n_blocks})
+            # per-row pricing: every real row of the last probe batch
+            # held exactly ceil((len+new)/block) blocks, priced at the
+            # exact per-block bytes, vs the dense cache's bucket-max
+            # for the batch bucket (pad rows included — dense pays them)
+            per_row = row_blocks(probe_len, new, bs)
+            last = kv1["last"]
+            from horovod_tpu.serving.shapes import ShapeBuckets
+            bkts = ShapeBuckets(
+                tuple(1 << i for i in range(LLAMA_CAP.bit_length())
+                      if (1 << i) <= LLAMA_CAP),
+                tuple(int(s) for s in LLAMA_SEQ.split(",")))
+            s_bkt = bkts.seq_bucket(probe_len)
+            dense_b = dense_kv_nbytes(
+                _cfg, bkts.batch_bucket(last["rows"]), s_bkt + new)
+            paged_b = last["bytes_in_use"]
+            _gate(report, "paged_per_row_bytes_exact",
+                  last["rows"] >= 1
+                  and last["blocks"] == per_row * last["rows"]
+                  and paged_b == per_row * last["rows"] * blk
+                  and paged_b < dense_b,
+                  {"last": last, "row_blocks": per_row,
+                   "expected_bytes": per_row * last["rows"] * blk,
+                   "dense_bucket_bytes": dense_b,
+                   "paged_fraction": round(paged_b / dense_b, 4)})
+            # exact block ledger across the whole request set: every
+            # grant is either predicted-fresh or predicted-reused
+            exp_reuse = len(LLAMA_HEAD) // bs * (n_b - 1)
+            exp_total = (sum(row_blocks(ln, new, bs) for ln in lens_a)
+                         + sum(row_blocks(len(r), new, bs)
+                               for r in reqs_b)
+                         + sum(row_blocks(len(p), new, bs)
+                               for p in VERIFY_PROMPTS)
+                         + per_row * len(probes))
+            fresh_d = kv1["fresh"] - kv0["fresh"]
+            reuse_d = kv1["reuse_hits"] - kv0["reuse_hits"]
+            _gate(report, "paged_alloc_ledger_exact",
+                  reuse_d == exp_reuse
+                  and fresh_d == exp_total - exp_reuse
+                  and kv1["in_use"] == 0,
+                  {"fresh_delta": fresh_d, "reuse_delta": reuse_d,
+                   "expected_total_blocks": exp_total,
+                   "expected_reuse": exp_reuse,
+                   "in_use_after_drain": kv1["in_use"]})
+            # prefix reuse measurably cuts allocation under the
+            # shared-head mix: the head blocks were allocated once and
+            # served n_b requests
+            _gate(report, "paged_prefix_reuse_cuts_blocks",
+                  reuse_d > 0 and reuse_d == exp_reuse,
+                  {"blocks_saved": reuse_d,
+                   "shared_head_requests": n_b,
+                   "saved_fraction_of_mix": round(
+                       reuse_d / sum(row_blocks(len(r), new, bs)
+                                     for r in reqs_b), 4)})
+            _gate(report, "paged_parity_with_sequential",
+                  outs_v == verify_ref,
+                  {"probes": len(VERIFY_PROMPTS),
+                   "match": outs_v == verify_ref})
+            # satellite: the KV ledger rides serve_push onto the
+            # plane's GET /serve/stats
+            _gate(report, "paged_kv_on_serve_stats",
+                  plane_kv is not None
+                  and plane_kv["bytes_capacity"]
+                  == kv1["bytes_capacity"],
+                  {"plane_kv": plane_kv})
+            report["paged"] = {
+                "block_size": bs, "block_nbytes": blk,
+                "pool_blocks": n_blocks,
+                "fresh_blocks": fresh_d, "reused_blocks": reuse_d,
+                "evictions": kv1["evictions"] - kv0["evictions"]}
+
+        # ---- model-parallel phase (--mp): the 2x2 CPU mesh ----
+        if args.mp:
+            import jax as _jax
+            from jax.sharding import PartitionSpec as _P
+            from horovod_tpu.models import llama as _llama
+            from horovod_tpu.training import fsdp_param_specs
+            _cfg = _llama.tiny()
+            phase = _Phase(n_workers=2, max_batch=LLAMA_CAP,
+                           model="mp", seq_buckets=LLAMA_SEQ,
+                           cap=LLAMA_CAP, tmp=tmp)
+            try:
+                phase.wait_ready()
+                outs_m = _submit_collect(phase, VERIFY_PROMPTS, "mpV")
+                rngm = random.Random(args.seed + 8)
+                extra = [[51 + i] + [rngm.randrange(0, 256)
+                                     for _ in range(7)]
+                         for i in range(8)]
+                _submit_collect(phase, extra, "mpX", stagger=0.002)
+            finally:
+                mstats = phase.close()
+            all_worker_stats += mstats
+            # expected per-chip residency: replicated leaves whole,
+            # sharded leaves exactly 1/mp — computed from the same
+            # specs the worker shards with
+            shapes = _jax.eval_shape(
+                lambda: _llama.init_params(_cfg,
+                                           _jax.random.PRNGKey(0)))
+            specs = fsdp_param_specs(shapes, 2, axis="hvd_serve_mp")
+            is_p = lambda x: isinstance(x, _P)  # noqa: E731
+            exp_chip = exp_full = 0
+            for spec, leaf in zip(
+                    _jax.tree_util.tree_leaves(specs, is_leaf=is_p),
+                    _jax.tree_util.tree_leaves(shapes)):
+                n = 1
+                for d in leaf.shape:
+                    n *= d
+                n *= leaf.dtype.itemsize
+                exp_full += n
+                sharded = any(
+                    "hvd_serve_mp" in (e if isinstance(e, tuple)
+                                       else (e,))
+                    for e in spec)
+                exp_chip += n // 2 if sharded else n
+            fwd_m = [s.get("forward", {}) for s in mstats]
+            _gate(report, "mp_per_chip_bytes_exact",
+                  len(fwd_m) == 2
+                  and all(f.get("mp") == 2 for f in fwd_m)
+                  and all(f.get("per_chip_param_nbytes") == exp_chip
+                          for f in fwd_m)
+                  and all(f.get("replica_param_nbytes") == exp_full
+                          for f in fwd_m)
+                  and exp_chip < exp_full,
+                  {"per_chip_nbytes": exp_chip,
+                   "replica_nbytes": exp_full,
+                   "resident_fraction": round(exp_chip / exp_full, 4),
+                   "mesh": "2 workers x mp=2"})
+            _gate(report, "mp_parity_with_sequential",
+                  outs_m == verify_ref,
+                  {"probes": len(VERIFY_PROMPTS),
+                   "match": outs_m == verify_ref})
+            report["mp"] = {"workers": 2, "mp": 2,
+                            "per_chip_param_nbytes": exp_chip,
+                            "replica_param_nbytes": exp_full}
 
         # ---- gate 4: zero recompiles after warmup ----
         n_buckets_max = 4 * len(SEQ_BUCKETS.split(","))  # batch x seq

@@ -567,8 +567,13 @@ tail -1 /tmp/ci_hvdtop.log
 # loopback — all four tail-latency gates must hold every run (batched
 # >= 3x sequential at equal p50, chaos straggler rotated with p99
 # bounded, SIGKILL-mid-lease loses zero requests, zero post-warmup
-# recompiles).  (docs/serving.md)
-python tools/bench_serve.py --smoke > /tmp/ci_bench_serve.log 2>&1 \
+# recompiles), plus the paged-KV phase (allocator bytes == tree_nbytes
+# exactly, per-row blocks beat bucket-max, prefix reuse cuts blocks,
+# paged == dense outputs) and the model-parallel phase (per-chip param
+# bytes == the exact 1/mp fraction on the 2x2 CPU mesh).
+# (docs/serving.md)
+python tools/bench_serve.py --smoke --paged --mp \
+  > /tmp/ci_bench_serve.log 2>&1 \
   || { tail -30 /tmp/ci_bench_serve.log; exit 1; }
 tail -1 /tmp/ci_bench_serve.log
 # checkpointless recovery: a lost worker's ZeRO frame rebuilt from its
@@ -593,18 +598,21 @@ echo "== 11/11 hvdsched: collective-schedule snapshots + consistency =="
 # checksum all_gather under its cadence cond, and the fsdp_distopt_step
 # entry whose model-sharded buckets reduce-scatter shard-sized operands
 # over the data axis alone (HVD210 sweeps the data axis: mesh shapes
-# 2x2 and 4x2).  The explicit entry-count assertion pins snapshot
-# coverage: a deleted tests/schedules/*.json would otherwise let
-# --check pass vacuously on the entries that remain.
+# 2x2 and 4x2), and the serve_mp_forward_step entry whose schedule must
+# be ONLY the spec all_gather hops over the serving model axis (the
+# serve_forward_step empty-schedule pin, generalized).  The explicit
+# entry-count assertion pins snapshot coverage: a deleted
+# tests/schedules/*.json would otherwise let --check pass vacuously on
+# the entries that remain.
 n_sched=$(ls tests/schedules/*.json | wc -l)
-if [ "${n_sched}" -ne 10 ]; then
-  echo "FAIL: expected 10 schedule snapshots, found ${n_sched}"; exit 1
+if [ "${n_sched}" -ne 11 ]; then
+  echo "FAIL: expected 11 schedule snapshots, found ${n_sched}"; exit 1
 fi
 sched_out=$(bash tools/hvdsched --check)
 echo "${sched_out}"
 case "${sched_out}" in
-  *"10 entries clean"*) ;;
-  *) echo "FAIL: hvdsched --check did not trace all 10 pinned entries"
+  *"11 entries clean"*) ;;
+  *) echo "FAIL: hvdsched --check did not trace all 11 pinned entries"
      exit 1 ;;
 esac
 bash tools/hvdsched --check --consistency

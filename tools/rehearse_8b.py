@@ -129,6 +129,22 @@ def main():
     mo_repl = 2 * 2 * local_numel
     mo_spec = 2 * 2 * sum(bl.shard_numel for bl in layout.buckets)
 
+    # --- serving-side KV accounting (ISSUE 20): what one serving chip
+    # holds for the decode cache at the real 8B shapes, priced exactly
+    # (per-block bytes x block counts, the same ledger bench_serve's
+    # --paged gates) — dense pays batch x bucket-max unconditionally;
+    # paged pays ceil((len + new)/block) blocks per row
+    from horovod_tpu.serving.paging import (dense_kv_nbytes,
+                                            kv_block_nbytes, row_blocks)
+    kv_block = 16
+    kv_new = 256
+    kv_batch = 8
+    blk = kv_block_nbytes(cfg, kv_block)
+    dense_bytes = dense_kv_nbytes(cfg, kv_batch, seq + kv_new)
+    paged_at = {
+        str(ln): kv_batch * row_blocks(ln, kv_new, kv_block) * blk
+        for ln in (512, 1024, 2048, seq)}
+
     print(json.dumps({
         "ok": True,
         "n_params": int(n_params),
@@ -154,6 +170,25 @@ def main():
             "moments_bf16_zero_tiles_bytes": mo_spec,
             "state_drop_vs_replicated": round(mo_repl / mo_spec, 2),
             "per_chip_gib": round(mo_spec / gib, 3),
+        },
+        # ISSUE 20: serving decode-cache residency at the same shapes —
+        # a batch of kv_batch rows decoding kv_new tokens from a
+        # bucket_seq-token bucket.  Dense is the bucket-max buffer every
+        # row pays; paged is the exact block count at the given TRUE
+        # prompt length (the win grows as real lengths fall short of
+        # the bucket)
+        "serving_kv": {
+            "block": kv_block,
+            "block_nbytes": blk,
+            "batch": kv_batch,
+            "bucket_seq": seq,
+            "max_new_tokens": kv_new,
+            "dense_gib": round(dense_bytes / gib, 3),
+            "paged_gib_at_len": {
+                k: round(v / gib, 3) for k, v in paged_at.items()},
+            "paged_fraction_at_len": {
+                k: round(v / dense_bytes, 4)
+                for k, v in paged_at.items()},
         },
         "v5p_hbm_gib": 95,
     }))
